@@ -1,0 +1,71 @@
+"""Static-shape index dedup + gradient combine.
+
+The reference dedups indices client-side before every pull
+(/root/reference/openembedding/server/EmbeddingPullOperator.cpp:60-84 via
+EasyHashMap) and pre-sums duplicate-key gradients with counts before every
+push (EmbeddingPushOperator.cpp:29-62, then MpscGradientReducer on the
+server). Under XLA everything must be static-shape, so the TPU-native
+equivalent is capacity-padded: ``jnp.unique(..., size=capacity)`` plus
+scatter-add segment combines. Worst case capacity == batch size, so the
+default is exact; callers may pass a smaller capacity based on measured batch
+uniqueness (the reference measures this too: laboratory/benchmark/analyze.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+# Padding sentinel for empty unique slots. Indices/keys are remapped away from
+# this value by callers when the key space could include it.
+FILL = jnp.iinfo(jnp.int32).min
+
+
+def unique_indices(indices: jnp.ndarray, capacity: int | None = None,
+                   fill_value: int = FILL
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Deduplicate a flat index vector into a fixed-capacity buffer.
+
+    Returns ``(uniq [capacity], inverse [n], valid [capacity])`` where
+    ``uniq[inverse[i]] == indices[i]`` and padding slots hold ``fill_value``.
+    Equivalent of the reference's ``exb_unique_indices`` C-ABI helper
+    (c_api.cc:220-231), reshaped for XLA: sorted, padded, mask instead of a
+    dynamic length.
+
+    CAUTION: if the batch holds more than ``capacity`` distinct indices, the
+    overflow entries get ``inverse`` values >= capacity and their gradients
+    are DROPPED by ``combine_gradients`` (scatter mode="drop"). The default
+    capacity (== batch size) is always exact; only pass a smaller capacity if
+    measured batch uniqueness guarantees it, and monitor with
+    ``overflow_count``.
+    """
+    indices = indices.ravel()
+    if capacity is None:
+        capacity = indices.shape[0]
+    fill = jnp.asarray(fill_value, dtype=indices.dtype)
+    uniq, inverse = jnp.unique(indices, size=capacity, fill_value=fill,
+                               return_inverse=True)
+    return uniq, inverse.ravel(), uniq != fill
+
+
+def combine_gradients(grads: jnp.ndarray, inverse: jnp.ndarray, capacity: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sum duplicate-key gradients into the unique buffer with counts.
+
+    ``grads`` is [n, dim]; returns ``(summed [capacity, dim], counts
+    [capacity])``. Matches the reference's client-side pre-reduce semantics:
+    the optimizer sees the SUM over duplicates plus the duplicate count
+    (EmbeddingPushOperator.cpp:29-62, MpscGradientReducer.h:27-54).
+    """
+    n, dim = grads.shape
+    summed = jnp.zeros((capacity, dim), dtype=grads.dtype).at[inverse].add(
+        grads, mode="drop")
+    counts = jnp.zeros((capacity,), dtype=jnp.int32).at[inverse].add(
+        1, mode="drop")
+    return summed, counts
+
+
+def overflow_count(inverse: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """Number of batch entries whose unique slot overflowed ``capacity``."""
+    return jnp.sum(inverse >= capacity)

@@ -1,0 +1,25 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The reference's tests simulate an N-node cluster by forking N processes in one
+box (core::MultiProcess, reference entry/c_api_test.h:194). The JAX-native
+equivalent is XLA's virtual host devices: 8 CPU devices in one process, so all
+shard_map/pjit collective paths execute for real without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {devs}"
+    return devs[:8]

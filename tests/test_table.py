@@ -1,0 +1,126 @@
+"""Core table semantics: pull/apply, dedup, initializer behavior."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import (EmbeddingVariableMeta, apply_gradients,
+                               create_table, make_optimizer, pull)
+from openembedding_tpu.ops import dedup
+
+
+def make(vocab=16, dim=4, opt="sgd", init=None):
+    meta = EmbeddingVariableMeta(embedding_dim=dim, vocabulary_size=vocab)
+    optimizer = make_optimizer(opt)
+    return meta, optimizer, create_table(meta, optimizer, init,
+                                         rng=jax.random.PRNGKey(0))
+
+
+def test_pull_shapes():
+    _, _, state = make()
+    out = pull(state, jnp.array([[1, 2], [3, 3]]))
+    assert out.shape == (2, 2, 4)
+    np.testing.assert_array_equal(out[1, 0], out[1, 1])
+
+
+def test_initializers_deterministic_and_ranged():
+    meta = EmbeddingVariableMeta(embedding_dim=8, vocabulary_size=100)
+    opt = make_optimizer("default")
+    a = create_table(meta, opt, {"category": "uniform", "minval": -0.5, "maxval": 0.5},
+                     rng=jax.random.PRNGKey(7))
+    b = create_table(meta, opt, {"category": "uniform", "minval": -0.5, "maxval": 0.5},
+                     rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a.weights), np.asarray(b.weights))
+    assert float(a.weights.min()) >= -0.5 and float(a.weights.max()) <= 0.5
+    c = create_table(meta, opt, {"category": "constant", "value": 2.5})
+    assert float(c.weights.min()) == float(c.weights.max()) == 2.5
+    n = create_table(meta, opt, {"category": "normal", "stddev": 0.1, "truncated": True})
+    assert float(jnp.abs(n.weights).max()) <= 0.2 + 1e-6
+
+
+def test_untouched_rows_unchanged():
+    _, opt, state = make(opt={"category": "sgd", "learning_rate": 1.0})
+    before = np.asarray(state.weights).copy()
+    idx = jnp.array([2, 5])
+    g = jnp.ones((2, 4))
+    state2 = apply_gradients(state, opt, idx, g)
+    after = np.asarray(state2.weights)
+    touched = {2, 5}
+    for r in range(16):
+        if r in touched:
+            assert not np.allclose(before[r], after[r])
+        else:
+            np.testing.assert_array_equal(before[r], after[r])
+
+
+def test_duplicates_summed_once():
+    # one update with summed grad, not N momentum updates
+    _, opt, state = make(opt={"category": "sgd", "learning_rate": 0.1, "momentum": 0.9})
+    idx = jnp.array([3, 3, 3])
+    g = jnp.ones((3, 4))
+    state2 = apply_gradients(state, opt, idx, g)
+    # moment = 0*0.9 + 0.1*3 = 0.3 ; weight -= 0.3
+    np.testing.assert_allclose(np.asarray(state2.slots["moment"])[3],
+                               np.full(4, 0.3), rtol=1e-6)
+    delta = np.asarray(state.weights - state2.weights)[3]
+    np.testing.assert_allclose(delta, np.full(4, 0.3), rtol=1e-6)
+
+
+def test_dedup_capacity_padding():
+    idx = jnp.array([5, 1, 5, 9, 1, 1], dtype=jnp.int32)
+    uniq, inverse, valid = dedup.unique_indices(idx, capacity=6)
+    assert uniq.shape == (6,)
+    assert int(valid.sum()) == 3
+    np.testing.assert_array_equal(np.asarray(uniq)[np.asarray(inverse)],
+                                  np.asarray(idx))
+    g = jnp.ones((6, 2))
+    summed, counts = dedup.combine_gradients(g, inverse, 6)
+    got = {int(u): int(c) for u, c, v in
+           zip(np.asarray(uniq), np.asarray(counts), np.asarray(valid)) if v}
+    assert got == {1: 3, 5: 2, 9: 1}
+    assert float(summed.sum()) == 12.0
+
+
+def test_jit_apply_under_vocab_smaller_than_batch():
+    _, opt, state = make(vocab=4, opt={"category": "adagrad", "learning_rate": 0.1})
+    idx = jnp.array([0, 1, 2, 3, 0, 1, 2, 3, 0])
+    g = jnp.ones((9, 4))
+    step = jax.jit(lambda s: apply_gradients(s, opt, idx, g))
+    state2 = step(state)
+    assert np.isfinite(np.asarray(state2.weights)).all()
+
+
+def test_negative_index_dropped_not_wrapped():
+    _, opt, state = make(vocab=8, opt={"category": "sgd", "learning_rate": 1.0})
+    before = np.asarray(state.weights).copy()
+    state2 = apply_gradients(state, opt, jnp.array([-3]), jnp.ones((1, 4)))
+    np.testing.assert_array_equal(before, np.asarray(state2.weights))
+
+
+def test_bool_config_strings():
+    from openembedding_tpu import make_initializer
+    assert make_optimizer({"category": "sgd", "nesterov": "true"}).nesterov is True
+    assert make_optimizer({"category": "sgd", "nesterov": "false"}).nesterov is False
+    assert make_initializer({"category": "normal", "truncated": "false"}).truncated is False
+
+
+def test_bfloat16_adam_beta_slots_float32():
+    meta = EmbeddingVariableMeta(datatype="bfloat16", embedding_dim=4,
+                                 vocabulary_size=8)
+    opt = make_optimizer("adam")
+    state = create_table(meta, opt)
+    assert state.weights.dtype == jnp.bfloat16
+    assert state.slots["beta_1_t"].dtype == jnp.float32
+    state2 = apply_gradients(state, opt, jnp.array([1]), jnp.ones((1, 4), jnp.bfloat16))
+    assert state2.weights.dtype == jnp.bfloat16
+    assert state2.slots["beta_2_t"].dtype == jnp.float32
+    np.testing.assert_allclose(float(state2.slots["beta_2_t"][1, 0]), 0.999)
+
+
+def test_float64_requires_x64():
+    import pytest as _pytest
+    meta = EmbeddingVariableMeta(datatype="float64", embedding_dim=2,
+                                 vocabulary_size=4)
+    with _pytest.raises(ValueError, match="x64"):
+        create_table(meta, make_optimizer("sgd"))
