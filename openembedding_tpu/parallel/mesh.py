@@ -1,0 +1,53 @@
+"""Device-mesh helpers.
+
+The reference's cluster topology (N workers + M parameter-server processes
+over TCP/RDMA, reference client/Connection.cpp, entry/server.cc) maps
+TPU-natively to a single SPMD program over a 2-D device mesh:
+
+* ``data`` axis — the reference's workers (Horovod data parallelism): batch
+  sharded, dense params replicated, dense grads all-reduced by XLA.
+* ``model`` axis — the reference's PS shards: embedding tables sharded along
+  the vocabulary dimension; pull/push become collectives over ICI.
+
+A single axis can be 1 (pure DP or pure model parallel). Multi-host scaling
+uses the same mesh spanning hosts (jax distributed init); ICI carries the
+in-slice collectives, DCN the cross-slice ones — no custom RPC layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def create_mesh(data: int = 1, model: Optional[int] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, model) mesh. ``model=None`` uses all remaining devices.
+
+    Equivalent of the reference's worker_num / wait_num_servers bootstrap
+    flags (openembedding/__init__.py:33-40): worker_num -> data axis size,
+    server count -> model axis size, "server in each worker" (-1) -> the same
+    devices appear on both axes of one mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if model is None:
+        if n % data:
+            raise ValueError(f"{n} devices not divisible by data={data}")
+        model = n // data
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    devices = [device] if device is not None else jax.devices()[:1]
+    return create_mesh(1, 1, devices)

@@ -1,0 +1,219 @@
+"""Vocabulary-sharded embedding tables over a device mesh.
+
+TPU-native replacement for the reference's parameter-server data plane:
+
+* The reference shards each variable's key space ``index % global_shard_num``
+  across PS processes and pulls rows by RPC
+  (/root/reference/openembedding/server/EmbeddingPullOperator.cpp:60-112,
+  key stored as ``index / shard_num``). Here the same modulo layout shards
+  rows across TPU devices along the mesh ``model`` axis, and the pull is a
+  shard_map region: local gather of owned rows + ``psum`` over the model
+  axis — XLA collectives over ICI instead of TCP/RDMA round trips.
+* The push + store pipeline (client pre-reduce -> MpscGradientReducer ->
+  EmbeddingStoreOperator commit, EmbeddingPushOperator.cpp:29-161,
+  EmbeddingStoreOperator.cpp:23-81) becomes: ``all_gather`` of (indices,
+  row-grads) over the data axis, then every model shard dedups/combines the
+  global batch, masks ownership, and applies its rows' optimizer update
+  locally — one fused XLA program, synchronous per step (the reference's
+  fake-gradient batch barrier is unnecessary: the SPMD step IS the barrier).
+* ``num_shards`` semantics: the reference's shard-per-server default
+  (WorkerContext.cpp:66-85) corresponds to one shard per mesh model slice.
+
+Layouts:
+* ``mod``   (default, reference parity): global row r -> shard r % S, local
+  row r // S. Robust to frequency-skewed sequential ids.
+* ``div``   (block): r -> shard r // rows_per_shard. Matches NamedSharding's
+  natural blocking; best when keys are pre-hashed (uniform).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..meta import EmbeddingVariableMeta
+from ..optim.initializers import make_initializer
+from ..optim.optimizers import SparseOptimizer, make_optimizer
+from .. import table as table_lib
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingSpec:
+    """Static description of how one table is laid out on the mesh."""
+
+    num_shards: int
+    rows_per_shard: int
+    layout: str = "mod"  # "mod" | "div"
+    data_axis: str = DATA_AXIS
+    model_axis: str = MODEL_AXIS
+
+    @property
+    def padded_vocab(self) -> int:
+        return self.num_shards * self.rows_per_shard
+
+    def shard_and_local(self, idx: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        if self.layout == "mod":
+            return idx % self.num_shards, idx // self.num_shards
+        return idx // self.rows_per_shard, idx % self.rows_per_shard
+
+    def global_row(self, shard: jnp.ndarray, local: jnp.ndarray) -> jnp.ndarray:
+        if self.layout == "mod":
+            return local * self.num_shards + shard
+        return shard * self.rows_per_shard + local
+
+
+def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
+                       num_shards: int = -1, layout: str = "mod",
+                       capacity: Optional[int] = None) -> ShardingSpec:
+    """num_shards=-1 => one shard per model-axis slice (reference default)."""
+    if layout not in ("mod", "div"):
+        raise ValueError(f"unknown layout {layout!r}")
+    model_size = mesh.shape[MODEL_AXIS]
+    if num_shards == -1:
+        num_shards = model_size
+    if num_shards != model_size:
+        raise ValueError(
+            f"num_shards={num_shards} must equal mesh model axis size "
+            f"{model_size} (use a different mesh or -1)")
+    vocab = capacity if capacity is not None else meta.vocabulary_size
+    rows_per_shard = math.ceil(vocab / num_shards)
+    return ShardingSpec(num_shards=num_shards, rows_per_shard=rows_per_shard,
+                        layout=layout)
+
+
+def create_sharded_table(meta: EmbeddingVariableMeta,
+                         optimizer: Any,
+                         initializer: Any = None,
+                         *,
+                         mesh: Mesh,
+                         spec: Optional[ShardingSpec] = None,
+                         rng: Optional[jax.Array] = None) -> table_lib.TableState:
+    """Materialize a table sharded over the mesh model axis.
+
+    Each device initializes only its own rows (PRNG folded with the shard
+    index) — no host-side full-table materialization, so tables bounded only
+    by aggregate HBM, like the reference's tables bounded by aggregate PS RAM.
+    """
+    optimizer = make_optimizer(optimizer)
+    initializer = make_initializer(initializer or table_lib.DEFAULT_INITIALIZER)
+    if spec is None:
+        spec = make_sharding_spec(meta, mesh)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    dtype = table_lib.resolve_dtype(meta)
+    dim = meta.embedding_dim
+
+    def _init(key):
+        s = lax.axis_index(spec.model_axis)
+        k = jax.random.fold_in(key, s)
+        weights = initializer.init(k, (spec.rows_per_shard, dim), dtype)
+        slots = optimizer.init_slots(spec.rows_per_shard, dim, dtype)
+        return table_lib.TableState(weights=weights, slots=slots)
+
+    fn = shard_map(_init, mesh=mesh,
+                   in_specs=(P(),),
+                   out_specs=_state_specs(optimizer, dim, spec),
+                   check_vma=False)
+    return jax.jit(fn)(rng)
+
+
+def _state_specs(optimizer: SparseOptimizer, dim: int, spec: ShardingSpec):
+    slot_spec = {name: P(spec.model_axis)
+                 for name in optimizer.slot_shapes(dim)}
+    return table_lib.TableState(weights=P(spec.model_axis), slots=slot_spec)
+
+
+def state_shardings(state_specs, mesh: Mesh):
+    return jax.tree.map(lambda p: NamedSharding(mesh, p), state_specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def pull_sharded(state: table_lib.TableState,
+                 indices: jnp.ndarray,
+                 *,
+                 mesh: Mesh,
+                 spec: ShardingSpec,
+                 batch_sharded: bool = True) -> jnp.ndarray:
+    """Distributed embedding lookup.
+
+    ``indices``: any shape, sharded over the data axis on dim 0 when
+    ``batch_sharded`` (the normal training path) else replicated. Returns
+    rows with the same batch sharding. Equivalent to the reference's pull
+    RPC fan-out + response scatter (EmbeddingPullOperator.cpp:40-252), as a
+    gather + one psum over ICI.
+    """
+    dim = state.weights.shape[-1]
+    batch_spec = P(spec.data_axis) if batch_sharded else P()
+
+    def _pull(weights, idx):
+        s = lax.axis_index(spec.model_axis)
+        flat = idx.ravel()
+        shard, local = spec.shard_and_local(flat)
+        # invalid indices (negative or beyond the padded vocab) are owned by
+        # nobody -> psum returns zero rows, same contract as table_lib.pull
+        owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
+        rows = jnp.take(weights, jnp.where(owned, local, 0), axis=0, mode="clip")
+        rows = jnp.where(owned[:, None], rows, jnp.zeros_like(rows))
+        rows = lax.psum(rows, spec.model_axis)
+        return rows.reshape(idx.shape + (dim,))
+
+    fn = shard_map(_pull, mesh=mesh,
+                   in_specs=(P(spec.model_axis), batch_spec),
+                   out_specs=batch_spec,
+                   check_vma=False)
+    return fn(state.weights, indices)
+
+
+def apply_gradients_sharded(state: table_lib.TableState,
+                            optimizer: SparseOptimizer,
+                            indices: jnp.ndarray,
+                            grads: jnp.ndarray,
+                            *,
+                            mesh: Mesh,
+                            spec: ShardingSpec,
+                            batch_sharded: bool = True,
+                            dedup_capacity: Optional[int] = None
+                            ) -> table_lib.TableState:
+    """Distributed push+update: every shard applies its owned rows.
+
+    Data-axis devices all_gather the global (indices, grads) so the update is
+    computed identically on every data replica of a model shard — replacing
+    the reference's single-owner store RPC (WorkerContext.cpp:115-123) with
+    deterministic replicated application.
+    """
+    dim = state.weights.shape[-1]
+    batch_spec = P(spec.data_axis) if batch_sharded else P()
+
+    def _apply(weights, slots, idx, g):
+        s = lax.axis_index(spec.model_axis)
+        flat = idx.ravel()
+        g2 = g.reshape(-1, dim)
+        if batch_sharded:
+            flat = lax.all_gather(flat, spec.data_axis, tiled=True)
+            g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
+        shard, local = spec.shard_and_local(flat)
+        owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
+        # non-owned entries become index -1 -> dropped inside apply_gradients
+        masked = jnp.where(owned, local, -1)
+        local_state = table_lib.TableState(weights=weights, slots=slots)
+        new_state = table_lib.apply_gradients(
+            local_state, optimizer, masked, g2,
+            dedup_capacity=dedup_capacity)
+        return new_state.weights, new_state.slots
+
+    slot_specs = {name: P(spec.model_axis) for name in state.slots}
+    fn = shard_map(_apply, mesh=mesh,
+                   in_specs=(P(spec.model_axis), slot_specs, batch_spec, batch_spec),
+                   out_specs=(P(spec.model_axis), slot_specs),
+                   check_vma=False)
+    weights, slots = fn(state.weights, state.slots, indices, grads)
+    return table_lib.TableState(weights=weights, slots=slots)

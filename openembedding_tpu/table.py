@@ -35,6 +35,20 @@ from .optim.initializers import Initializer, make_initializer
 from .optim.optimizers import SparseOptimizer, make_optimizer
 
 
+# Shared default: small-uniform like the reference's default variable config.
+DEFAULT_INITIALIZER = {"category": "uniform", "minval": -1e-3, "maxval": 1e-3}
+
+
+def resolve_dtype(meta: EmbeddingVariableMeta):
+    """Table dtype with the x64 guard (float64 needs jax_enable_x64)."""
+    dtype = jnp.dtype(meta.datatype)
+    if dtype == jnp.float64 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "datatype='float64' requires jax_enable_x64; enable it with "
+            "jax.config.update('jax_enable_x64', True) or use float32/bfloat16")
+    return dtype
+
+
 @struct.dataclass
 class TableState:
     """Pytree holding one shard's weights + optimizer slots."""
@@ -63,17 +77,12 @@ def create_table(meta: EmbeddingVariableMeta,
     the sharded wrappers in ``parallel/`` to build per-shard slices).
     """
     optimizer = make_optimizer(optimizer)
-    initializer = make_initializer(initializer or {"category": "uniform",
-                                                   "minval": -1e-3, "maxval": 1e-3})
+    initializer = make_initializer(initializer or DEFAULT_INITIALIZER)
     if capacity is None:
         capacity = meta.vocabulary_size
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    dtype = jnp.dtype(meta.datatype)
-    if dtype == jnp.float64 and not jax.config.jax_enable_x64:
-        raise ValueError(
-            "datatype='float64' requires jax_enable_x64; enable it with "
-            "jax.config.update('jax_enable_x64', True) or use float32/bfloat16")
+    dtype = resolve_dtype(meta)
     weights = initializer.init(rng, (capacity, meta.embedding_dim), dtype)
     slots = optimizer.init_slots(capacity, meta.embedding_dim, dtype)
     return TableState(weights=weights, slots=slots)
@@ -82,11 +91,14 @@ def create_table(meta: EmbeddingVariableMeta,
 def pull(state: TableState, indices: jnp.ndarray) -> jnp.ndarray:
     """Embedding lookup: rows for (possibly duplicated) indices.
 
-    Out-of-range indices clamp (XLA gather default); callers that shard keys
-    mask ownership before calling. Output shape = indices.shape + [dim].
+    Invalid indices (negative or >= capacity) return zero rows — the same
+    contract as the sharded path and as apply_gradients, which drops them.
+    Output shape = indices.shape + [dim].
     """
     flat = indices.ravel()
-    rows = jnp.take(state.weights, flat, axis=0, mode="clip")
+    valid = (flat >= 0) & (flat < state.capacity)
+    rows = jnp.take(state.weights, jnp.where(valid, flat, 0), axis=0, mode="clip")
+    rows = jnp.where(valid[:, None], rows, jnp.zeros_like(rows))
     return rows.reshape(indices.shape + (state.dim,))
 
 
