@@ -1,0 +1,88 @@
+"""Benchmark driver: DeepFM training throughput, one JSON line to stdout.
+
+Mirrors the reference's headline benchmark (test/benchmark/criteo_deepctr.py,
+documents/en/benchmark.md:41-52): DeepFM, embedding dim 9, Adagrad, 26
+categorical features with hashed ids, batch 4096 per chip, Criteo-shaped
+synthetic stream. The reference's Criteo-1TB number is 692k examples/s on
+8 GPU workers + 1 PS = 86.5k examples/s per accelerator chip —
+``vs_baseline`` is examples/s/chip against that per-chip rate.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REF_PER_CHIP = 692_000 / 8  # examples/s per accelerator in the reference
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    # one chip: pure model placement; multi-chip: (data, model) split
+    data_ax = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    mesh = create_mesh(data_ax, n_dev // data_ax)
+
+    features = tuple(f"c{i}" for i in range(26))
+    batch = 4096
+    dim = 9
+    vocab_per_feature = 1 << 20  # bounded ids (hashed host-side like TSV path)
+
+    specs = deepctr.make_feature_specs(
+        features, vocab_per_feature, dim,
+        optimizer={"category": "adagrad", "learning_rate": 0.01})
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", features), coll,
+                      optax.adagrad(0.01))
+
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        sparse = {}
+        for f in features:
+            ids = rng.randint(0, vocab_per_feature, batch).astype(np.int32)
+            sparse[f] = ids
+            sparse[f + deepctr.LINEAR_SUFFIX] = ids
+        return {
+            "label": (rng.rand(batch) > 0.5).astype(np.float32),
+            "dense": rng.randn(batch, 13).astype(np.float32),
+            "sparse": sparse,
+        }
+
+    batches = [make_batch() for _ in range(8)]
+    state = trainer.init(jax.random.PRNGKey(0),
+                         trainer.shard_batch(batches[0]))
+
+    # warmup / compile
+    state, m = trainer.train_step(state, batches[0])
+    jax.block_until_ready(m["loss"])
+
+    steps = 30 if platform != "cpu" else 5
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = trainer.train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = steps * batch / dt
+    per_chip = examples_per_sec / n_dev
+    print(json.dumps({
+        "metric": f"deepfm_dim9_adagrad_examples_per_sec_{platform}{n_dev}",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/s",
+        "vs_baseline": round(per_chip / REF_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

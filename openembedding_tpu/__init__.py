@@ -22,5 +22,8 @@ __version__ = "0.1.0"
 from .meta import (EmbeddingVariableMeta, ModelMeta, ModelVariableMeta,
                    UNBOUNDED_VOCAB, META_FORMAT_VERSION)
 from .table import TableState, create_table, pull, apply_gradients
+from .hash_table import HashTableState, create_hash_table
 from .optim.optimizers import make_optimizer, SparseOptimizer
 from .optim.initializers import make_initializer, Initializer
+from .embedding import EmbeddingSpec, EmbeddingCollection
+from .training import Trainer, TrainState, binary_logloss

@@ -1,0 +1,236 @@
+"""Sharded checkpoint save/load + dense export.
+
+Capability parity with the reference's dump/load pipeline (SURVEY §3.4;
+/root/reference/openembedding/server/EmbeddingDumpOperator.cpp,
+EmbeddingLoadOperator.cpp, client/Model.cpp:89-134):
+
+* ``<path>/model_meta`` — the same self-describing JSON head (model_sign,
+  ordered variable metas, format version; reference Meta.h "0.2", ours
+  ``META_FORMAT_VERSION``). Load validates variable metas match before
+  touching any table (Model.cpp:110-121).
+* per-variable ``var_<id>_<name>.npz`` — logical-row-order weights (+ named
+  optimizer-state arrays when ``include_optimizer``, the reference's
+  state_line_size != 0 flag, EmbeddingDumpOperator.cpp:36-76); hash variables
+  store (keys, weights, states) triples of live rows only — the reference's
+  streamed (indices, weights, states) blocks with re-globalized keys
+  (EmbeddingShardFile.h:21-23).
+* **Shard-topology independence**: arrays are written in *logical id order*
+  (the physical mod-layout permutation is undone on save and re-applied on
+  load), and hash rows are keyed — so a checkpoint taken on an 8-way mesh
+  loads onto a 2-way mesh, like the reference re-shards by
+  ``key % shard_num`` at load.
+* ``export_dense`` — the ``save_as_original_model`` equivalent
+  (exb.py:506-547): materializes every bounded variable as a dense array for
+  serving without this framework; hash variables are rejected exactly like
+  the reference (exb.py:536).
+
+Dense flax params ride flax.serialization msgpack next to the sparse dump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization
+
+from .embedding import EmbeddingCollection
+from .meta import ModelMeta
+from . import hash_table as hash_lib
+from . import table as table_lib
+from .parallel import sharded_hash as sh
+from .parallel import sharded_table as st
+
+MODEL_META_FILE = "model_meta"
+DENSE_FILE = "dense_state.msgpack"
+_LOAD_CHUNK = 1 << 16
+
+
+def _var_file(variable_id: int, name: str) -> str:
+    safe = name.replace("/", "_").replace(":", "__")
+    return f"var_{variable_id}_{safe}.npz"
+
+
+def _logical_perm(spec: st.ShardingSpec) -> np.ndarray:
+    """physical position of logical row r under the sharded layout."""
+    r = np.arange(spec.padded_vocab, dtype=np.int64)
+    shard = r % spec.num_shards if spec.layout == "mod" else r // spec.rows_per_shard
+    local = r // spec.num_shards if spec.layout == "mod" else r % spec.rows_per_shard
+    return shard * spec.rows_per_shard + local
+
+
+def save_checkpoint(path: str,
+                    collection: EmbeddingCollection,
+                    states: Dict[str, Any],
+                    *,
+                    dense_state: Any = None,
+                    include_optimizer: bool = True,
+                    model_sign: str = "") -> None:
+    """Dump all embedding variables (+ optional dense pytree) under ``path``."""
+    os.makedirs(path, exist_ok=True)
+    meta = collection.model_meta(model_sign=model_sign, model_uri=path)
+    meta.extra["include_optimizer"] = bool(include_optimizer)
+    with open(os.path.join(path, MODEL_META_FILE), "w") as f:
+        f.write(meta.dumps())
+
+    for name, spec in collection.specs.items():
+        state = states[name]
+        vid = collection.variable_id(name)
+        arrays = {}
+        if spec.use_hash:
+            keys = np.asarray(jax.device_get(state.keys))
+            weights = np.asarray(jax.device_get(state.weights))
+            live = keys != hash_lib.empty_key(keys.dtype)
+            arrays["keys"] = keys[live]
+            arrays["weights"] = weights[live]
+            if include_optimizer:
+                for sname, sval in state.slots.items():
+                    arrays[f"slot_{sname}"] = np.asarray(
+                        jax.device_get(sval))[live]
+        else:
+            # store only the real vocab rows in logical id order — padding
+            # rows (vocab..padded_vocab) are unreachable by contract and
+            # differ across mesh shapes, so dropping them is what makes the
+            # checkpoint shard-topology independent
+            sspec = collection.sharding_spec(name)
+            perm = _logical_perm(sspec)[:spec.input_dim]
+            arrays["weights"] = np.asarray(
+                jax.device_get(state.weights))[perm]
+            if include_optimizer:
+                for sname, sval in state.slots.items():
+                    arrays[f"slot_{sname}"] = np.asarray(
+                        jax.device_get(sval))[perm]
+        np.savez(os.path.join(path, _var_file(vid, name)), **arrays)
+
+    if dense_state is not None:
+        with open(os.path.join(path, DENSE_FILE), "wb") as f:
+            f.write(serialization.to_bytes(jax.device_get(dense_state)))
+
+
+def _check_meta(path: str, collection: EmbeddingCollection) -> ModelMeta:
+    with open(os.path.join(path, MODEL_META_FILE)) as f:
+        meta = ModelMeta.loads(f.read())
+    want = collection.model_meta()
+    got_vars = {v.name: v for v in meta.variables}
+    for v in want.variables:
+        if v.name not in got_vars:
+            raise ValueError(f"checkpoint at {path!r} has no variable "
+                             f"{v.name!r}")
+        g = got_vars[v.name]
+        if g.meta != v.meta:
+            raise ValueError(
+                f"variable {v.name!r} meta mismatch: checkpoint "
+                f"{g.meta} vs model {v.meta}")
+    return meta
+
+
+def load_checkpoint(path: str,
+                    collection: EmbeddingCollection,
+                    *,
+                    dense_state_template: Any = None,
+                    rng: Optional[jax.Array] = None):
+    """Rebuild all embedding states from ``path`` (any source mesh shape).
+
+    Returns ``states`` or ``(states, dense_state)`` when a template pytree is
+    given. Equivalent of Model::load_model: meta check -> clear weights ->
+    re-deliver rows to owning shards (Model.cpp:110-134).
+    """
+    meta = _check_meta(path, collection)
+    with_opt = bool(meta.extra.get("include_optimizer", True))
+    hash_names = [n for n, s in collection.specs.items() if s.use_hash]
+    # only hash variables need fresh (empty) device tables; bounded tables are
+    # assembled host-side below and never pay the random-init program
+    states = collection.init(rng, only=hash_names)
+    out = {}
+    for name, spec in collection.specs.items():
+        vid = collection.variable_id(name)
+        data = np.load(os.path.join(path, _var_file(vid, name)))
+        sspec = collection.sharding_spec(name)
+        optimizer = collection.optimizer(name)
+        if spec.use_hash:
+            state = states[name]
+            keys = data["keys"]
+            weights = data["weights"]
+            slot_data = ({s: data[f"slot_{s}"] for s in state.slots}
+                         if with_opt else {})
+            # stream fixed-size chunks (padded with EMPTY) to keep shapes static
+            empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+            n = keys.shape[0]
+            for lo in range(0, max(n, 1), _LOAD_CHUNK):
+                hi = min(lo + _LOAD_CHUNK, n)
+                size = min(_LOAD_CHUNK, max(n, 1))
+                ck = np.full((size,), empty, dtype=keys.dtype)
+                cw = np.zeros((size,) + weights.shape[1:], weights.dtype)
+                ck[:hi - lo] = keys[lo:hi]
+                cw[:hi - lo] = weights[lo:hi]
+                srows = {}
+                for sname, full in slot_data.items():
+                    cs = np.zeros((size,) + full.shape[1:], full.dtype)
+                    cs[:hi - lo] = full[lo:hi]
+                    srows[sname] = jnp.asarray(cs)
+                state = sh.insert_rows_sharded(
+                    state, jnp.asarray(ck), jnp.asarray(cw), srows,
+                    mesh=collection.mesh, spec=sspec)
+            out[name] = state
+        else:
+            # assemble the physical (mod-layout) arrays host-side, padding
+            # rows beyond the stored vocab with zeros / slot-init values (they
+            # are unreachable), then place them sharded
+            perm = _logical_perm(sspec)
+            shardings = collection.state_shardings()[name]
+            dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
+            dim = spec.output_dim
+            pv = sspec.padded_vocab
+
+            def _to_physical(logical_rows, fill, store_dtype):
+                full = np.full((pv,) + logical_rows.shape[1:], fill,
+                               dtype=store_dtype)
+                full[:logical_rows.shape[0]] = logical_rows
+                phys = np.empty_like(full)
+                phys[perm] = full
+                return phys
+
+            weights = _to_physical(data["weights"], 0.0, dtype)
+            new_slots = {}
+            for sname, sshape in optimizer.slot_shapes(dim).items():
+                sdtype = np.dtype(optimizer.slot_dtype(sname, dtype))
+                fill = optimizer.slot_init(sname)
+                if with_opt:
+                    rows = data[f"slot_{sname}"]
+                else:
+                    rows = np.empty((0, *sshape), dtype=sdtype)
+                new_slots[sname] = jax.device_put(
+                    _to_physical(rows, fill, sdtype), shardings.slots[sname])
+            out[name] = table_lib.TableState(
+                weights=jax.device_put(weights, shardings.weights),
+                slots=new_slots)
+    if dense_state_template is not None:
+        with open(os.path.join(path, DENSE_FILE), "rb") as f:
+            dense = serialization.from_bytes(dense_state_template, f.read())
+        return out, dense
+    return out
+
+
+def export_dense(collection: EmbeddingCollection,
+                 states: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Materialize bounded variables as dense [vocab, dim] arrays.
+
+    ``save_as_original_model`` equivalent (exb.py:506-547): the result plugs
+    into any plain embedding lookup. Hash variables cannot be densified and
+    raise, matching exb.py:536.
+    """
+    out = {}
+    for name, spec in collection.specs.items():
+        if spec.use_hash:
+            raise ValueError(
+                f"variable {name!r} uses an unbounded hash key space and "
+                "cannot be exported densely (reference rejects this too)")
+        sspec = collection.sharding_spec(name)
+        perm = _logical_perm(sspec)
+        weights = np.asarray(jax.device_get(states[name].weights))[perm]
+        out[name] = weights[:spec.input_dim]  # drop padding rows
+    return out

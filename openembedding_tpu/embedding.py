@@ -1,0 +1,229 @@
+"""High-level embedding API: specs + a collection of sharded variables.
+
+This is the TPU-native counterpart of the reference's Python surface
+(/root/reference/openembedding/tensorflow/exb.py):
+
+* ``EmbeddingSpec`` ≈ ``embed.Embedding(...)`` constructor arguments
+  (exb.py:388-443): ``input_dim=-1`` selects the unbounded hash-key space
+  (exb.py:231-233 maps it to vocab 2^63), per-variable optimizer/initializer
+  configs use the same string-dict convention (exb.py:25-86).
+* ``EmbeddingCollection`` ≈ the Context + per-layer ``Variable`` machinery
+  (exb.py:222-360): it assigns variable ids by registration order
+  (WorkerContext.cpp:95-113), owns each variable's sharding layout over the
+  mesh, and exposes the three data-plane verbs —
+
+  - ``init(rng)``            ≈ create_storage + create_variable + initializer
+  - ``pull(states, inputs)``  ≈ ``sparse_read`` → PullWeights for every layer
+  - ``apply_gradients(states, inputs, row_grads)`` ≈ PushGradients +
+    UpdateWeights for the whole model in one fused program. The reference's
+    fake-gradient allreduce barrier (exb_ops.cpp:434-437) has no equivalent
+    because the SPMD step is already synchronous.
+
+The dense half of a model (MLPs, small `sparse_as_dense` embeddings —
+exb.py:100-104) lives in ordinary flax params, replicated and data-parallel,
+exactly like the reference keeps small embeddings as plain tf.Variables under
+Horovod allreduce.
+
+Everything is functional: states are pytrees, the collection itself is static
+configuration (hashable, safe to close over in jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from .meta import (EmbeddingVariableMeta, ModelMeta, ModelVariableMeta,
+                   UNBOUNDED_VOCAB)
+from .optim.initializers import make_initializer
+from .optim.optimizers import make_optimizer
+from . import table as table_lib
+from .parallel import sharded_table as st
+from .parallel import sharded_hash as sh
+from .parallel.mesh import MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class EmbeddingSpec:
+    """Static description of one embedding variable (one reference Embedding
+    layer, exb.py:388-420)."""
+
+    name: str
+    input_dim: int                   # -1 => unbounded hash-key space
+    output_dim: int
+    dtype: str = "float32"
+    optimizer: Any = None            # None => collection default
+    initializer: Any = None          # None => collection default
+    num_shards: int = -1             # -1 => one shard per model-axis slice
+    hash_capacity: int = 2**20       # reserve_items for hash variables
+    layout: str = "mod"              # array-table row layout
+    key_dtype: str = "int32"         # hash key storage; "int64" needs x64 for
+                                     # the reference's full 2^62 key space
+
+    @property
+    def use_hash(self) -> bool:
+        return self.input_dim == -1
+
+    def meta(self) -> EmbeddingVariableMeta:
+        vocab = UNBOUNDED_VOCAB if self.use_hash else self.input_dim
+        return EmbeddingVariableMeta(datatype=self.dtype,
+                                     embedding_dim=self.output_dim,
+                                     vocabulary_size=vocab)
+
+
+class EmbeddingCollection:
+    """All sparse variables of one model, sharded over one mesh.
+
+    ``states`` (returned by :meth:`init`, threaded through ``pull`` /
+    ``apply_gradients``) is a plain dict ``name -> TableState|HashTableState``
+    — a pytree suitable for jit donation and checkpointing.
+    """
+
+    def __init__(self, specs, mesh: Mesh,
+                 default_optimizer: Any = None,
+                 default_initializer: Any = None):
+        if default_optimizer is None:
+            default_optimizer = {"category": "sgd", "learning_rate": 0.01}
+        if default_initializer is None:
+            default_initializer = dict(table_lib.DEFAULT_INITIALIZER)
+        self.mesh = mesh
+        self.specs: Dict[str, EmbeddingSpec] = {}
+        self._variable_ids: Dict[str, int] = {}
+        self._optimizers = {}
+        self._initializers = {}
+        self._shardings = {}
+        for i, spec in enumerate(specs):
+            if spec.name in self.specs:
+                raise ValueError(f"duplicate embedding name {spec.name!r}")
+            self.specs[spec.name] = spec
+            self._variable_ids[spec.name] = i
+            self._optimizers[spec.name] = make_optimizer(
+                spec.optimizer if spec.optimizer is not None else default_optimizer)
+            self._initializers[spec.name] = make_initializer(
+                spec.initializer if spec.initializer is not None else default_initializer)
+            if spec.use_hash:
+                self._shardings[spec.name] = sh.make_hash_sharding_spec(
+                    mesh, total_capacity=spec.hash_capacity,
+                    num_shards=spec.num_shards)
+            else:
+                self._shardings[spec.name] = st.make_sharding_spec(
+                    spec.meta(), mesh, num_shards=spec.num_shards,
+                    layout=spec.layout)
+
+    # --- introspection -----------------------------------------------------
+    def variable_id(self, name: str) -> int:
+        return self._variable_ids[name]
+
+    def optimizer(self, name: str):
+        return self._optimizers[name]
+
+    def initializer(self, name: str):
+        return self._initializers[name]
+
+    def sharding_spec(self, name: str):
+        return self._shardings[name]
+
+    def model_meta(self, model_sign: str = "", model_uri: str = "") -> ModelMeta:
+        variables = [
+            ModelVariableMeta(meta=self.specs[name].meta(),
+                              variable_id=self._variable_ids[name],
+                              name=name)
+            for name in self.specs
+        ]
+        variables.sort(key=lambda v: v.variable_id)
+        return ModelMeta(model_sign=model_sign, model_uri=model_uri,
+                         variables=variables,
+                         num_shards=self.mesh.shape[MODEL_AXIS])
+
+    # --- state lifecycle ---------------------------------------------------
+    def init(self, rng: Optional[jax.Array] = None,
+             only: Optional[Any] = None) -> Dict[str, Any]:
+        """Materialize variables (each sharded over the mesh model axis).
+
+        ``only`` restricts to a subset of names (the checkpoint loader skips
+        device init for variables it overwrites host-side).
+        """
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        states = {}
+        for name, spec in self.specs.items():
+            if only is not None and name not in only:
+                continue
+            sub = jax.random.fold_in(rng, self._variable_ids[name])
+            if spec.use_hash:
+                states[name] = sh.create_sharded_hash_table(
+                    spec.meta(), self._optimizers[name],
+                    mesh=self.mesh,
+                    spec=self._shardings[name], rng=sub,
+                    key_dtype=jnp.dtype(spec.key_dtype))
+            else:
+                states[name] = st.create_sharded_table(
+                    spec.meta(), self._optimizers[name],
+                    self._initializers[name], mesh=self.mesh,
+                    spec=self._shardings[name], rng=sub)
+        return states
+
+    def state_shardings(self) -> Dict[str, Any]:
+        """NamedShardings for every state leaf (for jit in/out_shardings)."""
+        out = {}
+        for name, spec in self.specs.items():
+            sspec = self._shardings[name]
+            mod = sh if spec.use_hash else st
+            specs = mod.state_specs(self._optimizers[name],
+                                    spec.output_dim, sspec)
+            out[name] = st.state_shardings(specs, self.mesh)
+        return out
+
+    # --- data plane --------------------------------------------------------
+    def pull(self, states: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
+             *, batch_sharded: bool = True) -> Dict[str, jnp.ndarray]:
+        """Lookup rows for every (present) input column.
+
+        ``inputs``: name -> integer indices of any shape; returns name ->
+        rows shaped ``indices.shape + (dim,)``. Differentiation happens with
+        respect to the *returned rows* (pass their grads to
+        :meth:`apply_gradients`), not the tables — mirroring the reference's
+        custom PullWeights gradient (exb.py:89-97).
+        """
+        rows = {}
+        for name, idx in inputs.items():
+            spec = self.specs[name]
+            if spec.use_hash:
+                rows[name] = sh.pull_sharded(
+                    states[name], idx, self._initializers[name],
+                    mesh=self.mesh, spec=self._shardings[name],
+                    batch_sharded=batch_sharded)
+            else:
+                rows[name] = st.pull_sharded(
+                    states[name], idx, mesh=self.mesh,
+                    spec=self._shardings[name], batch_sharded=batch_sharded)
+        return rows
+
+    def apply_gradients(self, states: Dict[str, Any],
+                        inputs: Dict[str, jnp.ndarray],
+                        row_grads: Dict[str, jnp.ndarray],
+                        *, batch_sharded: bool = True) -> Dict[str, Any]:
+        """Push+update for every column present in ``row_grads``.
+
+        ``row_grads[name]`` has the shape of the pulled rows. Untouched
+        variables keep their state object unchanged.
+        """
+        new_states = dict(states)
+        for name, g in row_grads.items():
+            spec = self.specs[name]
+            if spec.use_hash:
+                new_states[name] = sh.apply_gradients_sharded(
+                    states[name], self._optimizers[name],
+                    self._initializers[name], inputs[name], g,
+                    mesh=self.mesh, spec=self._shardings[name],
+                    batch_sharded=batch_sharded)
+            else:
+                new_states[name] = st.apply_gradients_sharded(
+                    states[name], self._optimizers[name], inputs[name], g,
+                    mesh=self.mesh, spec=self._shardings[name],
+                    batch_sharded=batch_sharded)
+        return new_states

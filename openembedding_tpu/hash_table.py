@@ -1,0 +1,328 @@
+"""Hash-table embedding variant for unbounded int64 key spaces.
+
+TPU-native redesign of the reference's hash-table embedding
+(/root/reference/openembedding/variable/EmbeddingTable.h:55-118 —
+``EasyHashMap<key, T*>`` + block pool, selected when
+``vocabulary_size >= 2^63``, Meta.h:44-46): a **static-capacity
+open-addressing table in HBM** so every lookup/insert is a fixed-shape XLA
+program (no host round trips, no dynamic allocation):
+
+* ``keys``: ``[capacity]`` array, ``EMPTY`` sentinel for free slots; weights
+  and optimizer slots are parallel ``[capacity, ...]`` arrays as in the array
+  table.
+* **Lookup** probes a fixed window of ``max_probes`` linear positions starting
+  at ``mix(key) % capacity`` — one vectorized gather of ``[n, P]`` candidate
+  keys, then a masked argmax. Because slots are never freed, a key can never
+  live past the first empty slot of its chain, so a window scan is exact up to
+  window overflow.
+* **Insert** is the reference's deferred materialization
+  (EmbeddingOptimizerVariable.h:242-266: pull lazily creates rows in
+  ``_new_weights``, merged on the next update) made functional: a *pull* of a
+  missing key returns its **deterministic per-key initializer row** (PRNG
+  folded with the key) without mutating anything; the *update* inserts the
+  row (claim-based parallel probing, ``lax.fori_loop`` over probe rounds) and
+  applies the gradient on top of that same deterministic init. Pull-then-push
+  therefore behaves exactly as if the row had materialized on pull.
+* Window overflow (table nearly full / pathological clustering) drops the
+  update and bumps ``insert_failures`` — observable, like the reference's
+  table growth being observable via item pool stats. Size the capacity for a
+  load factor <= ~0.7 and the default 32-probe window is effectively exact.
+
+Key dtype follows the incoming indices (int32 by default; enable
+``jax_enable_x64`` for the reference's full 2^62 hashed key space). The
+``EMPTY`` sentinel is ``iinfo(dtype).min`` — the same value dedup uses as its
+padding fill, so padding slots are naturally invalid keys here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from flax import struct
+
+from .meta import EmbeddingVariableMeta
+from .ops import dedup
+from .optim.initializers import Initializer, make_initializer
+from .optim.optimizers import SparseOptimizer, make_optimizer
+from . import table as table_lib
+
+DEFAULT_MAX_PROBES = 32
+
+
+def empty_key(dtype) -> int:
+    return int(jnp.iinfo(dtype).min)
+
+
+def _mix(keys: jnp.ndarray) -> jnp.ndarray:
+    """Avalanche-mix keys to probe start positions (unsigned arithmetic).
+
+    murmur3/splitmix-style finalizer so sequential or strided ids spread
+    uniformly — the reference gets this from EasyHashMap's hash policy.
+    """
+    if keys.dtype.itemsize == 8:
+        u = keys.astype(jnp.uint64)
+        u = (u ^ (u >> 33)) * jnp.uint64(0xFF51AFD7ED558CCD)
+        u = (u ^ (u >> 33)) * jnp.uint64(0xC4CEB9FE1A85EC53)
+        u = u ^ (u >> 33)
+    else:
+        u = keys.astype(jnp.uint32)
+        u = (u ^ (u >> 16)) * jnp.uint32(0x85EBCA6B)
+        u = (u ^ (u >> 13)) * jnp.uint32(0xC2B2AE35)
+        u = u ^ (u >> 16)
+    return u
+
+
+@struct.dataclass
+class HashTableState:
+    """Pytree for one hash-table shard."""
+
+    keys: jnp.ndarray                    # [capacity], EMPTY = free
+    weights: jnp.ndarray                 # [capacity, dim]
+    slots: Dict[str, jnp.ndarray]        # each [capacity, ...]
+    init_rng: jax.Array                  # base PRNG for per-key row init
+    insert_failures: jnp.ndarray         # int32 scalar, probe-window overflows
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.weights.shape[1]
+
+    def num_used(self) -> jnp.ndarray:
+        return jnp.sum(self.keys != empty_key(self.keys.dtype)).astype(jnp.int32)
+
+
+def create_hash_table(meta: EmbeddingVariableMeta,
+                      optimizer: Any,
+                      *,
+                      capacity: int,
+                      rng: Optional[jax.Array] = None,
+                      key_dtype=jnp.int32) -> HashTableState:
+    """Allocate an empty hash table shard.
+
+    ``capacity`` plays the reference's ``reserve_items`` role
+    (EmbeddingInitOperator.cpp:138-168) — hash vocabularies are unbounded so
+    the caller must budget rows.
+    """
+    optimizer = make_optimizer(optimizer)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    dtype = table_lib.resolve_dtype(meta)
+    dim = meta.embedding_dim
+    keys = jnp.full((capacity,), empty_key(key_dtype), dtype=key_dtype)
+    # weights hold placeholder zeros; live rows are written on insert with the
+    # deterministic per-key init, so this buffer's initial content never leaks.
+    weights = jnp.zeros((capacity, dim), dtype=dtype)
+    slots = optimizer.init_slots(capacity, dim, dtype)
+    return HashTableState(keys=keys, weights=weights, slots=slots,
+                          init_rng=rng,
+                          insert_failures=jnp.zeros((), jnp.int32))
+
+
+def init_rows(initializer: Initializer, base_rng: jax.Array,
+              keys: jnp.ndarray, dim: int, dtype) -> jnp.ndarray:
+    """Deterministic initializer row per key: fold key into the base PRNG."""
+    def one(k):
+        return initializer.init(jax.random.fold_in(base_rng, k), (dim,), dtype)
+    return jax.vmap(one)(keys)
+
+
+def check_key_dtype(table_keys: jnp.ndarray, query: jnp.ndarray) -> jnp.ndarray:
+    """Cast query keys to the table's key dtype, refusing silent truncation.
+
+    A table created with int32 keys cannot address an int64 id space — that
+    would alias ids modulo 2^32. Create the table with ``key_dtype=jnp.int64``
+    (requires jax_enable_x64) for the reference's full 2^62 hashed key space.
+    """
+    if query.dtype.itemsize > table_keys.dtype.itemsize:
+        raise ValueError(
+            f"query keys are {query.dtype} but the table stores "
+            f"{table_keys.dtype} keys; create the table with "
+            f"key_dtype={query.dtype} (int64 needs jax_enable_x64)")
+    return query.astype(table_keys.dtype)
+
+
+def find_rows(table_keys: jnp.ndarray, query: jnp.ndarray,
+              max_probes: int = DEFAULT_MAX_PROBES) -> jnp.ndarray:
+    """Slot index for each query key, or -1 when absent / invalid.
+
+    One [n, P] gather over the probe window, then masked first-match.
+    """
+    query = check_key_dtype(table_keys, query)
+    capacity = table_keys.shape[0]
+    h = (_mix(query) % jnp.asarray(capacity, _mix(query).dtype)).astype(jnp.int32)
+    pos = (h[:, None] + jnp.arange(max_probes, dtype=jnp.int32)[None, :]) % capacity
+    probed = jnp.take(table_keys, pos, axis=0)  # [n, P]
+    match = probed == query[:, None]
+    hit = jnp.any(match, axis=1)
+    first = jnp.argmax(match, axis=1)
+    slot = jnp.take_along_axis(pos, first[:, None], axis=1)[:, 0]
+    valid = query != empty_key(table_keys.dtype)
+    return jnp.where(hit & valid, slot, -1)
+
+
+def find_or_insert(table_keys: jnp.ndarray, new_keys: jnp.ndarray,
+                   valid: jnp.ndarray,
+                   max_probes: int = DEFAULT_MAX_PROBES
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Find each (unique) key's slot, inserting missing keys.
+
+    Parallel claim-based probing: each round every unplaced key tries its next
+    probe position; empty-slot claims are arbitrated by scatter-min of the key
+    ordinal, losers continue. Rounds are a ``lax.fori_loop`` with static
+    shapes. Returns ``(table_keys, slot [n] (-1 = failed), inserted [n],
+    failed [n])``.
+    """
+    capacity = table_keys.shape[0]
+    n = new_keys.shape[0]
+    empty = empty_key(table_keys.dtype)
+    h = (_mix(new_keys) % jnp.asarray(capacity, _mix(new_keys).dtype)).astype(jnp.int32)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    oob = jnp.asarray(capacity, jnp.int32)
+
+    def body(i, carry):
+        keys_arr, slot, done, inserted = carry
+        pos = (h + i) % capacity
+        cur = jnp.take(keys_arr, pos, axis=0)
+        active = valid & ~done
+        # already present (including keys inserted in earlier rounds)
+        matched = active & (cur == new_keys)
+        slot = jnp.where(matched, pos, slot)
+        done = done | matched
+        active = active & ~matched
+        # claim empty slots: lowest ordinal wins, losers retry next round
+        is_empty = cur == empty
+        trying = active & is_empty
+        claim = jnp.full((capacity,), n, jnp.int32).at[
+            jnp.where(trying, pos, oob)].min(ids, mode="drop")
+        won = trying & (jnp.take(claim, pos, axis=0) == ids)
+        keys_arr = keys_arr.at[jnp.where(won, pos, oob)].set(new_keys, mode="drop")
+        slot = jnp.where(won, pos, slot)
+        done = done | won
+        inserted = inserted | won
+        return keys_arr, slot, done, inserted
+
+    slot0 = jnp.full((n,), -1, jnp.int32)
+    done0 = ~valid
+    ins0 = jnp.zeros((n,), bool)
+    table_keys, slot, done, inserted = lax.fori_loop(
+        0, max_probes, body, (table_keys, slot0, done0, ins0))
+    failed = valid & ~done
+    return table_keys, slot, inserted, failed
+
+
+def insert_rows(state: HashTableState,
+                keys: jnp.ndarray,
+                weights: jnp.ndarray,
+                slot_rows: Optional[Dict[str, jnp.ndarray]] = None,
+                max_probes: int = DEFAULT_MAX_PROBES) -> HashTableState:
+    """Directly set rows (and optionally optimizer-state rows) for keys.
+
+    The load-path primitive (reference EmbeddingInitItems delivery,
+    EmbeddingLoadOperator.cpp:58-111): inserts missing keys and overwrites
+    weights/states verbatim — no optimizer math. ``keys`` must be unique;
+    EMPTY-sentinel keys are skipped.
+    """
+    keys = check_key_dtype(state.keys, keys.ravel())
+    valid = keys != empty_key(state.keys.dtype)
+    keys_arr, slot, _inserted, failed = find_or_insert(
+        state.keys, keys, valid, max_probes)
+    ok = valid & (slot >= 0)
+    oob = jnp.asarray(state.capacity, jnp.int32)
+    scatter_idx = jnp.where(ok, slot, oob)
+    new_weights = state.weights.at[scatter_idx].set(
+        weights.astype(state.weights.dtype), mode="drop")
+    slots = dict(state.slots)
+    if slot_rows:
+        for name, rows in slot_rows.items():
+            slots[name] = state.slots[name].at[scatter_idx].set(
+                rows.astype(state.slots[name].dtype), mode="drop")
+    return HashTableState(
+        keys=keys_arr, weights=new_weights, slots=slots,
+        init_rng=state.init_rng,
+        insert_failures=state.insert_failures + jnp.sum(failed).astype(jnp.int32))
+
+
+def pull(state: HashTableState, indices: jnp.ndarray,
+         initializer: Any,
+         max_probes: int = DEFAULT_MAX_PROBES) -> jnp.ndarray:
+    """Lookup rows; missing keys return their deterministic init row.
+
+    Mirrors the reference's pull contract (present -> stored row, absent ->
+    freshly initialized row, EmbeddingOptimizerVariable.h:242-266) without
+    mutation: the same init row materializes again at insert time. Keys equal
+    to the EMPTY sentinel return zeros.
+    """
+    initializer = make_initializer(initializer)
+    flat = check_key_dtype(state.keys, indices.ravel())
+    slot = find_rows(state.keys, flat, max_probes)
+    hit = slot >= 0
+    rows = jnp.take(state.weights, jnp.where(hit, slot, 0), axis=0, mode="clip")
+    fresh = init_rows(initializer, state.init_rng, flat, state.dim,
+                      state.weights.dtype)
+    rows = jnp.where(hit[:, None], rows, fresh)
+    invalid = flat == empty_key(state.keys.dtype)
+    rows = jnp.where(invalid[:, None], jnp.zeros_like(rows), rows)
+    return rows.reshape(indices.shape + (state.dim,))
+
+
+def apply_gradients(state: HashTableState,
+                    optimizer: SparseOptimizer,
+                    initializer: Any,
+                    indices: jnp.ndarray,
+                    grads: jnp.ndarray,
+                    *,
+                    dedup_capacity: Optional[int] = None,
+                    max_probes: int = DEFAULT_MAX_PROBES) -> HashTableState:
+    """Combine duplicate grads, insert missing keys, update touched rows.
+
+    The hash-table analogue of ``table.apply_gradients``: dedup -> claim/probe
+    insert -> gather (with deterministic init for fresh rows) -> vectorized
+    optimizer -> scatter. Window-overflow keys are dropped and counted.
+    """
+    optimizer = make_optimizer(optimizer)
+    initializer = make_initializer(initializer)
+    dim = state.dim
+    flat_idx = check_key_dtype(state.keys, indices.ravel())
+    flat_grads = grads.reshape(-1, dim)
+    n = flat_idx.shape[0]
+    capacity = dedup_capacity or n
+
+    uniq, inverse, valid = dedup.unique_indices(
+        flat_idx, capacity, fill_value=empty_key(flat_idx.dtype))
+    valid = valid & (uniq != empty_key(flat_idx.dtype))
+    summed, counts = dedup.combine_gradients(flat_grads, inverse, capacity)
+
+    keys_arr, slot, inserted, failed = find_or_insert(
+        state.keys, uniq, valid, max_probes)
+    ok = valid & (slot >= 0)
+    safe_slot = jnp.where(ok, slot, 0)
+
+    w = jnp.take(state.weights, safe_slot, axis=0)
+    fresh = init_rows(initializer, state.init_rng, uniq, dim,
+                      state.weights.dtype)
+    w = jnp.where(inserted[:, None], fresh, w)
+    s = {k: jnp.take(v, safe_slot, axis=0) for k, v in state.slots.items()}
+
+    compute = jnp.promote_types(state.weights.dtype, jnp.float32)
+    new_w, new_s = optimizer.update_rows(
+        w.astype(compute),
+        {k: v.astype(jnp.promote_types(v.dtype, jnp.float32)) for k, v in s.items()},
+        summed.astype(compute), counts)
+    new_w = new_w.astype(state.weights.dtype)
+    new_s = {k: new_s[k].astype(state.slots[k].dtype) for k in new_s}
+
+    oob = jnp.asarray(state.capacity, jnp.int32)
+    scatter_idx = jnp.where(ok, safe_slot, oob)
+    weights = state.weights.at[scatter_idx].set(new_w, mode="drop")
+    slots = {k: state.slots[k].at[scatter_idx].set(new_s[k], mode="drop")
+             for k in state.slots}
+    return HashTableState(
+        keys=keys_arr, weights=weights, slots=slots,
+        init_rng=state.init_rng,
+        insert_failures=state.insert_failures + jnp.sum(failed).astype(jnp.int32))

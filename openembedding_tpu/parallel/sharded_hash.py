@@ -1,0 +1,236 @@
+"""Hash-table embeddings sharded over the device mesh.
+
+Same data plane as ``sharded_table`` (gather + psum pull, all_gather + masked
+local update push) but for unbounded key spaces: each model-axis slice owns a
+local open-addressing ``HashTableState`` and the keys are partitioned
+``key % num_shards`` — the reference's modulo shard layout
+(/root/reference/openembedding/server/EmbeddingPullOperator.cpp:73-78) applied
+to hashed keys, which are uniform by construction.
+
+Non-owned keys are masked to the EMPTY sentinel before the local table call,
+which treats them as invalid (zero pull rows / dropped updates), so the psum
+over the model axis reconstructs the full batch exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..meta import EmbeddingVariableMeta
+from ..optim.initializers import make_initializer
+from ..optim.optimizers import SparseOptimizer, make_optimizer
+from .. import hash_table as hash_lib
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class HashShardingSpec:
+    """Static layout of one hash table over the mesh model axis."""
+
+    num_shards: int
+    capacity_per_shard: int
+    max_probes: int = hash_lib.DEFAULT_MAX_PROBES
+    data_axis: str = DATA_AXIS
+    model_axis: str = MODEL_AXIS
+
+    def owner_shard(self, keys: jnp.ndarray) -> jnp.ndarray:
+        # unsigned mod so negative (but valid) hashed keys still land on a
+        # deterministic shard; jnp % already yields non-negative for positive
+        # divisors, the cast keeps int64/int32 behavior identical.
+        return (keys % jnp.asarray(self.num_shards, keys.dtype)).astype(jnp.int32)
+
+
+def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
+                            num_shards: int = -1,
+                            max_probes: int = hash_lib.DEFAULT_MAX_PROBES
+                            ) -> HashShardingSpec:
+    """num_shards=-1 => one shard per model-axis slice (reference default)."""
+    model_size = mesh.shape[MODEL_AXIS]
+    if num_shards == -1:
+        num_shards = model_size
+    if num_shards != model_size:
+        raise ValueError(
+            f"num_shards={num_shards} must equal mesh model axis size "
+            f"{model_size} (use a different mesh or -1)")
+    cap = -(-total_capacity // num_shards)
+    return HashShardingSpec(num_shards=num_shards, capacity_per_shard=cap,
+                            max_probes=max_probes)
+
+
+def state_specs(optimizer: SparseOptimizer, dim: int, spec: HashShardingSpec):
+    m = spec.model_axis
+    return hash_lib.HashTableState(
+        keys=P(m), weights=P(m),
+        slots={name: P(m) for name in optimizer.slot_shapes(dim)},
+        init_rng=P(), insert_failures=P())
+
+
+def create_sharded_hash_table(meta: EmbeddingVariableMeta,
+                              optimizer: Any,
+                              *,
+                              mesh: Mesh,
+                              spec: HashShardingSpec,
+                              rng: Optional[jax.Array] = None,
+                              key_dtype=jnp.int32) -> hash_lib.HashTableState:
+    """Allocate per-shard empty hash tables across the mesh.
+
+    The per-key deterministic init uses the shared base rng (not folded per
+    shard): a key has exactly one owner, and keeping the base rng global makes
+    row init independent of shard count (checkpoints stay comparable when
+    resharded).
+    """
+    optimizer = make_optimizer(optimizer)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    dim = meta.embedding_dim
+
+    def _init(key):
+        return hash_lib.create_hash_table(
+            meta, optimizer,
+            capacity=spec.capacity_per_shard, rng=key, key_dtype=key_dtype)
+
+    fn = shard_map(_init, mesh=mesh,
+                   in_specs=(P(),),
+                   out_specs=state_specs(optimizer, dim, spec),
+                   check_vma=False)
+    return jax.jit(fn)(rng)
+
+
+def _mask_non_owned(spec: HashShardingSpec, flat: jnp.ndarray) -> jnp.ndarray:
+    s = lax.axis_index(spec.model_axis)
+    empty = hash_lib.empty_key(flat.dtype)
+    owned = (spec.owner_shard(flat) == s) & (flat != empty)
+    return jnp.where(owned, flat, empty)
+
+
+def insert_rows_sharded(state: hash_lib.HashTableState,
+                        keys: jnp.ndarray,
+                        weights: jnp.ndarray,
+                        slot_rows=None,
+                        *,
+                        mesh: Mesh,
+                        spec: HashShardingSpec) -> hash_lib.HashTableState:
+    """Load-path row delivery: every shard inserts its owned keys verbatim.
+
+    ``keys``/``weights``/``slot_rows`` are replicated host batches (the
+    checkpoint loader streams chunks); non-owned keys are masked to EMPTY and
+    skipped locally — the reference's owning-server delivery
+    (EmbeddingLoadOperator.cpp:58-111).
+    """
+    m = spec.model_axis
+    slot_rows = slot_rows or {}
+
+    def _insert(tkeys, tweights, tslots, init_rng, k, w, srows):
+        local = hash_lib.HashTableState(
+            keys=tkeys, weights=tweights, slots=tslots, init_rng=init_rng,
+            insert_failures=jnp.zeros((), jnp.int32))
+        masked = _mask_non_owned(spec, k.ravel())
+        new = hash_lib.insert_rows(local, masked, w, srows or None,
+                                   max_probes=spec.max_probes)
+        failed = lax.psum(new.insert_failures, spec.model_axis)
+        return new.keys, new.weights, new.slots, failed
+
+    slot_specs = {name: P(m) for name in state.slots}
+    in_slot_specs = {name: P() for name in slot_rows}
+    fn = shard_map(_insert, mesh=mesh,
+                   in_specs=(P(m), P(m), slot_specs, P(), P(), P(),
+                             in_slot_specs),
+                   out_specs=(P(m), P(m), slot_specs, P()),
+                   check_vma=False)
+    tkeys, tweights, tslots, failed = fn(
+        state.keys, state.weights, state.slots, state.init_rng,
+        keys, weights, slot_rows)
+    return hash_lib.HashTableState(
+        keys=tkeys, weights=tweights, slots=tslots,
+        init_rng=state.init_rng,
+        insert_failures=state.insert_failures + failed)
+
+
+def pull_sharded(state: hash_lib.HashTableState,
+                 indices: jnp.ndarray,
+                 initializer: Any,
+                 *,
+                 mesh: Mesh,
+                 spec: HashShardingSpec,
+                 batch_sharded: bool = True) -> jnp.ndarray:
+    """Distributed hash lookup: each shard resolves its owned keys, psum joins.
+
+    Missing-but-valid keys get their deterministic init row (computed only by
+    the owner shard); EMPTY-sentinel keys return zero rows.
+    """
+    dim = state.weights.shape[-1]
+    batch_spec = P(spec.data_axis) if batch_sharded else P()
+    initializer = make_initializer(initializer)
+
+    def _pull(keys, weights, init_rng, idx):
+        local = hash_lib.HashTableState(
+            keys=keys, weights=weights, slots={}, init_rng=init_rng,
+            insert_failures=jnp.zeros((), jnp.int32))
+        flat = _mask_non_owned(spec, idx.ravel())
+        rows = hash_lib.pull(local, flat, initializer,
+                             max_probes=spec.max_probes)
+        rows = lax.psum(rows, spec.model_axis)
+        return rows.reshape(idx.shape + (dim,))
+
+    fn = shard_map(_pull, mesh=mesh,
+                   in_specs=(P(spec.model_axis), P(spec.model_axis), P(),
+                             batch_spec),
+                   out_specs=batch_spec,
+                   check_vma=False)
+    return fn(state.keys, state.weights, state.init_rng, indices)
+
+
+def apply_gradients_sharded(state: hash_lib.HashTableState,
+                            optimizer: SparseOptimizer,
+                            initializer: Any,
+                            indices: jnp.ndarray,
+                            grads: jnp.ndarray,
+                            *,
+                            mesh: Mesh,
+                            spec: HashShardingSpec,
+                            batch_sharded: bool = True,
+                            dedup_capacity: Optional[int] = None
+                            ) -> hash_lib.HashTableState:
+    """Distributed push+update: all_gather batch, each shard updates its keys."""
+    dim = state.weights.shape[-1]
+    batch_spec = P(spec.data_axis) if batch_sharded else P()
+    optimizer = make_optimizer(optimizer)
+    m = spec.model_axis
+
+    def _apply(keys, weights, slots, init_rng, idx, g):
+        flat = idx.ravel()
+        g2 = g.reshape(-1, dim)
+        if batch_sharded:
+            flat = lax.all_gather(flat, spec.data_axis, tiled=True)
+            g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
+        flat = _mask_non_owned(spec, flat)
+        local = hash_lib.HashTableState(
+            keys=keys, weights=weights, slots=slots, init_rng=init_rng,
+            insert_failures=jnp.zeros((), jnp.int32))
+        new = hash_lib.apply_gradients(
+            local, optimizer, initializer, flat, g2,
+            dedup_capacity=dedup_capacity, max_probes=spec.max_probes)
+        # per-shard failure deltas -> replicated global total
+        failed = lax.psum(new.insert_failures, spec.model_axis)
+        return new.keys, new.weights, new.slots, failed
+
+    slot_specs = {name: P(m) for name in state.slots}
+    fn = shard_map(_apply, mesh=mesh,
+                   in_specs=(P(m), P(m), slot_specs, P(),
+                             batch_spec, batch_spec),
+                   out_specs=(P(m), P(m), slot_specs, P()),
+                   check_vma=False)
+    keys, weights, slots, failed = fn(
+        state.keys, state.weights, state.slots, state.init_rng,
+        indices, grads)
+    return hash_lib.HashTableState(
+        keys=keys, weights=weights, slots=slots,
+        init_rng=state.init_rng,
+        insert_failures=state.insert_failures + failed)

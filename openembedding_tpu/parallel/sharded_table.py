@@ -121,12 +121,12 @@ def create_sharded_table(meta: EmbeddingVariableMeta,
 
     fn = shard_map(_init, mesh=mesh,
                    in_specs=(P(),),
-                   out_specs=_state_specs(optimizer, dim, spec),
+                   out_specs=state_specs(optimizer, dim, spec),
                    check_vma=False)
     return jax.jit(fn)(rng)
 
 
-def _state_specs(optimizer: SparseOptimizer, dim: int, spec: ShardingSpec):
+def state_specs(optimizer: SparseOptimizer, dim: int, spec: ShardingSpec):
     slot_spec = {name: P(spec.model_axis)
                  for name in optimizer.slot_shapes(dim)}
     return table_lib.TableState(weights=P(spec.model_axis), slots=slot_spec)

@@ -1,0 +1,155 @@
+"""Training loop machinery: TrainState + jitted SPMD train/eval steps.
+
+TPU-native counterpart of the reference's execution model (SURVEY §3.2/3.3):
+the reference splits a step into pull RPCs (forward), push RPCs (backward),
+a Horovod allreduce of dense grads + fake grads (barrier), and a store RPC
+(optimizer commit). Here the whole step is ONE jitted SPMD program over the
+(data, model) mesh:
+
+* forward pull  -> shard_map gather + psum        (was: pull RPC)
+* dense grads   -> XLA all-reduce over data axis  (was: Horovod allreduce)
+* sparse update -> all_gather + masked local scatter-apply (was: push+store)
+* batch barrier -> implicit: it's one XLA program (was: fake-grad allreduce,
+  exb_ops.cpp:434-437)
+
+The dense half (MLPs + small `sparse_as_dense` embeddings) is a plain flax
+module optimized by optax, replicated like the reference's worker-side
+tf.Variables (exb.py:100-104, README "Cache" mode).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .embedding import EmbeddingCollection
+from .parallel.mesh import DATA_AXIS
+
+
+@struct.dataclass
+class TrainState:
+    """Whole-model training state: dense + sparse + bookkeeping."""
+
+    step: jnp.ndarray            # int32 global step (the reference batch_id)
+    params: Any                  # flax dense params, replicated
+    opt_state: Any               # optax state for the dense params
+    emb: Dict[str, Any]          # embedding states (sharded over model axis)
+
+
+def binary_logloss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean sigmoid cross-entropy — the CTR objective of every reference
+    example (examples/criteo_deepctr_network.py 'binary_crossentropy')."""
+    logits = logits.reshape(-1)
+    labels = labels.reshape(-1).astype(logits.dtype)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+
+class Trainer:
+    """Builds jitted train/eval steps for (flax module + EmbeddingCollection).
+
+    ``module.apply({'params': p}, batch['dense'], rows)`` must return logits
+    of shape [B]. ``batch`` is ``{'label': [B], 'dense': [B, d] (optional),
+    'sparse': {name: int indices}}``, batch-sharded over the data axis.
+    """
+
+    def __init__(self, module, collection: EmbeddingCollection,
+                 dense_optimizer: optax.GradientTransformation,
+                 loss_fn: Callable = binary_logloss):
+        self.module = module
+        self.collection = collection
+        self.tx = dense_optimizer
+        self.loss_fn = loss_fn
+        self.mesh = collection.mesh
+        self._replicated = NamedSharding(self.mesh, P())
+        self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._train_step = None
+        self._eval_step = None
+
+    # --- initialization ----------------------------------------------------
+    def init(self, rng: jax.Array, sample_batch: Dict[str, Any]) -> TrainState:
+        """Initialize dense params (replicated) + all embedding tables."""
+        emb_rng, dense_rng = jax.random.split(rng)
+        emb = self.collection.init(emb_rng)
+        rows = self.collection.pull(emb, sample_batch["sparse"],
+                                    batch_sharded=False)
+        variables = self.module.init(dense_rng, sample_batch.get("dense"), rows)
+        params = variables["params"]
+        set_repl = partial(jax.device_put, device=self._replicated)
+        params = jax.tree.map(set_repl, params)
+        opt_state = jax.tree.map(set_repl, self.tx.init(params))
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state, emb=emb)
+
+    # --- steps ---------------------------------------------------------------
+    def _build_train_step(self):
+        collection, module, tx, loss_fn = (self.collection, self.module,
+                                           self.tx, self.loss_fn)
+
+        def step_fn(state: TrainState, batch) -> tuple:
+            sparse = batch["sparse"]
+            rows = collection.pull(state.emb, sparse)
+
+            def lfn(params, rows):
+                logits = module.apply({"params": params},
+                                      batch.get("dense"), rows)
+                return loss_fn(logits, batch["label"])
+
+            loss, (dense_g, row_g) = jax.value_and_grad(
+                lfn, argnums=(0, 1))(state.params, rows)
+            updates, opt_state = tx.update(dense_g, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            emb = collection.apply_gradients(state.emb, sparse, row_g)
+            new_state = TrainState(step=state.step + 1, params=params,
+                                   opt_state=opt_state, emb=emb)
+            return new_state, {"loss": loss}
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def _build_eval_step(self):
+        collection, module = self.collection, self.module
+
+        def eval_fn(state: TrainState, batch):
+            rows = collection.pull(state.emb, batch["sparse"])
+            logits = module.apply({"params": state.params},
+                                  batch.get("dense"), rows)
+            return jax.nn.sigmoid(logits.reshape(-1))
+
+        return jax.jit(eval_fn)
+
+    def train_step(self, state: TrainState, batch) -> tuple:
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step(state, self.shard_batch(batch))
+
+    def eval_step(self, state: TrainState, batch) -> jnp.ndarray:
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        return self._eval_step(state, self.shard_batch(batch))
+
+    # --- helpers -------------------------------------------------------------
+    def shard_batch(self, batch):
+        """Place host batch arrays batch-sharded over the data axis."""
+        def place(x):
+            if x is None:
+                return None
+            x = jnp.asarray(x)
+            return jax.device_put(x, self._batch_sharding)
+        return jax.tree.map(place, batch)
+
+    def fit(self, state: TrainState, batches, *, log_every: int = 0,
+            log_fn=print):
+        """Simple host loop over an iterable of batches (model.fit analogue)."""
+        last = None
+        for i, batch in enumerate(batches):
+            state, metrics = self.train_step(state, batch)
+            last = metrics
+            if log_every and (i + 1) % log_every == 0:
+                log_fn(f"step {i + 1}: loss={float(metrics['loss']):.5f}")
+        return state, last
