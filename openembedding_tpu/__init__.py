@@ -26,4 +26,5 @@ from .hash_table import HashTableState, create_hash_table
 from .optim.optimizers import make_optimizer, SparseOptimizer
 from .optim.initializers import make_initializer, Initializer
 from .embedding import EmbeddingSpec, EmbeddingCollection
+from .fused import FusedMapper, make_fused_specs
 from .training import Trainer, TrainState, binary_logloss

@@ -149,23 +149,30 @@ class EmbeddingCollection:
         """
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        states = {}
-        for name, spec in self.specs.items():
-            if only is not None and name not in only:
-                continue
-            sub = jax.random.fold_in(rng, self._variable_ids[name])
-            if spec.use_hash:
-                states[name] = sh.create_sharded_hash_table(
-                    spec.meta(), self._optimizers[name],
-                    mesh=self.mesh,
-                    spec=self._shardings[name], rng=sub,
-                    key_dtype=jnp.dtype(spec.key_dtype))
-            else:
-                states[name] = st.create_sharded_table(
-                    spec.meta(), self._optimizers[name],
-                    self._initializers[name], mesh=self.mesh,
-                    spec=self._shardings[name], rng=sub)
-        return states
+
+        # one jitted program for ALL variables: per-variable table creation
+        # would compile (and on a remote-compile TPU link, round-trip) one
+        # program per variable — 2F programs for an F-feature model
+        def _create_all(key):
+            states = {}
+            for name, spec in self.specs.items():
+                if only is not None and name not in only:
+                    continue
+                sub = jax.random.fold_in(key, self._variable_ids[name])
+                if spec.use_hash:
+                    states[name] = sh.create_sharded_hash_table(
+                        spec.meta(), self._optimizers[name],
+                        mesh=self.mesh,
+                        spec=self._shardings[name], rng=sub,
+                        key_dtype=jnp.dtype(spec.key_dtype))
+                else:
+                    states[name] = st.create_sharded_table(
+                        spec.meta(), self._optimizers[name],
+                        self._initializers[name], mesh=self.mesh,
+                        spec=self._shardings[name], rng=sub)
+            return states
+
+        return jax.jit(_create_all)(rng)
 
     def state_shardings(self) -> Dict[str, Any]:
         """NamedShardings for every state leaf (for jit in/out_shardings)."""
@@ -180,21 +187,25 @@ class EmbeddingCollection:
 
     # --- data plane --------------------------------------------------------
     def pull(self, states: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
-             *, batch_sharded: bool = True) -> Dict[str, jnp.ndarray]:
+             *, batch_sharded: bool = True,
+             read_only: bool = False) -> Dict[str, jnp.ndarray]:
         """Lookup rows for every (present) input column.
 
         ``inputs``: name -> integer indices of any shape; returns name ->
         rows shaped ``indices.shape + (dim,)``. Differentiation happens with
         respect to the *returned rows* (pass their grads to
         :meth:`apply_gradients`), not the tables — mirroring the reference's
-        custom PullWeights gradient (exb.py:89-97).
+        custom PullWeights gradient (exb.py:89-97). ``read_only`` selects the
+        serving contract: unknown hash keys return zeros instead of init rows
+        (reference EmbeddingPullOperator read_only get_weights path).
         """
         rows = {}
         for name, idx in inputs.items():
             spec = self.specs[name]
             if spec.use_hash:
                 rows[name] = sh.pull_sharded(
-                    states[name], idx, self._initializers[name],
+                    states[name], idx,
+                    None if read_only else self._initializers[name],
                     mesh=self.mesh, spec=self._shardings[name],
                     batch_sharded=batch_sharded)
             else:

@@ -257,14 +257,21 @@ def pull(state: HashTableState, indices: jnp.ndarray,
     freshly initialized row, EmbeddingOptimizerVariable.h:242-266) without
     mutation: the same init row materializes again at insert time. Keys equal
     to the EMPTY sentinel return zeros.
+
+    ``initializer=None`` selects the **read-only** (serving) contract:
+    missing keys return zero rows with no init math — the reference's
+    read_only get_weights path (EmbeddingPullOperator.cpp:179-181).
     """
-    initializer = make_initializer(initializer)
     flat = check_key_dtype(state.keys, indices.ravel())
     slot = find_rows(state.keys, flat, max_probes)
     hit = slot >= 0
     rows = jnp.take(state.weights, jnp.where(hit, slot, 0), axis=0, mode="clip")
-    fresh = init_rows(initializer, state.init_rng, flat, state.dim,
-                      state.weights.dtype)
+    if initializer is None:
+        fresh = jnp.zeros_like(rows)
+    else:
+        initializer = make_initializer(initializer)
+        fresh = init_rows(initializer, state.init_rng, flat, state.dim,
+                          state.weights.dtype)
     rows = jnp.where(hit[:, None], rows, fresh)
     invalid = flat == empty_key(state.keys.dtype)
     rows = jnp.where(invalid[:, None], jnp.zeros_like(rows), rows)

@@ -69,15 +69,28 @@ def make_feature_specs(feature_names: Sequence[str],
     return tuple(specs)
 
 
+FUSED_NAME = "fields"
+
+
 def _stack_fields(rows: Dict[str, jnp.ndarray],
                   names: Sequence[str]) -> jnp.ndarray:
-    """[B, F, dim] field-major stack of per-feature rows."""
+    """[B, F, dim] field-major embedding block.
+
+    Accepts either the per-feature layout (one [B, dim] entry per name —
+    reference-style one variable per Embedding layer) or the fused layout
+    (a single [B, F, dim] entry under ``FUSED_NAME`` from ``fused.py``).
+    """
+    if FUSED_NAME in rows:
+        return rows[FUSED_NAME]
     return jnp.stack([rows[n] for n in names], axis=1)
 
 
 def _linear_term(rows: Dict[str, jnp.ndarray],
                  names: Sequence[str]) -> jnp.ndarray:
     """Sum of first-order (dim-1) embeddings -> [B]."""
+    fused = FUSED_NAME + LINEAR_SUFFIX
+    if fused in rows:
+        return jnp.sum(rows[fused], axis=(-2, -1))
     lin = jnp.concatenate([rows[n + LINEAR_SUFFIX] for n in names], axis=-1)
     return jnp.sum(lin, axis=-1)
 

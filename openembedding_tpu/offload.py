@@ -1,0 +1,228 @@
+"""Host-offloaded embedding tier: tables bigger than HBM, cached on device.
+
+TPU-native redesign of the reference's Persistent-Memory tier (SURVEY §2.6
+PMem rows; /root/reference/openembedding/variable/PmemEmbeddingTable.h,
+PmemEmbeddingItemPool.h, PmemEmbeddingOptimizerVariable.h — the ICDE'23
+design): bulk rows live in cheap/slow storage (there: Optane PMem; here:
+host DRAM), a bounded fast cache holds the working set (there: DRAM LRU
+cache; here: an HBM open-addressing table), and checkpoints are
+**incremental** via a per-row work_id watermark.
+
+Protocol mapping:
+
+* ``prepare(ids)``  ≈ the PMem pull's pre-touch (PmemEmbeddingOptimizer-
+  Variable.h:93-122): host gathers rows absent from the device cache and
+  inserts them (weights + optimizer state) before the step.
+* ``pull`` / ``apply_gradients`` run entirely against the HBM cache — the
+  hot path touches no host memory, like the reference's cache-hit path.
+* ``flush()``       ≈ LRU eviction + pmem_flush (PmemEmbeddingTable.h:
+  237-270): live cache rows are written back to host and stamped with the
+  current ``work_id``; the cache is cleared (state returns on next prepare).
+* ``next_work()``   ≈ per-update-batch work_id advance (:285-295).
+* ``should_persist`` ≈ the reference's signal that a checkpoint is cheap/
+  due (PmemEmbeddingOptimizerVariable.h:84-86): here, cache occupancy
+  crossing a threshold or a full persist_pending_window of batches.
+* ``persist(dir)``  ≈ lightweight incremental checkpoint: first persist
+  writes a base file; later persists write only rows with
+  ``work_id > last persisted watermark`` (the checkpoint-commit protocol of
+  PmemEmbeddingTable.h:297-328 without the transactional pool, since host
+  DRAM + files replace libpmemobj).
+* ``restore(dir)``  ≈ load_pmem_pool (:191-201): base + increments replayed
+  newest-wins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .meta import EmbeddingVariableMeta
+from .optim.initializers import make_initializer
+from .optim.optimizers import make_optimizer
+from . import hash_table as hash_lib
+from . import table as table_lib
+
+OFFLOAD_META_FILE = "offload_meta"
+
+
+class HostOffloadedTable:
+    """One embedding variable: host-resident rows + HBM hash cache.
+
+    Single-program (replicated) device cache; the sharded variant composes
+    this with the mesh exactly like sharded_hash does for plain hash tables.
+    """
+
+    def __init__(self, meta: EmbeddingVariableMeta, optimizer: Any,
+                 initializer: Any = None, *,
+                 vocab: int,
+                 cache_capacity: int,
+                 persist_pending_window: int = 64,
+                 occupancy_threshold: float = 0.7,
+                 seed: int = 0):
+        self.meta = meta
+        self.optimizer = make_optimizer(optimizer)
+        self.initializer = make_initializer(
+            initializer or table_lib.DEFAULT_INITIALIZER)
+        self.vocab = int(vocab)
+        self.cache_capacity = int(cache_capacity)
+        self.persist_pending_window = persist_pending_window
+        self.occupancy_threshold = occupancy_threshold
+        dim = meta.embedding_dim
+        dtype = np.dtype(table_lib.resolve_dtype(meta))
+
+        # host store, eagerly initialized (the array-table contract)
+        rng = jax.random.PRNGKey(seed)
+        # .copy(): np.asarray over a jax buffer is a read-only view
+        self.host_weights = np.asarray(
+            self.initializer.init(rng, (self.vocab, dim), dtype)).copy()
+        self.host_slots: Dict[str, np.ndarray] = {}
+        for sname, sshape in self.optimizer.slot_shapes(dim).items():
+            sdtype = np.dtype(self.optimizer.slot_dtype(sname, dtype))
+            self.host_slots[sname] = np.full(
+                (self.vocab,) + sshape, self.optimizer.slot_init(sname),
+                dtype=sdtype)
+        self.host_work_id = np.zeros(self.vocab, np.int64)
+
+        self.work_id = 1            # current update-batch watermark
+        self.persisted_work = 0     # highest watermark on disk
+        self._batches_since_persist = 0
+        self.cache = hash_lib.create_hash_table(
+            meta, self.optimizer, capacity=self.cache_capacity,
+            rng=jax.random.fold_in(rng, 1))
+
+    # --- cache management ---------------------------------------------------
+    def _cached_mask(self, ids: np.ndarray) -> np.ndarray:
+        slots = hash_lib.find_rows(self.cache.keys, jnp.asarray(ids))
+        return np.asarray(slots) >= 0
+
+    def prepare(self, ids) -> None:
+        """Ensure all (unique) batch ids are cache-resident (the pre-touch).
+
+        Flushes first if the incoming rows would overflow the probe window's
+        comfortable load factor.
+        """
+        ids = np.unique(np.asarray(ids).ravel())
+        ids = ids[(ids >= 0) & (ids < self.vocab)]
+        missing = ids[~self._cached_mask(ids)]
+        used = int(self.cache.num_used())
+        if used + missing.size > self.occupancy_threshold * self.cache_capacity:
+            self.flush()
+            missing = ids  # cache is empty now; re-insert the whole batch
+        if missing.size == 0:
+            return
+        rows = self.host_weights[missing]
+        srows = {k: v[missing] for k, v in self.host_slots.items()}
+        self.cache = hash_lib.insert_rows(
+            self.cache, jnp.asarray(missing), jnp.asarray(rows),
+            {k: jnp.asarray(v) for k, v in srows.items()})
+        if int(self.cache.insert_failures) > 0:
+            raise RuntimeError(
+                "HBM cache insert overflow — cache_capacity too small for "
+                "one batch's working set")
+
+    def pull(self, ids) -> jnp.ndarray:
+        """Cache-resident lookup (call prepare(ids) first)."""
+        return hash_lib.pull(self.cache, jnp.asarray(ids), None)
+
+    def apply_gradients(self, ids, grads) -> None:
+        """Cache-resident update; advances the work counter."""
+        self.cache = hash_lib.apply_gradients(
+            self.cache, self.optimizer, self.initializer,
+            jnp.asarray(ids), grads)
+        self.next_work()
+
+    def next_work(self) -> None:
+        self.work_id += 1
+        self._batches_since_persist += 1
+
+    # --- writeback / persistence -------------------------------------------
+    def flush(self) -> int:
+        """Write all live cache rows back to host, stamped with work_id."""
+        keys = np.asarray(jax.device_get(self.cache.keys))
+        live = keys != hash_lib.empty_key(keys.dtype)
+        ids = keys[live]
+        if ids.size:
+            weights = np.asarray(jax.device_get(self.cache.weights))[live]
+            self.host_weights[ids] = weights
+            for sname, sval in self.cache.slots.items():
+                self.host_slots[sname][ids] = np.asarray(
+                    jax.device_get(sval))[live]
+            self.host_work_id[ids] = self.work_id
+        self.clear_cache()
+        return int(ids.size)
+
+    def clear_cache(self) -> None:
+        """Drop all cache rows WITHOUT writeback (restore path)."""
+        self.cache = self.cache.replace(
+            keys=jnp.full_like(
+                self.cache.keys,
+                hash_lib.empty_key(np.dtype(self.cache.keys.dtype))),
+            insert_failures=jnp.zeros((), jnp.int32))
+
+    @property
+    def should_persist(self) -> bool:
+        """Cheap-checkpoint signal (reference exb_should_persist)."""
+        used = int(self.cache.num_used())
+        return (self._batches_since_persist >= self.persist_pending_window
+                or used >= self.occupancy_threshold * self.cache_capacity)
+
+    def persist(self, path: str) -> Dict[str, Any]:
+        """Incremental checkpoint: base on first call, deltas afterwards."""
+        os.makedirs(path, exist_ok=True)
+        self.flush()
+        meta_path = os.path.join(path, OFFLOAD_META_FILE)
+        chain = []
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                chain = json.load(f)["checkpoints"]
+        if not chain:
+            fname = f"base_{self.work_id}.npz"
+            np.savez(os.path.join(path, fname),
+                     ids=np.arange(self.vocab, dtype=np.int64),
+                     weights=self.host_weights,
+                     work_id=self.host_work_id,
+                     **{f"slot_{k}": v for k, v in self.host_slots.items()})
+            changed = self.vocab
+        else:
+            dirty = self.host_work_id > self.persisted_work
+            ids = np.nonzero(dirty)[0].astype(np.int64)
+            fname = f"inc_{self.work_id}.npz"
+            np.savez(os.path.join(path, fname),
+                     ids=ids,
+                     weights=self.host_weights[ids],
+                     work_id=self.host_work_id[ids],
+                     **{f"slot_{k}": v[ids]
+                        for k, v in self.host_slots.items()})
+            changed = int(ids.size)
+        chain.append({"file": fname, "work_id": self.work_id})
+        with open(meta_path, "w") as f:
+            json.dump({"checkpoints": chain, "vocab": self.vocab,
+                       "meta": self.meta.to_json()}, f)
+        self.persisted_work = self.work_id
+        self._batches_since_persist = 0
+        return {"file": fname, "rows": changed}
+
+    def restore(self, path: str) -> None:
+        """Replay base + increments (newest wins by construction)."""
+        with open(os.path.join(path, OFFLOAD_META_FILE)) as f:
+            meta = json.load(f)
+        if int(meta["vocab"]) != self.vocab:
+            raise ValueError(f"offload checkpoint vocab {meta['vocab']} != "
+                             f"table vocab {self.vocab}")
+        max_work = self.work_id
+        for entry in meta["checkpoints"]:
+            data = np.load(os.path.join(path, entry["file"]))
+            ids = data["ids"]
+            self.host_weights[ids] = data["weights"]
+            for sname in self.host_slots:
+                self.host_slots[sname][ids] = data[f"slot_{sname}"]
+            self.host_work_id[ids] = data["work_id"]
+            max_work = max(max_work, int(entry["work_id"]))
+        self.work_id = max_work + 1
+        self.persisted_work = max_work
+        self.clear_cache()  # stale pre-restore rows must not write back

@@ -163,11 +163,13 @@ def pull_sharded(state: hash_lib.HashTableState,
     """Distributed hash lookup: each shard resolves its owned keys, psum joins.
 
     Missing-but-valid keys get their deterministic init row (computed only by
-    the owner shard); EMPTY-sentinel keys return zero rows.
+    the owner shard); EMPTY-sentinel keys return zero rows. ``initializer=
+    None`` = read-only serving contract (missing keys -> zeros).
     """
     dim = state.weights.shape[-1]
     batch_spec = P(spec.data_axis) if batch_sharded else P()
-    initializer = make_initializer(initializer)
+    if initializer is not None:
+        initializer = make_initializer(initializer)
 
     def _pull(keys, weights, init_rng, idx):
         local = hash_lib.HashTableState(

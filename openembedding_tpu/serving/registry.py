@@ -1,0 +1,168 @@
+"""Serving-side model registry: load checkpoints, serve read-only lookups.
+
+Capability parity with the reference's serving plane (SURVEY §3.5):
+
+* ``ModelRegistry`` ≈ ModelManager + ModelController state
+  (/root/reference/openembedding/client/ModelController.cpp): models are
+  keyed by ``model_sign`` ("<uuid>-<version>", reference py_api.cc:130-138),
+  carry CREATING/NORMAL/DELETING/ERROR status, loads run async (CREATING
+  visible during load like the master-tree status), lookups against a
+  CREATING/DELETING model are rejected (ModelController.cpp:24-44).
+* ``ServingModel.lookup`` ≈ the read-only pull handler — no side effects:
+  unknown hash keys return zero rows (EmbeddingPullOperator.cpp:179-181).
+* Replicas: the reference replicates shards across PS nodes (replica_num=3
+  default) and picks one per pull. One SPMD serving process holds exactly one
+  copy of each table in HBM; HA is processes × load balancer, so
+  ``replica_num`` here is metadata recorded for the deployment layer (each
+  extra serving process IS a replica). Dead-process recovery = reload from
+  the checkpoint URI, which ``load_model`` does from scratch — the
+  restore-from-dump path of EmbeddingRestoreOperator.cpp:108-152.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..embedding import EmbeddingCollection, EmbeddingSpec
+from ..meta import ModelMeta, ModelStatus, UNBOUNDED_VOCAB
+from .. import checkpoint as ckpt_lib
+
+
+class ServingModel:
+    """One loaded model: collection + read-only states."""
+
+    def __init__(self, sign: str, collection: EmbeddingCollection,
+                 states: Dict[str, Any], meta: ModelMeta):
+        self.sign = sign
+        self.collection = collection
+        self.states = states
+        self.meta = meta
+        self._by_id = {collection.variable_id(name): name
+                       for name in collection.specs}
+
+    def variable_name(self, variable_id: int) -> str:
+        return self._by_id[variable_id]
+
+    def lookup(self, variable: Any, indices) -> jnp.ndarray:
+        """Read-only pull for one variable (by name or variable_id)."""
+        name = (variable if isinstance(variable, str)
+                else self._by_id[int(variable)])
+        idx = jnp.asarray(indices)
+        rows = self.collection.pull(self.states, {name: idx},
+                                    batch_sharded=False, read_only=True)
+        return rows[name]
+
+
+def _specs_from_meta(meta: ModelMeta, hash_capacity: int
+                     ) -> List[EmbeddingSpec]:
+    """Rebuild EmbeddingSpecs from a checkpoint's model_meta — the serving
+    process needs no model code, just the dump (like TF-Serving + the
+    reference's SavedModel + <dir>/openembedding sidecar)."""
+    specs = []
+    for v in sorted(meta.variables, key=lambda v: v.variable_id):
+        hash_var = v.meta.vocabulary_size >= UNBOUNDED_VOCAB
+        specs.append(EmbeddingSpec(
+            name=v.name, input_dim=-1 if hash_var else v.meta.vocabulary_size,
+            output_dim=v.meta.embedding_dim, dtype=v.meta.datatype,
+            hash_capacity=hash_capacity))
+    return specs
+
+
+class ModelRegistry:
+    """All models served by this process, with lifecycle management."""
+
+    def __init__(self, mesh, *, default_hash_capacity: int = 2**20):
+        self.mesh = mesh
+        self.default_hash_capacity = default_hash_capacity
+        self._lock = threading.Lock()
+        self._models: Dict[str, ServingModel] = {}
+        self._status: Dict[str, Dict[str, Any]] = {}
+
+    # --- lifecycle (ModelController.create/delete/show equivalents) -------
+    def create_model(self, model_uri: str, *, model_sign: Optional[str] = None,
+                     replica_num: int = 3, num_shards: int = -1,
+                     block: bool = True) -> str:
+        """Load a checkpoint for serving; returns the model_sign.
+
+        Async when ``block=False``: status is CREATING until the load thread
+        finishes (reference ModelController.cpp:47-85 thread-group load).
+        """
+        with open(f"{model_uri}/{ckpt_lib.MODEL_META_FILE}") as f:
+            meta = ModelMeta.loads(f.read())
+        sign = model_sign or meta.model_sign or model_uri
+        with self._lock:
+            if sign in self._status and \
+                    self._status[sign]["model_status"] == ModelStatus.CREATING:
+                raise ValueError(f"model {sign!r} is already being created")
+            self._status[sign] = {
+                "model_sign": sign, "model_uri": model_uri,
+                "model_status": ModelStatus.CREATING, "model_error": "",
+                "replica_num": replica_num,
+            }
+
+        def _load():
+            try:
+                specs = _specs_from_meta(meta, self.default_hash_capacity)
+                coll = EmbeddingCollection(specs, self.mesh)
+                states = ckpt_lib.load_checkpoint(model_uri, coll)
+                model = ServingModel(sign, coll, states, meta)
+                with self._lock:
+                    self._models[sign] = model
+                    self._status[sign]["model_status"] = ModelStatus.NORMAL
+            except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+                with self._lock:
+                    self._status[sign]["model_status"] = ModelStatus.ERROR
+                    self._status[sign]["model_error"] = (
+                        f"{e}\n{traceback.format_exc()}")
+
+        if block:
+            _load()
+            err = self._status[sign]
+            if err["model_status"] == ModelStatus.ERROR:
+                raise RuntimeError(err["model_error"])
+        else:
+            threading.Thread(target=_load, daemon=True).start()
+        return sign
+
+    def delete_model(self, sign: str) -> None:
+        with self._lock:
+            if sign not in self._status:
+                raise KeyError(sign)
+            self._status[sign]["model_status"] = ModelStatus.DELETING
+            self._models.pop(sign, None)
+            del self._status[sign]
+
+    def find_model(self, sign: str) -> ServingModel:
+        """NORMAL-status model or error — the find_model_variable gate
+        (ModelController.cpp:24-44 rejects CREATING)."""
+        with self._lock:
+            st = self._status.get(sign)
+            if st is None:
+                raise KeyError(f"unknown model {sign!r}")
+            if st["model_status"] != ModelStatus.NORMAL:
+                raise RuntimeError(
+                    f"model {sign!r} is {st['model_status']}: "
+                    f"{st.get('model_error', '')}")
+            return self._models[sign]
+
+    def show_model(self, sign: str) -> Dict[str, Any]:
+        with self._lock:
+            if sign not in self._status:
+                raise KeyError(sign)
+            return dict(self._status[sign])
+
+    def show_models(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(v) for v in self._status.values()]
+
+    # --- nodes (show_node/shutdown_node analogues over jax devices) --------
+    def show_nodes(self) -> List[Dict[str, Any]]:
+        import jax
+        return [{"node_id": d.id, "platform": d.platform,
+                 "kind": getattr(d, "device_kind", "")}
+                for d in self.mesh.devices.flatten()]

@@ -1,0 +1,143 @@
+"""REST controller for the serving registry.
+
+HTTP surface parity with the reference's controller daemon
+(/root/reference/openembedding/entry/controller.cc:100-204, default port
+8010):
+
+* ``POST /models {"model_uri", "replica_num"=3, "num_shards"=-1}`` -> 201 +
+  Location header (controller.cc:107-121)
+* ``GET /models`` / ``GET /models/<sign>`` -> status JSON
+* ``DELETE /models/<sign>``
+* ``GET /nodes`` / ``GET /nodes/<id>`` -> device info (the reference's PS
+  node listing); ``DELETE /nodes/<id>`` is intentionally a 501 — one SPMD
+  serving process has no per-node shutdown; kill the process (documented
+  divergence).
+* extra (TPU build): ``POST /models/<sign>/lookup {"variable", "indices"}``
+  -> rows; the reference serves lookups through TF-Serving custom ops
+  instead, which have no HTTP equivalent to mirror.
+
+stdlib http.server — a thin control plane, not a data-plane server; the
+data plane is in-process jitted XLA (ServingModel.lookup).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .registry import ModelRegistry
+
+DEFAULT_PORT = 8010
+
+
+def make_handler(registry: ModelRegistry):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet test output
+            pass
+
+        def _send(self, code: int, obj=None, location: str = None):
+            body = json.dumps(obj).encode() if obj is not None else b""
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if location:
+                self.send_header("Location", location)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        def do_GET(self):
+            try:
+                if self.path == "/models":
+                    return self._send(200, registry.show_models())
+                m = re.fullmatch(r"/models/([^/]+)", self.path)
+                if m:
+                    return self._send(200, registry.show_model(m.group(1)))
+                if self.path == "/nodes":
+                    return self._send(200, registry.show_nodes())
+                m = re.fullmatch(r"/nodes/(\d+)", self.path)
+                if m:
+                    nodes = [n for n in registry.show_nodes()
+                             if n["node_id"] == int(m.group(1))]
+                    if not nodes:
+                        return self._send(404, {"error": "no such node"})
+                    return self._send(200, nodes[0])
+                self._send(404, {"error": "not found"})
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": str(e)})
+
+        def do_POST(self):
+            try:
+                if self.path == "/models":
+                    req = self._body()
+                    sign = registry.create_model(
+                        req["model_uri"],
+                        model_sign=req.get("model_sign"),
+                        replica_num=int(req.get("replica_num", 3)),
+                        num_shards=int(req.get("num_shards", -1)),
+                        block=bool(req.get("block", False)))
+                    return self._send(201, {"model_sign": sign},
+                                      location=f"/models/{sign}")
+                m = re.fullmatch(r"/models/([^/]+)/lookup", self.path)
+                if m:
+                    req = self._body()
+                    model = registry.find_model(m.group(1))
+                    rows = model.lookup(
+                        req["variable"],
+                        np.asarray(req["indices"], dtype=np.int64
+                                   if req.get("int64") else np.int32))
+                    return self._send(200, {"rows": np.asarray(rows).tolist()})
+                self._send(404, {"error": "not found"})
+            except (KeyError, ValueError) as e:
+                self._send(400, {"error": str(e)})
+            except RuntimeError as e:
+                self._send(409, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": str(e)})
+
+        def do_DELETE(self):
+            try:
+                m = re.fullmatch(r"/models/([^/]+)", self.path)
+                if m:
+                    registry.delete_model(m.group(1))
+                    return self._send(200, {"deleted": m.group(1)})
+                if re.fullmatch(r"/nodes/\d+", self.path):
+                    return self._send(501, {
+                        "error": "single SPMD serving process has no "
+                                 "per-node shutdown; stop the process"})
+                self._send(404, {"error": "not found"})
+            except KeyError as e:
+                self._send(404, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": str(e)})
+
+    return Handler
+
+
+class ControllerServer:
+    """Threaded HTTP controller (the masterd+controller daemon analogue)."""
+
+    def __init__(self, registry: ModelRegistry, port: int = DEFAULT_PORT,
+                 host: str = "127.0.0.1"):
+        self.httpd = ThreadingHTTPServer((host, port),
+                                         make_handler(registry))
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -76,8 +76,13 @@ class Trainer:
         """Initialize dense params (replicated) + all embedding tables."""
         emb_rng, dense_rng = jax.random.split(rng)
         emb = self.collection.init(emb_rng)
-        rows = self.collection.pull(emb, sample_batch["sparse"],
-                                    batch_sharded=False)
+        # dense init only needs row SHAPES — zeros via eval_shape avoid
+        # dispatching one pull program per variable before training starts
+        row_shapes = jax.eval_shape(
+            lambda e, s: self.collection.pull(e, s, batch_sharded=False),
+            emb, sample_batch["sparse"])
+        rows = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                            row_shapes)
         variables = self.module.init(dense_rng, sample_batch.get("dense"), rows)
         params = variables["params"]
         set_repl = partial(jax.device_put, device=self._replicated)

@@ -1,0 +1,178 @@
+"""Observability: scoped timers, distributed-counter analogues, reporter.
+
+Capability parity with the reference's tracing/metrics plane (SURVEY §5.1,
+§5.5): ``VTIMER`` scoped timers on operator stages, ``Accumulator`` counters
+(pull_indices / pull_unique) gated by a performance-evaluation flag, and the
+rank-0 periodic reporter thread (WorkerContext.cpp:24-41,140-163).
+
+TPU-native shape: one process drives the SPMD program, so "distributed
+accumulators" collapse to process-local counters — the cross-device sums the
+reference's AccumulatorServer did are already performed by XLA collectives
+inside the step. Counters are therefore cheap host-side atomics; per-batch
+device stats (batch uniqueness, the quantity the reference measures with
+pull_indices/pull_unique and laboratory/benchmark/analyze.py) are computed
+host-side on the index arrays when evaluation is enabled.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+_EVALUATE_PERFORMANCE = False
+
+
+def set_evaluate_performance(on: bool) -> None:
+    """Global gate like the reference's pico_is_evaluate_performance()."""
+    global _EVALUATE_PERFORMANCE
+    _EVALUATE_PERFORMANCE = bool(on)
+
+
+def evaluate_performance() -> bool:
+    return _EVALUATE_PERFORMANCE
+
+
+class Accumulator:
+    """Named monotonic counters + timing sums (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, float] = collections.defaultdict(float)
+        self._times: Dict[str, float] = collections.defaultdict(float)
+        self._calls: Dict[str, int] = collections.defaultdict(int)
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counts[name] += value
+
+    def add_time(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._times[name] += seconds
+            self._calls[name] += 1
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            out = {name: {"count": v} for name, v in self._counts.items()}
+            for name, t in self._times.items():
+                out.setdefault(name, {})["seconds"] = t
+                out[name]["calls"] = self._calls[name]
+                if self._calls[name]:
+                    out[name]["avg_ms"] = 1000.0 * t / self._calls[name]
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._times.clear()
+            self._calls.clear()
+
+
+# process-global default, like the reference's Accumulator client singleton
+GLOBAL = Accumulator()
+
+
+@contextlib.contextmanager
+def vtimer(name: str, accumulator: Optional[Accumulator] = None):
+    """Scoped timer (VTIMER equivalent). No-op-cheap when not reporting."""
+    acc = accumulator or GLOBAL
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        acc.add_time(name, time.perf_counter() - t0)
+
+
+def record_batch_stats(sparse: Dict[str, np.ndarray],
+                       accumulator: Optional[Accumulator] = None) -> None:
+    """pull_indices / pull_unique counters for one batch (host-side).
+
+    Gated by set_evaluate_performance like the reference
+    (EmbeddingPullOperator.cpp:208-209,244-248) — measuring uniqueness costs
+    a host np.unique per column, so it's off by default.
+    """
+    if not _EVALUATE_PERFORMANCE:
+        return
+    acc = accumulator or GLOBAL
+    for name, idx in sparse.items():
+        arr = np.asarray(idx).ravel()
+        acc.add("pull_indices", arr.size)
+        acc.add("pull_unique", np.unique(arr).size)
+
+
+class Reporter:
+    """Rank-0 periodic metrics printer (WorkerContext reporter thread).
+
+    ``report_interval`` seconds between dumps; 0 disables (the reference's
+    server.report_interval default semantics)."""
+
+    def __init__(self, interval: float,
+                 accumulator: Optional[Accumulator] = None,
+                 sink: Callable[[str], None] = print):
+        self.interval = interval
+        self.acc = accumulator or GLOBAL
+        self.sink = sink
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Reporter":
+        if self.interval and self.interval > 0:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self.report()
+
+    def report(self):
+        snap = self.acc.snapshot()
+        if snap:
+            parts = []
+            for name in sorted(snap):
+                fields = ", ".join(f"{k}={v:.6g}"
+                                   for k, v in sorted(snap[name].items()))
+                parts.append(f"{name}[{fields}]")
+            self.sink("metrics: " + " ".join(parts))
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class StreamingAUC:
+    """Fixed-bin streaming AUC — device-friendly histogram method.
+
+    The reference reports AUC through keras metrics; here scores are binned
+    into ``bins`` buckets per update and AUC is computed from the positive /
+    negative histograms (exact up to bin resolution, O(1) memory for
+    arbitrarily long evaluation streams).
+    """
+
+    def __init__(self, bins: int = 8192):
+        self.bins = bins
+        self.pos = np.zeros(bins, np.int64)
+        self.neg = np.zeros(bins, np.int64)
+
+    def update(self, labels, scores) -> None:
+        labels = np.asarray(labels).ravel()
+        scores = np.clip(np.asarray(scores, np.float64).ravel(), 0.0, 1.0)
+        idx = np.minimum((scores * self.bins).astype(np.int64), self.bins - 1)
+        self.pos += np.bincount(idx[labels > 0.5], minlength=self.bins)
+        self.neg += np.bincount(idx[labels <= 0.5], minlength=self.bins)
+
+    def result(self) -> float:
+        """P(score_pos > score_neg) + 0.5 P(tie), from the histograms."""
+        total_pos = self.pos.sum()
+        total_neg = self.neg.sum()
+        if total_pos == 0 or total_neg == 0:
+            return 0.5
+        neg_below = np.concatenate([[0], np.cumsum(self.neg)[:-1]])
+        wins = float(np.sum(self.pos * neg_below))
+        ties = float(np.sum(self.pos * self.neg))
+        return (wins + 0.5 * ties) / (float(total_pos) * float(total_neg))

@@ -1,0 +1,143 @@
+"""Fused feature groups: id mapping correctness, semantic parity with
+per-feature variables, end-to-end training on a mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from openembedding_tpu import (EmbeddingCollection, Trainer, make_fused_specs)
+from openembedding_tpu.fused import FusedMapper
+from openembedding_tpu.models import deepctr
+from openembedding_tpu.parallel.mesh import create_mesh
+
+FEATURES = ("c0", "c1", "c2")
+
+
+def test_mapper_bounded_offsets():
+    m = FusedMapper(FEATURES, (10, 20, 30))
+    assert m.offsets.tolist() == [0, 10, 30]
+    assert m.total_vocab == 60
+    sparse = {"c0": np.array([0, 9]), "c1": np.array([0, 19]),
+              "c2": np.array([0, 29])}
+    fused = m.fuse(sparse)["fields"]
+    np.testing.assert_array_equal(fused, [[0, 10, 30], [9, 29, 59]])
+    # out-of-range ids become -1 (invalid) instead of bleeding into the
+    # next feature's row range
+    bad = m.fuse({"c0": np.array([10]), "c1": np.array([-1]),
+                  "c2": np.array([5])})["fields"]
+    np.testing.assert_array_equal(bad, [[-1, -1, 35]])
+
+
+def test_mapper_hash_disjoint():
+    m = FusedMapper(FEATURES, (-1, -1, -1))
+    assert m.use_hash
+    sparse = {f: np.array([123, 456], dtype=np.int32) for f in FEATURES}
+    fused = m.fuse(sparse)["fields"]
+    # same raw key in different features maps to distinct fused keys
+    assert len(set(fused[0].tolist())) == 3
+
+
+def test_mixed_hash_bounded_rejected():
+    with pytest.raises(ValueError, match="fuse"):
+        make_fused_specs(FEATURES, [10, -1, 30], 4)
+
+
+def test_fused_parity_with_per_feature(devices8):
+    """Same ids, same optimizer: fused pull/apply must behave exactly like
+    per-feature variables modulo initialization (constant init => exact)."""
+    mesh = create_mesh(1, 8, devices8)
+    vocabs = (40, 56, 24)
+    init = {"category": "constant", "value": 0.5}
+    opt = {"category": "adagrad", "learning_rate": 0.1}
+
+    fspecs, mapper = make_fused_specs(FEATURES, list(vocabs), 4,
+                                      need_linear=False, optimizer=opt,
+                                      initializer=init)
+    fcoll = EmbeddingCollection(fspecs, mesh)
+    fstates = fcoll.init()
+
+    pspecs = deepctr.make_feature_specs(FEATURES, list(vocabs), 4,
+                                        need_linear=False, optimizer=opt,
+                                        initializer=init)
+    pcoll = EmbeddingCollection(pspecs, mesh)
+    pstates = pcoll.init()
+
+    rng = np.random.RandomState(0)
+    for step in range(3):
+        sparse = {f: rng.randint(0, v, 16).astype(np.int32)
+                  for f, v in zip(FEATURES, vocabs)}
+        fused_in = mapper.fuse(sparse)
+        frows = fcoll.pull(fstates, fused_in, batch_sharded=False)["fields"]
+        prows = pcoll.pull(pstates, sparse, batch_sharded=False)
+        for j, f in enumerate(FEATURES):
+            np.testing.assert_allclose(np.asarray(frows[:, j]),
+                                       np.asarray(prows[f]),
+                                       rtol=1e-6, atol=1e-7)
+        g = rng.randn(16, len(FEATURES), 4).astype(np.float32)
+        fstates = fcoll.apply_gradients(
+            fstates, fused_in, {"fields": jnp.asarray(g)},
+            batch_sharded=False)
+        pstates = pcoll.apply_gradients(
+            pstates, sparse, {f: jnp.asarray(g[:, j])
+                              for j, f in enumerate(FEATURES)},
+            batch_sharded=False)
+
+
+def test_fused_training_end_to_end(devices8):
+    mesh = create_mesh(2, 4, devices8)
+    specs, mapper = make_fused_specs(
+        FEATURES, 100, 8,
+        optimizer={"category": "adagrad", "learning_rate": 0.1})
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", FEATURES), coll,
+                      optax.adam(1e-2))
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            raw = {f: rng.randint(0, 100, 16).astype(np.int32)
+                   for f in FEATURES}
+            label = ((raw["c0"] + raw["c1"]) % 2).astype(np.float32)
+            yield mapper.fuse_batch({
+                "label": label,
+                "dense": rng.randn(16, 4).astype(np.float32),
+                "sparse": raw})
+
+    bs = list(batches(30))
+    state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(bs[0]))
+    losses = []
+    for b in bs:
+        state, m = trainer.train_step(state, b)
+        losses.append(float(m["loss"]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_fused_hash_training(devices8):
+    mesh = create_mesh(2, 4, devices8)
+    specs, mapper = make_fused_specs(
+        FEATURES, -1, 8, hash_capacity=4096,
+        optimizer={"category": "adagrad", "learning_rate": 0.1})
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("wdl", FEATURES), coll,
+                      optax.adam(1e-2))
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            raw = {f: rng.randint(0, 10**6, 16).astype(np.int32)
+                   for f in FEATURES}
+            label = (rng.rand(16) > 0.5).astype(np.float32)
+            yield mapper.fuse_batch({
+                "label": label,
+                "dense": None,
+                "sparse": raw})
+
+    bs = list(batches(10))
+    state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(bs[0]))
+    for b in bs:
+        state, m = trainer.train_step(state, b)
+    assert np.isfinite(float(m["loss"]))
+    assert int(state.emb["fields"].insert_failures) == 0

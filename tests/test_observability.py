@@ -1,0 +1,73 @@
+"""Timers, accumulators, batch stats gating, reporter, streaming AUC."""
+
+import time
+
+import numpy as np
+
+from openembedding_tpu.utils import observability as obs
+
+
+def test_accumulator_and_vtimer():
+    acc = obs.Accumulator()
+    acc.add("pulls", 5)
+    acc.add("pulls", 3)
+    with obs.vtimer("step", acc):
+        time.sleep(0.01)
+    snap = acc.snapshot()
+    assert snap["pulls"]["count"] == 8
+    assert snap["step"]["calls"] == 1
+    assert snap["step"]["seconds"] >= 0.01
+    acc.reset()
+    assert acc.snapshot() == {}
+
+
+def test_batch_stats_gated():
+    acc = obs.Accumulator()
+    sparse = {"c": np.array([1, 1, 2, 3])}
+    obs.record_batch_stats(sparse, acc)          # gate off -> no-op
+    assert acc.snapshot() == {}
+    obs.set_evaluate_performance(True)
+    try:
+        obs.record_batch_stats(sparse, acc)
+        snap = acc.snapshot()
+        assert snap["pull_indices"]["count"] == 4
+        assert snap["pull_unique"]["count"] == 3
+    finally:
+        obs.set_evaluate_performance(False)
+
+
+def test_reporter_periodic():
+    acc = obs.Accumulator()
+    acc.add("x", 1)
+    lines = []
+    rep = obs.Reporter(0.05, acc, sink=lines.append).start()
+    time.sleep(0.2)
+    rep.stop()
+    assert lines and "x[count=1]" in lines[0]
+
+
+def test_streaming_auc_exact_cases():
+    auc = obs.StreamingAUC(bins=1000)
+    # perfectly separable
+    auc.update([1, 1, 0, 0], [0.9, 0.8, 0.2, 0.1])
+    assert abs(auc.result() - 1.0) < 1e-9
+    # random scores over many updates -> ~0.5
+    auc2 = obs.StreamingAUC()
+    rng = np.random.RandomState(0)
+    for _ in range(20):
+        labels = rng.randint(0, 2, 1000)
+        auc2.update(labels, rng.rand(1000))
+    assert abs(auc2.result() - 0.5) < 0.02
+    # agreement with exact pairwise AUC on a small mixed case
+    labels = rng.randint(0, 2, 500)
+    scores = np.clip(rng.rand(500) * 0.6 + labels * 0.2, 0, 1)
+    auc3 = obs.StreamingAUC()
+    auc3.update(labels, scores)
+    pos, neg = scores[labels > 0], scores[labels <= 0]
+    exact = np.mean(pos[:, None] > neg[None, :]) \
+        + 0.5 * np.mean(pos[:, None] == neg[None, :])
+    assert abs(auc3.result() - exact) < 5e-3
+    # degenerate: single class
+    auc4 = obs.StreamingAUC()
+    auc4.update([1, 1], [0.5, 0.6])
+    assert auc4.result() == 0.5
