@@ -106,7 +106,19 @@ def main(argv=None):
     first = next(it)
     state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(first))
     if args.load:
-        state = state.replace(emb=ckpt.load_checkpoint(args.load, coll))
+        import os
+        template = {"params": state.params, "opt_state": state.opt_state,
+                    "step": state.step}
+        if os.path.exists(f"{args.load}/{ckpt.DENSE_FILE}"):
+            emb, dense = ckpt.load_checkpoint(args.load, coll,
+                                              dense_state_template=template)
+            state = state.replace(emb=emb, params=dense["params"],
+                                  opt_state=dense["opt_state"],
+                                  step=dense["step"])
+        else:
+            print("warning: checkpoint has no dense state; MLP weights stay "
+                  "freshly initialized")
+            state = state.replace(emb=ckpt.load_checkpoint(args.load, coll))
         print(f"loaded checkpoint from {args.load}")
 
     t0 = time.time()
@@ -119,9 +131,10 @@ def main(argv=None):
         n += 1
         if args.log_every and (i + 1) % args.log_every == 0:
             print(f"step {i+1}: loss={float(m['loss']):.5f}")
-    jax.block_until_ready(m["loss"])
-    dt = time.time() - t0
-    print(f"trained {n} steps, {n * args.batch_size / dt:.0f} examples/s")
+    if n:
+        jax.block_until_ready(m["loss"])
+        dt = time.time() - t0
+        print(f"trained {n} steps, {n * args.batch_size / dt:.0f} examples/s")
 
     if args.eval_steps:
         auc = StreamingAUC()

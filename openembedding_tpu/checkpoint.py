@@ -74,6 +74,17 @@ def save_checkpoint(path: str,
     os.makedirs(path, exist_ok=True)
     meta = collection.model_meta(model_sign=model_sign, model_uri=path)
     meta.extra["include_optimizer"] = bool(include_optimizer)
+    # persist hash-table geometry so a loader (e.g. the serving registry,
+    # which rebuilds specs from this meta alone) allocates tables that can
+    # hold every stored row — the reference's load path delivers every row
+    # or fails (EmbeddingLoadOperator.cpp:58-111)
+    hash_info = {
+        name: {"hash_capacity": spec.hash_capacity,
+               "key_dtype": spec.key_dtype}
+        for name, spec in collection.specs.items() if spec.use_hash
+    }
+    if hash_info:
+        meta.extra["hash_variables"] = hash_info
     with open(os.path.join(path, MODEL_META_FILE), "w") as f:
         f.write(meta.dumps())
 
@@ -155,7 +166,13 @@ def load_checkpoint(path: str,
             state = states[name]
             keys = data["keys"]
             weights = data["weights"]
-            slot_data = ({s: data[f"slot_{s}"] for s in state.slots}
+            # slots present in both the checkpoint and the current optimizer
+            # are restored; others keep their fresh init — loading into a
+            # different optimizer category keeps weights and re-initializes
+            # slots, the reference's copy_from hot-swap semantics
+            # (EmbeddingVariable.cpp:29-60)
+            slot_data = ({s: data[f"slot_{s}"] for s in state.slots
+                          if f"slot_{s}" in data}
                          if with_opt else {})
             # stream fixed-size chunks (padded with EMPTY) to keep shapes static
             empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
@@ -175,6 +192,13 @@ def load_checkpoint(path: str,
                 state = sh.insert_rows_sharded(
                     state, jnp.asarray(ck), jnp.asarray(cw), srows,
                     mesh=collection.mesh, spec=sspec)
+            failed = int(jax.device_get(state.insert_failures))
+            if failed > 0:
+                raise RuntimeError(
+                    f"hash variable {name!r}: {failed} of {n} checkpoint "
+                    f"rows did not fit (hash_capacity="
+                    f"{spec.hash_capacity}); increase hash_capacity — a "
+                    "load must deliver every row or fail")
             out[name] = state
         else:
             # assemble the physical (mod-layout) arrays host-side, padding
@@ -199,9 +223,12 @@ def load_checkpoint(path: str,
             for sname, sshape in optimizer.slot_shapes(dim).items():
                 sdtype = np.dtype(optimizer.slot_dtype(sname, dtype))
                 fill = optimizer.slot_init(sname)
-                if with_opt:
+                if with_opt and f"slot_{sname}" in data:
                     rows = data[f"slot_{sname}"]
                 else:
+                    # absent from the dump (saved without optimizer state, or
+                    # under a different optimizer category): fresh slot init,
+                    # weights kept — copy_from hot-swap semantics
                     rows = np.empty((0, *sshape), dtype=sdtype)
                 new_slots[sname] = jax.device_put(
                     _to_physical(rows, fill, sdtype), shardings.slots[sname])

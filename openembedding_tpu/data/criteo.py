@@ -35,13 +35,7 @@ DENSE_NAMES = tuple(f"I{i}" for i in range(1, NUM_DENSE + 1))
 SPARSE_NAMES = tuple(f"C{i}" for i in range(1, NUM_SPARSE + 1))
 
 
-def mix64(x: np.ndarray) -> np.ndarray:
-    """splitmix64 finalizer — deterministic int64 avalanche (the
-    to_hash_bucket_fast role, minus TF's farmhash choice)."""
-    x = x.astype(np.uint64)
-    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
-    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
-    return x ^ (x >> np.uint64(33))
+from ..utils.hashing import mix64  # noqa: E402 — re-export (public here)
 
 
 def hash_bucket(values: np.ndarray, num_buckets: int) -> np.ndarray:

@@ -72,11 +72,15 @@ class FusedMapper:
         cols = [np.asarray(sparse[f]) for f in self.feature_names]
         ids = np.stack(cols, axis=1)  # [B, F]
         if self.use_hash:
+            from .utils.hashing import mix64
             F = np.int64(self.num_features)
             fused = ids.astype(np.int64) * F + np.arange(
                 self.num_features, dtype=np.int64)[None, :]
             if ids.dtype == np.int32:
-                fused = np.bitwise_and(fused, np.int64(2**31 - 1))
+                # avalanche-mix before truncating to 31 bits: F shares a
+                # factor with 2^31, so a plain mask would alias distinct
+                # features onto the same row in a structured way
+                fused = (mix64(fused) & np.uint64(2**31 - 1)).astype(np.int64)
             fused = fused.astype(ids.dtype)
         else:
             vocab = np.asarray(self.vocab_sizes, dtype=np.int64)[None, :]

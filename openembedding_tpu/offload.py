@@ -130,10 +130,20 @@ class HostOffloadedTable:
         return hash_lib.pull(self.cache, jnp.asarray(ids), None)
 
     def apply_gradients(self, ids, grads) -> None:
-        """Cache-resident update; advances the work counter."""
+        """Cache-resident update; advances the work counter.
+
+        Ids outside [0, vocab) are masked to the EMPTY sentinel (dropped):
+        an out-of-range id written into the cache would alias or overflow a
+        valid host row at flush() time.
+        """
+        ids = jnp.asarray(ids)
+        # range-check BEFORE any dtype narrowing: a wide id must not wrap
+        # into the valid range and alias a real row
+        valid = (ids >= 0) & (ids < self.vocab)
+        ids = jnp.where(valid, ids, 0).astype(self.cache.keys.dtype)
+        ids = jnp.where(valid, ids, hash_lib.empty_key(ids.dtype))
         self.cache = hash_lib.apply_gradients(
-            self.cache, self.optimizer, self.initializer,
-            jnp.asarray(ids), grads)
+            self.cache, self.optimizer, self.initializer, ids, grads)
         self.next_work()
 
     def next_work(self) -> None:

@@ -35,7 +35,7 @@ class Initializer:
         return out
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Constant(Initializer):
     value: float = 0.0
     category = "constant"
@@ -45,7 +45,7 @@ class Constant(Initializer):
         return jnp.full(shape, self.value, dtype=dtype)
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Uniform(Initializer):
     minval: float = -1.0
     maxval: float = 1.0
@@ -57,7 +57,7 @@ class Uniform(Initializer):
                                   maxval=self.maxval).astype(dtype)
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Normal(Initializer):
     mean: float = 0.0
     stddev: float = 1.0

@@ -86,7 +86,7 @@ class SparseOptimizer:
         return out
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Default(SparseOptimizer):
     """Stateless; lr=0 (serving / frozen) or plain SGD when lr != 0."""
 
@@ -99,7 +99,7 @@ class Default(SparseOptimizer):
         return weights, slots
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Adadelta(SparseOptimizer):
     learning_rate: float = 0.001
     rho: float = 0.95
@@ -118,7 +118,7 @@ class Adadelta(SparseOptimizer):
         return weights, {"accum": accum, "accum_update": accum_update}
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Adagrad(SparseOptimizer):
     learning_rate: float = 0.001
     initial_accumulator_value: float = 0.1
@@ -138,7 +138,7 @@ class Adagrad(SparseOptimizer):
         return weights, {"accum": accum}
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Adam(SparseOptimizer):
     learning_rate: float = 0.001
     beta_1: float = 0.9
@@ -168,7 +168,7 @@ class Adam(SparseOptimizer):
         return weights, {"m": m, "v": v, "beta_1_t": beta_1_t, "beta_2_t": beta_2_t}
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Adamax(SparseOptimizer):
     learning_rate: float = 0.001
     beta_1: float = 0.9
@@ -196,7 +196,7 @@ class Adamax(SparseOptimizer):
         return weights, {"m": m, "v": v, "beta_1_t": beta_1_t}
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Ftrl(SparseOptimizer):
     learning_rate: float = 0.001
     initial_accumulator_value: float = 0.1
@@ -234,7 +234,7 @@ class Ftrl(SparseOptimizer):
         return weights, {"accum": accum_new, "linear": linear}
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class RMSprop(SparseOptimizer):
     learning_rate: float = 0.001
     rho: float = 0.9
@@ -253,7 +253,7 @@ class RMSprop(SparseOptimizer):
         return weights, {"accum": accum, "moment": moment}
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class SGD(SparseOptimizer):
     learning_rate: float = 0.01
     momentum: float = 0.0
@@ -272,7 +272,7 @@ class SGD(SparseOptimizer):
         return weights, {"moment": moment}
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class Test(SparseOptimizer):
     """Deterministic flip-state optimizer for unit tests.
 

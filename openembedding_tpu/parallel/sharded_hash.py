@@ -15,6 +15,7 @@ over the model axis reconstructs the full batch exactly once.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional
 
 import jax
@@ -110,6 +111,34 @@ def _mask_non_owned(spec: HashShardingSpec, flat: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(owned, flat, empty)
 
 
+@functools.lru_cache(maxsize=None)
+def _insert_rows_program(mesh: Mesh, spec: HashShardingSpec,
+                         slot_names: tuple, in_slot_names: tuple):
+    """Cached jitted insert program: the checkpoint loader streams many
+    same-shaped chunks, and rebuilding the shard_map closure per chunk would
+    retrace (and on a remote-compile link, round-trip) every call."""
+    m = spec.model_axis
+
+    def _insert(tkeys, tweights, tslots, init_rng, k, w, srows):
+        local = hash_lib.HashTableState(
+            keys=tkeys, weights=tweights, slots=tslots, init_rng=init_rng,
+            insert_failures=jnp.zeros((), jnp.int32))
+        masked = _mask_non_owned(spec, k.ravel())
+        new = hash_lib.insert_rows(local, masked, w, srows or None,
+                                   max_probes=spec.max_probes)
+        failed = lax.psum(new.insert_failures, spec.model_axis)
+        return new.keys, new.weights, new.slots, failed
+
+    slot_specs = {name: P(m) for name in slot_names}
+    in_slot_specs = {name: P() for name in in_slot_names}
+    fn = shard_map(_insert, mesh=mesh,
+                   in_specs=(P(m), P(m), slot_specs, P(), P(), P(),
+                             in_slot_specs),
+                   out_specs=(P(m), P(m), slot_specs, P()),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
 def insert_rows_sharded(state: hash_lib.HashTableState,
                         keys: jnp.ndarray,
                         weights: jnp.ndarray,
@@ -124,26 +153,9 @@ def insert_rows_sharded(state: hash_lib.HashTableState,
     skipped locally — the reference's owning-server delivery
     (EmbeddingLoadOperator.cpp:58-111).
     """
-    m = spec.model_axis
     slot_rows = slot_rows or {}
-
-    def _insert(tkeys, tweights, tslots, init_rng, k, w, srows):
-        local = hash_lib.HashTableState(
-            keys=tkeys, weights=tweights, slots=tslots, init_rng=init_rng,
-            insert_failures=jnp.zeros((), jnp.int32))
-        masked = _mask_non_owned(spec, k.ravel())
-        new = hash_lib.insert_rows(local, masked, w, srows or None,
-                                   max_probes=spec.max_probes)
-        failed = lax.psum(new.insert_failures, spec.model_axis)
-        return new.keys, new.weights, new.slots, failed
-
-    slot_specs = {name: P(m) for name in state.slots}
-    in_slot_specs = {name: P() for name in slot_rows}
-    fn = shard_map(_insert, mesh=mesh,
-                   in_specs=(P(m), P(m), slot_specs, P(), P(), P(),
-                             in_slot_specs),
-                   out_specs=(P(m), P(m), slot_specs, P()),
-                   check_vma=False)
+    fn = _insert_rows_program(mesh, spec, tuple(state.slots),
+                              tuple(slot_rows))
     tkeys, tweights, tslots, failed = fn(
         state.keys, state.weights, state.slots, state.init_rng,
         keys, weights, slot_rows)
@@ -153,23 +165,10 @@ def insert_rows_sharded(state: hash_lib.HashTableState,
         insert_failures=state.insert_failures + failed)
 
 
-def pull_sharded(state: hash_lib.HashTableState,
-                 indices: jnp.ndarray,
-                 initializer: Any,
-                 *,
-                 mesh: Mesh,
-                 spec: HashShardingSpec,
-                 batch_sharded: bool = True) -> jnp.ndarray:
-    """Distributed hash lookup: each shard resolves its owned keys, psum joins.
-
-    Missing-but-valid keys get their deterministic init row (computed only by
-    the owner shard); EMPTY-sentinel keys return zero rows. ``initializer=
-    None`` = read-only serving contract (missing keys -> zeros).
-    """
-    dim = state.weights.shape[-1]
+@functools.lru_cache(maxsize=None)
+def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
+                  dim: int, batch_sharded: bool):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
-    if initializer is not None:
-        initializer = make_initializer(initializer)
 
     def _pull(keys, weights, init_rng, idx):
         local = hash_lib.HashTableState(
@@ -186,24 +185,35 @@ def pull_sharded(state: hash_lib.HashTableState,
                              batch_spec),
                    out_specs=batch_spec,
                    check_vma=False)
+    return jax.jit(fn)
+
+
+def pull_sharded(state: hash_lib.HashTableState,
+                 indices: jnp.ndarray,
+                 initializer: Any,
+                 *,
+                 mesh: Mesh,
+                 spec: HashShardingSpec,
+                 batch_sharded: bool = True) -> jnp.ndarray:
+    """Distributed hash lookup: each shard resolves its owned keys, psum joins.
+
+    Missing-but-valid keys get their deterministic init row (computed only by
+    the owner shard); EMPTY-sentinel keys return zero rows. ``initializer=
+    None`` = read-only serving contract (missing keys -> zeros).
+    """
+    dim = state.weights.shape[-1]
+    if initializer is not None:
+        initializer = make_initializer(initializer)
+    fn = _pull_program(mesh, spec, initializer, dim, batch_sharded)
     return fn(state.keys, state.weights, state.init_rng, indices)
 
 
-def apply_gradients_sharded(state: hash_lib.HashTableState,
-                            optimizer: SparseOptimizer,
-                            initializer: Any,
-                            indices: jnp.ndarray,
-                            grads: jnp.ndarray,
-                            *,
-                            mesh: Mesh,
-                            spec: HashShardingSpec,
-                            batch_sharded: bool = True,
-                            dedup_capacity: Optional[int] = None
-                            ) -> hash_lib.HashTableState:
-    """Distributed push+update: all_gather batch, each shard updates its keys."""
-    dim = state.weights.shape[-1]
+@functools.lru_cache(maxsize=None)
+def _apply_program(mesh: Mesh, spec: HashShardingSpec,
+                   optimizer: SparseOptimizer, initializer: Any, dim: int,
+                   batch_sharded: bool, dedup_capacity: Optional[int],
+                   slot_names: tuple):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
-    optimizer = make_optimizer(optimizer)
     m = spec.model_axis
 
     def _apply(keys, weights, slots, init_rng, idx, g):
@@ -223,12 +233,33 @@ def apply_gradients_sharded(state: hash_lib.HashTableState,
         failed = lax.psum(new.insert_failures, spec.model_axis)
         return new.keys, new.weights, new.slots, failed
 
-    slot_specs = {name: P(m) for name in state.slots}
+    slot_specs = {name: P(m) for name in slot_names}
     fn = shard_map(_apply, mesh=mesh,
                    in_specs=(P(m), P(m), slot_specs, P(),
                              batch_spec, batch_spec),
                    out_specs=(P(m), P(m), slot_specs, P()),
                    check_vma=False)
+    return jax.jit(fn)
+
+
+def apply_gradients_sharded(state: hash_lib.HashTableState,
+                            optimizer: SparseOptimizer,
+                            initializer: Any,
+                            indices: jnp.ndarray,
+                            grads: jnp.ndarray,
+                            *,
+                            mesh: Mesh,
+                            spec: HashShardingSpec,
+                            batch_sharded: bool = True,
+                            dedup_capacity: Optional[int] = None
+                            ) -> hash_lib.HashTableState:
+    """Distributed push+update: all_gather batch, each shard updates its keys."""
+    dim = state.weights.shape[-1]
+    optimizer = make_optimizer(optimizer)
+    initializer = make_initializer(initializer) if initializer is not None \
+        else None
+    fn = _apply_program(mesh, spec, optimizer, initializer, dim,
+                        batch_sharded, dedup_capacity, tuple(state.slots))
     keys, weights, slots, failed = fn(
         state.keys, state.weights, state.slots, state.init_rng,
         indices, grads)

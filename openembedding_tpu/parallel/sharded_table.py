@@ -29,6 +29,7 @@ Layouts:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from functools import partial
 from typing import Any, Optional, Tuple
@@ -137,21 +138,11 @@ def state_shardings(state_specs, mesh: Mesh):
                         is_leaf=lambda x: isinstance(x, P))
 
 
-def pull_sharded(state: table_lib.TableState,
-                 indices: jnp.ndarray,
-                 *,
-                 mesh: Mesh,
-                 spec: ShardingSpec,
-                 batch_sharded: bool = True) -> jnp.ndarray:
-    """Distributed embedding lookup.
-
-    ``indices``: any shape, sharded over the data axis on dim 0 when
-    ``batch_sharded`` (the normal training path) else replicated. Returns
-    rows with the same batch sharding. Equivalent to the reference's pull
-    RPC fan-out + response scatter (EmbeddingPullOperator.cpp:40-252), as a
-    gather + one psum over ICI.
-    """
-    dim = state.weights.shape[-1]
+@functools.lru_cache(maxsize=None)
+def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
+                  batch_sharded: bool):
+    """Cached jitted pull: eager callers (serving lookups, tests) would
+    otherwise rebuild + retrace the shard_map closure every call."""
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
     def _pull(weights, idx):
@@ -170,7 +161,58 @@ def pull_sharded(state: table_lib.TableState,
                    in_specs=(P(spec.model_axis), batch_spec),
                    out_specs=batch_spec,
                    check_vma=False)
+    return jax.jit(fn)
+
+
+def pull_sharded(state: table_lib.TableState,
+                 indices: jnp.ndarray,
+                 *,
+                 mesh: Mesh,
+                 spec: ShardingSpec,
+                 batch_sharded: bool = True) -> jnp.ndarray:
+    """Distributed embedding lookup.
+
+    ``indices``: any shape, sharded over the data axis on dim 0 when
+    ``batch_sharded`` (the normal training path) else replicated. Returns
+    rows with the same batch sharding. Equivalent to the reference's pull
+    RPC fan-out + response scatter (EmbeddingPullOperator.cpp:40-252), as a
+    gather + one psum over ICI.
+    """
+    dim = state.weights.shape[-1]
+    fn = _pull_program(mesh, spec, dim, batch_sharded)
     return fn(state.weights, indices)
+
+
+@functools.lru_cache(maxsize=None)
+def _apply_program(mesh: Mesh, spec: ShardingSpec,
+                   optimizer: SparseOptimizer, dim: int,
+                   batch_sharded: bool, dedup_capacity: Optional[int],
+                   slot_names: tuple):
+    batch_spec = P(spec.data_axis) if batch_sharded else P()
+
+    def _apply(weights, slots, idx, g):
+        s = lax.axis_index(spec.model_axis)
+        flat = idx.ravel()
+        g2 = g.reshape(-1, dim)
+        if batch_sharded:
+            flat = lax.all_gather(flat, spec.data_axis, tiled=True)
+            g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
+        shard, local = spec.shard_and_local(flat)
+        owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
+        # non-owned entries become index -1 -> dropped inside apply_gradients
+        masked = jnp.where(owned, local, -1)
+        local_state = table_lib.TableState(weights=weights, slots=slots)
+        new_state = table_lib.apply_gradients(
+            local_state, optimizer, masked, g2,
+            dedup_capacity=dedup_capacity)
+        return new_state.weights, new_state.slots
+
+    slot_specs = {name: P(spec.model_axis) for name in slot_names}
+    fn = shard_map(_apply, mesh=mesh,
+                   in_specs=(P(spec.model_axis), slot_specs, batch_spec, batch_spec),
+                   out_specs=(P(spec.model_axis), slot_specs),
+                   check_vma=False)
+    return jax.jit(fn)
 
 
 def apply_gradients_sharded(state: table_lib.TableState,
@@ -191,29 +233,8 @@ def apply_gradients_sharded(state: table_lib.TableState,
     deterministic replicated application.
     """
     dim = state.weights.shape[-1]
-    batch_spec = P(spec.data_axis) if batch_sharded else P()
-
-    def _apply(weights, slots, idx, g):
-        s = lax.axis_index(spec.model_axis)
-        flat = idx.ravel()
-        g2 = g.reshape(-1, dim)
-        if batch_sharded:
-            flat = lax.all_gather(flat, spec.data_axis, tiled=True)
-            g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
-        shard, local = spec.shard_and_local(flat)
-        owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
-        # non-owned entries become index -1 -> dropped inside apply_gradients
-        masked = jnp.where(owned, local, -1)
-        local_state = table_lib.TableState(weights=weights, slots=slots)
-        new_state = table_lib.apply_gradients(
-            local_state, optimizer, masked, g2,
-            dedup_capacity=dedup_capacity)
-        return new_state.weights, new_state.slots
-
-    slot_specs = {name: P(spec.model_axis) for name in state.slots}
-    fn = shard_map(_apply, mesh=mesh,
-                   in_specs=(P(spec.model_axis), slot_specs, batch_spec, batch_spec),
-                   out_specs=(P(spec.model_axis), slot_specs),
-                   check_vma=False)
+    optimizer = make_optimizer(optimizer)
+    fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
+                        dedup_capacity, tuple(state.slots))
     weights, slots = fn(state.weights, state.slots, indices, grads)
     return table_lib.TableState(weights=weights, slots=slots)

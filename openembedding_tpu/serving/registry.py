@@ -58,18 +58,28 @@ class ServingModel:
         return rows[name]
 
 
-def _specs_from_meta(meta: ModelMeta, hash_capacity: int
-                     ) -> List[EmbeddingSpec]:
+def _specs_from_meta(meta: ModelMeta, hash_capacity: int,
+                     num_shards: int = -1) -> List[EmbeddingSpec]:
     """Rebuild EmbeddingSpecs from a checkpoint's model_meta — the serving
     process needs no model code, just the dump (like TF-Serving + the
-    reference's SavedModel + <dir>/openembedding sidecar)."""
+    reference's SavedModel + <dir>/openembedding sidecar). Hash geometry
+    (capacity/key dtype) comes from the meta's ``hash_variables`` extra when
+    the checkpoint recorded it, so serving tables can hold every trained row."""
+    hash_info = meta.extra.get("hash_variables", {})
     specs = []
     for v in sorted(meta.variables, key=lambda v: v.variable_id):
         hash_var = v.meta.vocabulary_size >= UNBOUNDED_VOCAB
+        info = hash_info.get(v.name, {})
         specs.append(EmbeddingSpec(
             name=v.name, input_dim=-1 if hash_var else v.meta.vocabulary_size,
             output_dim=v.meta.embedding_dim, dtype=v.meta.datatype,
-            hash_capacity=hash_capacity))
+            # serving is read-only: the stateless "default" optimizer means
+            # no slot arrays are allocated or loaded (the reference serves
+            # through the no-optimizer default, EmbeddingOptimizer.h default)
+            optimizer={"category": "default"},
+            hash_capacity=int(info.get("hash_capacity", hash_capacity)),
+            key_dtype=info.get("key_dtype", "int32"),
+            num_shards=num_shards))
     return specs
 
 
@@ -107,7 +117,8 @@ class ModelRegistry:
 
         def _load():
             try:
-                specs = _specs_from_meta(meta, self.default_hash_capacity)
+                specs = _specs_from_meta(meta, self.default_hash_capacity,
+                                         num_shards)
                 coll = EmbeddingCollection(specs, self.mesh)
                 states = ckpt_lib.load_checkpoint(model_uri, coll)
                 model = ServingModel(sign, coll, states, meta)

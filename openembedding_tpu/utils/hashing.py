@@ -1,0 +1,23 @@
+"""Shared host-side key hashing.
+
+One splitmix64 finalizer used by every host-side key producer (the dataset
+hashers in ``data.criteo`` and the fused-feature key mixer in ``fused``) —
+the ``tf.strings.to_hash_bucket_fast`` role of the reference's TSV path
+(/root/reference/test/benchmark/criteo_deepctr.py:202-240), minus TF's
+farmhash choice.
+
+NOTE: ``hash_table._mix`` is the jnp twin of this function (same constants)
+for on-device probe hashing; keep the two in sync.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — deterministic int64 avalanche."""
+    x = x.astype(np.uint64)
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xFF51AFD7ED558CCD)
+    x = (x ^ (x >> np.uint64(33))) * np.uint64(0xC4CEB9FE1A85EC53)
+    return x ^ (x >> np.uint64(33))
