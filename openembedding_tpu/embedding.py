@@ -58,11 +58,14 @@ class EmbeddingSpec:
     dtype: str = "float32"
     optimizer: Any = None            # None => collection default
     initializer: Any = None          # None => collection default
-    num_shards: int = -1             # -1 => one shard per model-axis slice
+    num_shards: int = -1             # -1 => one shard per device (a2a plane)
     hash_capacity: int = 2**20       # reserve_items for hash variables
     layout: str = "mod"              # array-table row layout
     key_dtype: str = "int32"         # hash key storage; "int64" needs x64 for
                                      # the reference's full 2^62 key space
+    plane: str = "a2a"               # "a2a" owner-routed | "psum" baseline
+    a2a_capacity: int = 0            # per-destination bucket rows; 0 = auto
+    a2a_slack: float = 2.0           # auto bucket = slack * mean
 
     @property
     def use_hash(self) -> bool:
@@ -108,11 +111,13 @@ class EmbeddingCollection:
             if spec.use_hash:
                 self._shardings[spec.name] = sh.make_hash_sharding_spec(
                     mesh, total_capacity=spec.hash_capacity,
-                    num_shards=spec.num_shards)
+                    num_shards=spec.num_shards, plane=spec.plane,
+                    a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack)
             else:
                 self._shardings[spec.name] = st.make_sharding_spec(
                     spec.meta(), mesh, num_shards=spec.num_shards,
-                    layout=spec.layout)
+                    layout=spec.layout, plane=spec.plane,
+                    a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack)
 
     # --- introspection -----------------------------------------------------
     def variable_id(self, name: str) -> int:
@@ -135,9 +140,10 @@ class EmbeddingCollection:
             for name in self.specs
         ]
         variables.sort(key=lambda v: v.variable_id)
+        num_shards = max((s.num_shards for s in self._shardings.values()),
+                         default=1)
         return ModelMeta(model_sign=model_sign, model_uri=model_uri,
-                         variables=variables,
-                         num_shards=self.mesh.shape[MODEL_AXIS])
+                         variables=variables, num_shards=num_shards)
 
     # --- state lifecycle ---------------------------------------------------
     def init(self, rng: Optional[jax.Array] = None,

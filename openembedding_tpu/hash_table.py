@@ -285,12 +285,15 @@ def apply_gradients(state: HashTableState,
                     grads: jnp.ndarray,
                     *,
                     dedup_capacity: Optional[int] = None,
-                    max_probes: int = DEFAULT_MAX_PROBES) -> HashTableState:
+                    max_probes: int = DEFAULT_MAX_PROBES,
+                    in_counts: Optional[jnp.ndarray] = None) -> HashTableState:
     """Combine duplicate grads, insert missing keys, update touched rows.
 
     The hash-table analogue of ``table.apply_gradients``: dedup -> claim/probe
     insert -> gather (with deterministic init for fresh rows) -> vectorized
     optimizer -> scatter. Window-overflow keys are dropped and counted.
+    ``in_counts`` ([n]) marks grads that are already pre-reduced sums of that
+    many originals (owner side of the all-to-all exchange).
     """
     optimizer = make_optimizer(optimizer)
     initializer = make_initializer(initializer)
@@ -303,7 +306,8 @@ def apply_gradients(state: HashTableState,
     uniq, inverse, valid = dedup.unique_indices(
         flat_idx, capacity, fill_value=empty_key(flat_idx.dtype))
     valid = valid & (uniq != empty_key(flat_idx.dtype))
-    summed, counts = dedup.combine_gradients(flat_grads, inverse, capacity)
+    summed, counts = dedup.combine_gradients(flat_grads, inverse, capacity,
+                                             in_counts)
 
     keys_arr, slot, inserted, failed = find_or_insert(
         state.keys, uniq, valid, max_probes)

@@ -49,7 +49,8 @@ def unique_indices(indices: jnp.ndarray, capacity: int | None = None,
     return uniq, inverse.ravel(), uniq != fill
 
 
-def combine_gradients(grads: jnp.ndarray, inverse: jnp.ndarray, capacity: int
+def combine_gradients(grads: jnp.ndarray, inverse: jnp.ndarray, capacity: int,
+                      in_counts: jnp.ndarray | None = None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sum duplicate-key gradients into the unique buffer with counts.
 
@@ -57,12 +58,18 @@ def combine_gradients(grads: jnp.ndarray, inverse: jnp.ndarray, capacity: int
     [capacity])``. Matches the reference's client-side pre-reduce semantics:
     the optimizer sees the SUM over duplicates plus the duplicate count
     (EmbeddingPushOperator.cpp:29-62, MpscGradientReducer.h:27-54).
+
+    ``in_counts`` carries per-entry multiplicities when the incoming grads are
+    *already pre-reduced* (the owner side of the all-to-all exchange receives
+    (sum, count) pairs from every peer and must SUM the counts) — the
+    reference's server-side MpscGradientReducer merging client pre-reduces.
     """
     n, dim = grads.shape
     summed = jnp.zeros((capacity, dim), dtype=grads.dtype).at[inverse].add(
         grads, mode="drop")
+    add = jnp.int32(1) if in_counts is None else in_counts.astype(jnp.int32)
     counts = jnp.zeros((capacity,), dtype=jnp.int32).at[inverse].add(
-        1, mode="drop")
+        add, mode="drop")
     return summed, counts
 
 

@@ -1,0 +1,308 @@
+"""Owner-routed all-to-all exchange: the scale-grade sparse data plane.
+
+The reference's pull/push pipeline is an *owner exchange*: dedup client-side,
+partition keys by owning shard, send each shard only its own requests, scatter
+the per-shard responses back
+(/root/reference/openembedding/server/EmbeddingPullOperator.cpp:60-112,207-252,
+EmbeddingPushOperator.cpp:29-104). The first TPU data plane here (the "psum"
+plane in ``sharded_table``/``sharded_hash``) replaced that with gather + psum
+(pull) and all_gather + masked local update (push) — simple and correct, but
+its ICI traffic scales with *mesh size*, not with owned rows: the push
+all_gathers the full global batch to every device.
+
+This module is the owner exchange done TPU-natively, inside one shard_map
+program ("a2a" plane):
+
+* tables are sharded over the **whole mesh** (data x model axes = N shards),
+  so HBM capacity scales with every chip and there are no table replicas to
+  keep in sync;
+* each device handles a distinct slice of the batch (the model-axis peers of
+  a data slice split their common copy), dedups it, buckets the unique keys
+  by owner shard into fixed-capacity blocks, and a grid all-to-all routes
+  each block to its owner — indices out, rows (pull) or pre-reduced
+  (grad, count) pairs (push) back;
+* the owner resolves rows locally (array index math or hash probe) and, on
+  push, merges the per-peer pre-reduces exactly like the reference's
+  server-side MpscGradientReducer (counts are summed, not recounted).
+
+Per-device ICI bytes per step are O(slack * batch_slice * dim) instead of
+O(global_batch * dim) — the gap to the reference's per-owner exchange closed.
+
+Static shapes: the per-destination bucket capacity must be fixed at trace
+time. Keys are uniform across owners by construction ("mod" layout spreads
+sequential ids; hash keys are avalanche-mixed), so the default capacity
+``max(32, 2 * mean_bucket)`` overflows with vanishing probability; overflowed
+entries are dropped (zero rows on pull, skipped updates on push) — measure
+with :func:`routing_overflow` (the reference ships the same measurement
+methodology, laboratory/benchmark/analyze.py) and raise
+``a2a_capacity``/``a2a_slack`` if your key distribution defeats the layout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import dedup
+from ..utils import observability
+
+
+def _record_drops(counter: str, local_dropped: jnp.ndarray) -> None:
+    """Gated host accumulation of routed-exchange drops.
+
+    When ``observability.set_evaluate_performance(True)`` is on at **trace
+    time**, every execution adds each device's dropped-entry count to the
+    global accumulator (their sum is the global total) — the same gate the
+    reference puts on its pull_indices/pull_unique counters
+    (EmbeddingPullOperator.cpp:208-209,244-248). Off by default: a host
+    callback per step would stall TPU pipelining.
+    """
+    if observability.evaluate_performance():
+        jax.debug.callback(
+            lambda d: observability.GLOBAL.add(counter, int(d)),
+            local_dropped)
+
+
+def linear_shard_id(axes: Sequence[str], sizes: Sequence[int]) -> jnp.ndarray:
+    """This device's shard ordinal, row-major over ``axes`` (static sizes).
+
+    Matches the block order of ``PartitionSpec((*axes,))`` on dim 0: the
+    device at mesh position (i0, i1, ...) owns block i0*s1*... + i1*... .
+    """
+    idx = jnp.zeros((), jnp.int32)
+    for ax, size in zip(axes, sizes):
+        idx = idx * size + lax.axis_index(ax)
+    return idx
+
+
+def bucket_capacity(slice_size: int, num_shards: int,
+                    capacity: int = 0, slack: float = 2.0) -> int:
+    """Per-destination bucket size: explicit, or mean*slack with a floor.
+
+    Slices of <= 32 entries (tests, serving probes) get ``capacity ==
+    slice_size`` and are exact regardless of key skew. Larger slices rely on
+    owner uniformity: binomial concentration makes ``2 * mean`` overflow-free
+    for uniform owners (hashed keys, or sequential ids under the "mod"
+    layout), but *structured* skew — e.g. ids all congruent modulo the shard
+    count — can overflow. Monitor with :func:`routing_overflow` or the gated
+    ``a2a_dropped_*`` accumulators, and raise ``a2a_capacity``/``a2a_slack``
+    (up to ``slice_size`` = always exact) if your keys defeat the layout.
+    """
+    if capacity:
+        return min(capacity, slice_size)
+    mean = math.ceil(slice_size / num_shards)
+    c = max(32, math.ceil(mean * slack))
+    c = min(slice_size, -(-c // 8) * 8)
+    return max(c, 1)
+
+
+def bucketize(owner: jnp.ndarray, num_shards: int, capacity: int
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Assign each entry a flat send-buffer slot ``owner * capacity + pos``.
+
+    ``owner`` is [m] with values in [0, num_shards) or >= num_shards for
+    entries that must not be sent. Returns ``(dest [m], ok [m])``: ``dest``
+    is the flat slot (== num_shards * capacity, i.e. out of range, when
+    dropped), ``ok`` marks entries that made it into a bucket. Equivalent of
+    the reference's per-shard request assembly (EmbeddingPullOperator.cpp:
+    73-112) under XLA's static shapes: stable sort by owner, rank within
+    group, drop past-capacity ranks.
+    """
+    m = owner.shape[0]
+    owner = owner.astype(jnp.int32)
+    clamped = jnp.minimum(owner, num_shards)
+    order = jnp.argsort(clamped, stable=True)
+    sorted_owner = clamped[order]
+    counts = jnp.zeros((num_shards + 1,), jnp.int32).at[clamped].add(1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    pos_sorted = jnp.arange(m, dtype=jnp.int32) - starts[sorted_owner]
+    pos = jnp.zeros((m,), jnp.int32).at[order].set(pos_sorted)
+    ok = (owner < num_shards) & (pos < capacity)
+    dest = jnp.where(ok, owner * capacity + pos, num_shards * capacity)
+    return dest, ok
+
+
+def fill_buckets(values: jnp.ndarray, dest: jnp.ndarray, num_shards: int,
+                 capacity: int, fill) -> jnp.ndarray:
+    """Scatter [m, ...] values into a [num_shards, capacity, ...] send buffer."""
+    out = jnp.full((num_shards * capacity,) + values.shape[1:], fill,
+                   dtype=values.dtype)
+    out = out.at[dest].set(values, mode="drop")
+    return out.reshape((num_shards, capacity) + values.shape[1:])
+
+
+def grid_all_to_all(x: jnp.ndarray, axes: Sequence[str],
+                    sizes: Sequence[int]) -> jnp.ndarray:
+    """All-to-all over the product of mesh ``axes``.
+
+    ``x`` is [N, ...] of per-destination blocks in row-major linear-shard
+    order (N = prod(sizes)); the result is [N, ...] where row j is the block
+    peer j destined for this device. Decomposed into one ``lax.all_to_all``
+    per axis (a grid transpose): after routing over axis k, block (j0..jk..)
+    holds data from the peer matching on later axes — the composition routes
+    every block to exactly its (j0, ..., jn) owner.
+    """
+    n = x.shape[0]
+    shape = tuple(sizes) + x.shape[1:]
+    y = x.reshape(shape)
+    for k, (ax, size) in enumerate(zip(axes, sizes)):
+        if size > 1:
+            y = lax.all_to_all(y, ax, split_axis=k, concat_axis=k)
+    return y.reshape((n,) + x.shape[1:])
+
+
+def grid_info(mesh, shard_axes: Sequence[str], model_axis: str,
+              batch_sharded: bool):
+    """(grid_axes, grid_sizes, split_axes, split_sizes) for one exchange.
+
+    The batch is divided among the mesh axes it is *replicated* over (the
+    model axis when batch-sharded over data; the whole shard grid when fully
+    replicated), and routed to owners over all table shard axes.
+    """
+    grid_axes = tuple(shard_axes)
+    grid_sizes = tuple(mesh.shape[a] for a in grid_axes)
+    split_axes = (model_axis,) if batch_sharded else grid_axes
+    split_sizes = tuple(mesh.shape[a] for a in split_axes)
+    return grid_axes, grid_sizes, split_axes, split_sizes
+
+
+def split_slice(flat: jnp.ndarray, num_parts: int, my_part: jnp.ndarray,
+                fill) -> Tuple[jnp.ndarray, int]:
+    """Pad ``flat`` [n] to a multiple of ``num_parts`` and take slice
+    ``my_part`` of size m = ceil(n / num_parts). Returns (slice, m)."""
+    n = flat.shape[0]
+    m = -(-n // num_parts)
+    padded = jnp.full((m * num_parts,), fill, dtype=flat.dtype)
+    padded = padded.at[:n].set(flat)
+    start = (my_part * m).astype(jnp.int32)
+    return lax.dynamic_slice(padded, (start,), (m,)), m
+
+
+def split_slice_rows(rows: jnp.ndarray, num_parts: int, my_part: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """Row variant of :func:`split_slice` (zero padding)."""
+    n = rows.shape[0]
+    m = -(-n // num_parts)
+    padded = jnp.zeros((m * num_parts,) + rows.shape[1:], rows.dtype)
+    padded = padded.at[:n].set(rows)
+    start = (my_part * m).astype(jnp.int32)
+    starts = (start,) + (jnp.zeros((), jnp.int32),) * (rows.ndim - 1)
+    return lax.dynamic_slice(padded, starts, (m,) + rows.shape[1:])
+
+
+def exchange_pull(flat_idx: jnp.ndarray,
+                  resolve_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                  owner_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                  *,
+                  sentinel,
+                  dim: int,
+                  num_shards: int,
+                  grid_axes: Sequence[str],
+                  grid_sizes: Sequence[int],
+                  split_axes: Sequence[str],
+                  split_sizes: Sequence[int],
+                  capacity: int = 0,
+                  slack: float = 2.0) -> jnp.ndarray:
+    """Owner-routed lookup of ``flat_idx`` [n] -> rows [n, dim].
+
+    ``flat_idx`` must be identical on all ``split_axes`` peers (they divide
+    the work); ``resolve_fn(keys [K]) -> [K, dim]`` runs on the owner and
+    must return zero rows for keys it does not own (sentinel included).
+    ``owner_fn(keys)`` maps keys to shard ordinals (>= num_shards = do not
+    send). The result is replicated over ``split_axes`` again (all_gather).
+    """
+    my_part = linear_shard_id(split_axes, split_sizes)
+    n = flat_idx.shape[0]
+    sl, m = split_slice(flat_idx, math.prod(split_sizes), my_part, sentinel)
+    uniq, inverse, _valid = dedup.unique_indices(sl, m, fill_value=sentinel)
+    cap = bucket_capacity(m, num_shards, capacity, slack)
+    owners = owner_fn(uniq)
+    dest, ok = bucketize(owners, num_shards, cap)
+    _record_drops("a2a_dropped_pull",
+                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32))
+    send = fill_buckets(uniq, dest, num_shards, cap, sentinel)
+    req = grid_all_to_all(send, grid_axes, grid_sizes)
+    rows = resolve_fn(req.ravel())
+    resp = grid_all_to_all(rows.reshape((num_shards, cap, dim)),
+                           grid_axes, grid_sizes)
+    flat_resp = resp.reshape((num_shards * cap, dim))
+    uniq_rows = jnp.take(flat_resp, jnp.where(ok, dest, 0), axis=0)
+    uniq_rows = jnp.where(ok[:, None], uniq_rows, jnp.zeros_like(uniq_rows))
+    slice_rows = jnp.take(uniq_rows, inverse, axis=0)
+    out = lax.all_gather(slice_rows, tuple(split_axes), tiled=True)
+    return out[:n]
+
+
+def exchange_push(flat_idx: jnp.ndarray,
+                  grads: jnp.ndarray,
+                  apply_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+                                     None],
+                  owner_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                  *,
+                  sentinel,
+                  num_shards: int,
+                  grid_axes: Sequence[str],
+                  grid_sizes: Sequence[int],
+                  split_axes: Sequence[str],
+                  split_sizes: Sequence[int],
+                  capacity: int = 0,
+                  slack: float = 2.0):
+    """Owner-routed push: pre-reduce, route (key, grad sum, count) to owners.
+
+    ``apply_fn(keys [K], grads [K, dim], counts [K])`` runs on the owner with
+    the merged per-peer pre-reduces and returns its updated local state
+    (whatever pytree it likes). Entries with count 0 / sentinel key are
+    padding and must be ignored by ``apply_fn`` (both built-in appliers drop
+    them via the invalid-key contract).
+    """
+    dim = grads.shape[-1]
+    my_part = linear_shard_id(split_axes, split_sizes)
+    parts = math.prod(split_sizes)
+    sl, m = split_slice(flat_idx, parts, my_part, sentinel)
+    g2 = split_slice_rows(grads.reshape((-1, dim)), parts, my_part)
+    uniq, inverse, _valid = dedup.unique_indices(sl, m, fill_value=sentinel)
+    summed, counts = dedup.combine_gradients(g2, inverse, m)
+    cap = bucket_capacity(m, num_shards, capacity, slack)
+    owners = owner_fn(uniq)
+    dest, ok = bucketize(owners, num_shards, cap)
+    _record_drops("a2a_dropped_push",
+                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32))
+    send_k = fill_buckets(uniq, dest, num_shards, cap, sentinel)
+    send_g = fill_buckets(summed, dest, num_shards, cap, 0)
+    send_c = fill_buckets(counts, dest, num_shards, cap, 0)
+    rk = grid_all_to_all(send_k, grid_axes, grid_sizes)
+    rg = grid_all_to_all(send_g, grid_axes, grid_sizes)
+    rc = grid_all_to_all(send_c, grid_axes, grid_sizes)
+    k = rk.ravel()
+    return apply_fn(k, rg.reshape((k.shape[0], dim)), rc.ravel())
+
+
+def routing_overflow(indices, num_shards: int, slice_parts: int,
+                     owner_of, capacity: int = 0, slack: float = 2.0) -> int:
+    """Host-side diagnostic: how many batch entries would the a2a plane drop?
+
+    Sizes the bucket capacity for a sample batch the way the exchange does
+    (dedup per slice, bucket by owner) and counts past-capacity uniques —
+    the reference measures batch key-overlap the same way before sizing its
+    dedup structures (laboratory/benchmark/analyze.py). 0 means the default
+    capacity is exact for this batch shape + key distribution.
+    """
+    import numpy as np
+    flat = np.asarray(indices).ravel()
+    n = flat.shape[0]
+    m = -(-n // slice_parts)
+    cap = bucket_capacity(m, num_shards, capacity, slack)
+    dropped = 0
+    for p in range(slice_parts):
+        sl = flat[p * m:(p + 1) * m]
+        uniq = np.unique(sl)
+        owners = np.asarray(owner_of(uniq))
+        keep = owners < num_shards
+        counts = np.bincount(owners[keep], minlength=num_shards)
+        dropped += int(np.maximum(counts - cap, 0).sum())
+    return dropped

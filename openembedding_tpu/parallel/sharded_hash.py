@@ -1,15 +1,17 @@
 """Hash-table embeddings sharded over the device mesh.
 
-Same data plane as ``sharded_table`` (gather + psum pull, all_gather + masked
-local update push) but for unbounded key spaces: each model-axis slice owns a
-local open-addressing ``HashTableState`` and the keys are partitioned
-``key % num_shards`` — the reference's modulo shard layout
-(/root/reference/openembedding/server/EmbeddingPullOperator.cpp:73-78) applied
-to hashed keys, which are uniform by construction.
+Same two data planes as ``sharded_table`` but for unbounded key spaces:
 
-Non-owned keys are masked to the EMPTY sentinel before the local table call,
-which treats them as invalid (zero pull rows / dropped updates), so the psum
-over the model axis reconstructs the full batch exactly once.
+* ``"a2a"`` (default) — owner-routed exchange over the whole mesh (see
+  ``parallel/alltoall.py``): each device owns one open-addressing shard,
+  keys are partitioned ``key % num_shards`` (the reference's modulo shard
+  layout, /root/reference/openembedding/server/EmbeddingPullOperator.cpp:73-78,
+  applied to hashed keys, which are uniform by construction) and routed to
+  their single owner.
+* ``"psum"`` — shards along the model axis only (replicated over data):
+  non-owned keys are masked to the EMPTY sentinel before the local table
+  call (zero pull rows / dropped updates), so a psum over the model axis
+  reconstructs the full batch exactly once.
 """
 
 from __future__ import annotations
@@ -28,18 +30,31 @@ from ..meta import EmbeddingVariableMeta
 from ..optim.initializers import make_initializer
 from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import hash_table as hash_lib
+from . import alltoall as a2a
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
 @dataclasses.dataclass(frozen=True)
 class HashShardingSpec:
-    """Static layout of one hash table over the mesh model axis."""
+    """Static layout of one hash table over the mesh."""
 
     num_shards: int
     capacity_per_shard: int
     max_probes: int = hash_lib.DEFAULT_MAX_PROBES
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
+    plane: str = "a2a"   # "a2a" | "psum"
+    a2a_capacity: int = 0
+    a2a_slack: float = 2.0
+
+    @property
+    def shard_axes(self) -> tuple:
+        if self.plane == "a2a":
+            return (self.data_axis, self.model_axis)
+        return (self.model_axis,)
+
+    def row_spec(self) -> P:
+        return P(self.shard_axes)
 
     def owner_shard(self, keys: jnp.ndarray) -> jnp.ndarray:
         # unsigned mod so negative (but valid) hashed keys still land on a
@@ -50,26 +65,31 @@ class HashShardingSpec:
 
 def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
                             num_shards: int = -1,
-                            max_probes: int = hash_lib.DEFAULT_MAX_PROBES
-                            ) -> HashShardingSpec:
-    """num_shards=-1 => one shard per model-axis slice (reference default)."""
-    model_size = mesh.shape[MODEL_AXIS]
+                            max_probes: int = hash_lib.DEFAULT_MAX_PROBES,
+                            plane: str = "a2a",
+                            a2a_capacity: int = 0,
+                            a2a_slack: float = 2.0) -> HashShardingSpec:
+    """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum")."""
+    if plane not in ("a2a", "psum"):
+        raise ValueError(f"unknown plane {plane!r}")
+    want = mesh.size if plane == "a2a" else mesh.shape[MODEL_AXIS]
     if num_shards == -1:
-        num_shards = model_size
-    if num_shards != model_size:
+        num_shards = want
+    if num_shards != want:
         raise ValueError(
-            f"num_shards={num_shards} must equal mesh model axis size "
-            f"{model_size} (use a different mesh or -1)")
+            f"num_shards={num_shards} must equal the {plane}-plane shard "
+            f"count {want} for this mesh (or pass -1)")
     cap = -(-total_capacity // num_shards)
     return HashShardingSpec(num_shards=num_shards, capacity_per_shard=cap,
-                            max_probes=max_probes)
+                            max_probes=max_probes, plane=plane,
+                            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack)
 
 
 def state_specs(optimizer: SparseOptimizer, dim: int, spec: HashShardingSpec):
-    m = spec.model_axis
+    row = spec.row_spec()
     return hash_lib.HashTableState(
-        keys=P(m), weights=P(m),
-        slots={name: P(m) for name in optimizer.slot_shapes(dim)},
+        keys=row, weights=row,
+        slots={name: row for name in optimizer.slot_shapes(dim)},
         init_rng=P(), insert_failures=P())
 
 
@@ -104,11 +124,16 @@ def create_sharded_hash_table(meta: EmbeddingVariableMeta,
     return jax.jit(fn)(rng)
 
 
-def _mask_non_owned(spec: HashShardingSpec, flat: jnp.ndarray) -> jnp.ndarray:
-    s = lax.axis_index(spec.model_axis)
+def _mask_non_owned(spec: HashShardingSpec, flat: jnp.ndarray,
+                    me: jnp.ndarray) -> jnp.ndarray:
     empty = hash_lib.empty_key(flat.dtype)
-    owned = (spec.owner_shard(flat) == s) & (flat != empty)
+    owned = (spec.owner_shard(flat) == me) & (flat != empty)
     return jnp.where(owned, flat, empty)
+
+
+def _my_shard(mesh: Mesh, spec: HashShardingSpec) -> jnp.ndarray:
+    axes = spec.shard_axes
+    return a2a.linear_shard_id(axes, tuple(mesh.shape[a] for a in axes))
 
 
 @functools.lru_cache(maxsize=None)
@@ -117,24 +142,24 @@ def _insert_rows_program(mesh: Mesh, spec: HashShardingSpec,
     """Cached jitted insert program: the checkpoint loader streams many
     same-shaped chunks, and rebuilding the shard_map closure per chunk would
     retrace (and on a remote-compile link, round-trip) every call."""
-    m = spec.model_axis
 
     def _insert(tkeys, tweights, tslots, init_rng, k, w, srows):
         local = hash_lib.HashTableState(
             keys=tkeys, weights=tweights, slots=tslots, init_rng=init_rng,
             insert_failures=jnp.zeros((), jnp.int32))
-        masked = _mask_non_owned(spec, k.ravel())
+        masked = _mask_non_owned(spec, k.ravel(), _my_shard(mesh, spec))
         new = hash_lib.insert_rows(local, masked, w, srows or None,
                                    max_probes=spec.max_probes)
-        failed = lax.psum(new.insert_failures, spec.model_axis)
+        failed = lax.psum(new.insert_failures, spec.shard_axes)
         return new.keys, new.weights, new.slots, failed
 
-    slot_specs = {name: P(m) for name in slot_names}
+    row = spec.row_spec()
+    slot_specs = {name: row for name in slot_names}
     in_slot_specs = {name: P() for name in in_slot_names}
     fn = shard_map(_insert, mesh=mesh,
-                   in_specs=(P(m), P(m), slot_specs, P(), P(), P(),
+                   in_specs=(row, row, slot_specs, P(), P(), P(),
                              in_slot_specs),
-                   out_specs=(P(m), P(m), slot_specs, P()),
+                   out_specs=(row, row, slot_specs, P()),
                    check_vma=False)
     return jax.jit(fn)
 
@@ -170,19 +195,50 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                   dim: int, batch_sharded: bool):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    def _pull(keys, weights, init_rng, idx):
-        local = hash_lib.HashTableState(
-            keys=keys, weights=weights, slots={}, init_rng=init_rng,
-            insert_failures=jnp.zeros((), jnp.int32))
-        flat = _mask_non_owned(spec, idx.ravel())
-        rows = hash_lib.pull(local, flat, initializer,
-                             max_probes=spec.max_probes)
-        rows = lax.psum(rows, spec.model_axis)
-        return rows.reshape(idx.shape + (dim,))
+    if spec.plane == "a2a":
+        grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
+            mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
+        def _pull(keys, weights, init_rng, idx):
+            me = a2a.linear_shard_id(grid_axes, grid_sizes)
+            local = hash_lib.HashTableState(
+                keys=keys, weights=weights, slots={}, init_rng=init_rng,
+                insert_failures=jnp.zeros((), jnp.int32))
+            flat = idx.ravel()
+            sentinel = hash_lib.empty_key(flat.dtype)
+
+            def resolve(q):
+                masked = _mask_non_owned(spec, q, me)
+                return hash_lib.pull(local, masked, initializer,
+                                     max_probes=spec.max_probes)
+
+            def owner(q):
+                valid = q != sentinel
+                return jnp.where(valid, spec.owner_shard(q),
+                                 spec.num_shards).astype(jnp.int32)
+
+            rows = a2a.exchange_pull(
+                flat, resolve, owner, sentinel=sentinel, dim=dim,
+                num_shards=spec.num_shards, grid_axes=grid_axes,
+                grid_sizes=grid_sizes, split_axes=split_axes,
+                split_sizes=split_sizes, capacity=spec.a2a_capacity,
+                slack=spec.a2a_slack)
+            return rows.reshape(idx.shape + (dim,))
+    else:
+        def _pull(keys, weights, init_rng, idx):
+            local = hash_lib.HashTableState(
+                keys=keys, weights=weights, slots={}, init_rng=init_rng,
+                insert_failures=jnp.zeros((), jnp.int32))
+            flat = _mask_non_owned(spec, idx.ravel(),
+                                   lax.axis_index(spec.model_axis))
+            rows = hash_lib.pull(local, flat, initializer,
+                                 max_probes=spec.max_probes)
+            rows = lax.psum(rows, spec.model_axis)
+            return rows.reshape(idx.shape + (dim,))
+
+    row = spec.row_spec()
     fn = shard_map(_pull, mesh=mesh,
-                   in_specs=(P(spec.model_axis), P(spec.model_axis), P(),
-                             batch_spec),
+                   in_specs=(row, row, P(), batch_spec),
                    out_specs=batch_spec,
                    check_vma=False)
     return jax.jit(fn)
@@ -195,7 +251,7 @@ def pull_sharded(state: hash_lib.HashTableState,
                  mesh: Mesh,
                  spec: HashShardingSpec,
                  batch_sharded: bool = True) -> jnp.ndarray:
-    """Distributed hash lookup: each shard resolves its owned keys, psum joins.
+    """Distributed hash lookup: the owner shard resolves each key.
 
     Missing-but-valid keys get their deterministic init row (computed only by
     the owner shard); EMPTY-sentinel keys return zero rows. ``initializer=
@@ -214,30 +270,64 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                    batch_sharded: bool, dedup_capacity: Optional[int],
                    slot_names: tuple):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
-    m = spec.model_axis
 
-    def _apply(keys, weights, slots, init_rng, idx, g):
-        flat = idx.ravel()
-        g2 = g.reshape(-1, dim)
-        if batch_sharded:
-            flat = lax.all_gather(flat, spec.data_axis, tiled=True)
-            g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
-        flat = _mask_non_owned(spec, flat)
-        local = hash_lib.HashTableState(
-            keys=keys, weights=weights, slots=slots, init_rng=init_rng,
-            insert_failures=jnp.zeros((), jnp.int32))
-        new = hash_lib.apply_gradients(
-            local, optimizer, initializer, flat, g2,
-            dedup_capacity=dedup_capacity, max_probes=spec.max_probes)
-        # per-shard failure deltas -> replicated global total
-        failed = lax.psum(new.insert_failures, spec.model_axis)
-        return new.keys, new.weights, new.slots, failed
+    if spec.plane == "a2a":
+        grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
+            mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
-    slot_specs = {name: P(m) for name in slot_names}
+        def _apply(keys, weights, slots, init_rng, idx, g):
+            me = a2a.linear_shard_id(grid_axes, grid_sizes)
+            local = hash_lib.HashTableState(
+                keys=keys, weights=weights, slots=slots, init_rng=init_rng,
+                insert_failures=jnp.zeros((), jnp.int32))
+            flat = idx.ravel()
+            sentinel = hash_lib.empty_key(flat.dtype)
+
+            def owner(q):
+                valid = q != sentinel
+                return jnp.where(valid, spec.owner_shard(q),
+                                 spec.num_shards).astype(jnp.int32)
+
+            def apply_fn(q, grads, counts):
+                masked = _mask_non_owned(spec, q, me)
+                new = hash_lib.apply_gradients(
+                    local, optimizer, initializer, masked, grads,
+                    dedup_capacity=dedup_capacity,
+                    max_probes=spec.max_probes, in_counts=counts)
+                failed = lax.psum(new.insert_failures, spec.shard_axes)
+                return new.keys, new.weights, new.slots, failed
+
+            return a2a.exchange_push(
+                flat, g.reshape(-1, dim), apply_fn, owner,
+                sentinel=sentinel, num_shards=spec.num_shards,
+                grid_axes=grid_axes, grid_sizes=grid_sizes,
+                split_axes=split_axes, split_sizes=split_sizes,
+                capacity=spec.a2a_capacity, slack=spec.a2a_slack)
+    else:
+        def _apply(keys, weights, slots, init_rng, idx, g):
+            flat = idx.ravel()
+            g2 = g.reshape(-1, dim)
+            if batch_sharded:
+                flat = lax.all_gather(flat, spec.data_axis, tiled=True)
+                g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
+            flat = _mask_non_owned(spec, flat,
+                                   lax.axis_index(spec.model_axis))
+            local = hash_lib.HashTableState(
+                keys=keys, weights=weights, slots=slots, init_rng=init_rng,
+                insert_failures=jnp.zeros((), jnp.int32))
+            new = hash_lib.apply_gradients(
+                local, optimizer, initializer, flat, g2,
+                dedup_capacity=dedup_capacity, max_probes=spec.max_probes)
+            # per-shard failure deltas -> replicated global total
+            failed = lax.psum(new.insert_failures, spec.model_axis)
+            return new.keys, new.weights, new.slots, failed
+
+    row = spec.row_spec()
+    slot_specs = {name: row for name in slot_names}
     fn = shard_map(_apply, mesh=mesh,
-                   in_specs=(P(m), P(m), slot_specs, P(),
+                   in_specs=(row, row, slot_specs, P(),
                              batch_spec, batch_spec),
-                   out_specs=(P(m), P(m), slot_specs, P()),
+                   out_specs=(row, row, slot_specs, P()),
                    check_vma=False)
     return jax.jit(fn)
 
@@ -253,7 +343,7 @@ def apply_gradients_sharded(state: hash_lib.HashTableState,
                             batch_sharded: bool = True,
                             dedup_capacity: Optional[int] = None
                             ) -> hash_lib.HashTableState:
-    """Distributed push+update: all_gather batch, each shard updates its keys."""
+    """Distributed push+update: each key's grads reach its single owner shard."""
     dim = state.weights.shape[-1]
     optimizer = make_optimizer(optimizer)
     initializer = make_initializer(initializer) if initializer is not None \

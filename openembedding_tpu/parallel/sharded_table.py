@@ -24,6 +24,16 @@ Layouts:
   row r // S. Robust to frequency-skewed sequential ids.
 * ``div``   (block): r -> shard r // rows_per_shard. Matches NamedSharding's
   natural blocking; best when keys are pre-hashed (uniform).
+
+Data planes (``ShardingSpec.plane``):
+* ``"a2a"`` (default) — owner-routed all-to-all exchange (see
+  ``parallel/alltoall.py``): tables sharded over the WHOLE mesh (data x
+  model), per-device traffic O(batch_slice * dim). The reference's
+  dedup->shard->request->scatter pipeline, TPU-native.
+* ``"psum"`` — tables sharded over the model axis only (replicated across
+  the data axis); pull = gather + psum, push = all_gather + masked local
+  update. Simpler program, more ICI bytes and D-fold HBM replication; kept
+  as the ablation baseline and for meshes where replicas are wanted.
 """
 
 from __future__ import annotations
@@ -41,9 +51,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..meta import EmbeddingVariableMeta
+from ..ops import dedup
 from ..optim.initializers import make_initializer
 from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import table as table_lib
+from . import alltoall as a2a
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -56,6 +68,19 @@ class ShardingSpec:
     layout: str = "mod"  # "mod" | "div"
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
+    plane: str = "a2a"   # "a2a" | "psum"
+    a2a_capacity: int = 0    # per-destination bucket rows; 0 = auto
+    a2a_slack: float = 2.0   # auto capacity = slack * mean bucket size
+
+    @property
+    def shard_axes(self) -> tuple:
+        """Mesh axes the table's row dimension is sharded over."""
+        if self.plane == "a2a":
+            return (self.data_axis, self.model_axis)
+        return (self.model_axis,)
+
+    def row_spec(self) -> P:
+        return P(self.shard_axes)
 
     @property
     def padded_vocab(self) -> int:
@@ -74,21 +99,32 @@ class ShardingSpec:
 
 def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
                        num_shards: int = -1, layout: str = "mod",
-                       capacity: Optional[int] = None) -> ShardingSpec:
-    """num_shards=-1 => one shard per model-axis slice (reference default)."""
+                       capacity: Optional[int] = None,
+                       plane: str = "a2a",
+                       a2a_capacity: int = 0,
+                       a2a_slack: float = 2.0) -> ShardingSpec:
+    """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum").
+
+    The reference's shard-per-server default (WorkerContext.cpp:66-85): on
+    the a2a plane every chip is a "server", on the psum plane every model
+    slice is one (its data-axis replicas mirror each other).
+    """
     if layout not in ("mod", "div"):
         raise ValueError(f"unknown layout {layout!r}")
-    model_size = mesh.shape[MODEL_AXIS]
+    if plane not in ("a2a", "psum"):
+        raise ValueError(f"unknown plane {plane!r}")
+    want = mesh.size if plane == "a2a" else mesh.shape[MODEL_AXIS]
     if num_shards == -1:
-        num_shards = model_size
-    if num_shards != model_size:
+        num_shards = want
+    if num_shards != want:
         raise ValueError(
-            f"num_shards={num_shards} must equal mesh model axis size "
-            f"{model_size} (use a different mesh or -1)")
+            f"num_shards={num_shards} must equal the {plane}-plane shard "
+            f"count {want} for this mesh (or pass -1)")
     vocab = capacity if capacity is not None else meta.vocabulary_size
     rows_per_shard = math.ceil(vocab / num_shards)
     return ShardingSpec(num_shards=num_shards, rows_per_shard=rows_per_shard,
-                        layout=layout)
+                        layout=layout, plane=plane,
+                        a2a_capacity=a2a_capacity, a2a_slack=a2a_slack)
 
 
 def create_sharded_table(meta: EmbeddingVariableMeta,
@@ -113,8 +149,11 @@ def create_sharded_table(meta: EmbeddingVariableMeta,
     dtype = table_lib.resolve_dtype(meta)
     dim = meta.embedding_dim
 
+    axes = spec.shard_axes
+    sizes = tuple(mesh.shape[a] for a in axes)
+
     def _init(key):
-        s = lax.axis_index(spec.model_axis)
+        s = a2a.linear_shard_id(axes, sizes)
         k = jax.random.fold_in(key, s)
         weights = initializer.init(k, (spec.rows_per_shard, dim), dtype)
         slots = optimizer.init_slots(spec.rows_per_shard, dim, dtype)
@@ -128,9 +167,9 @@ def create_sharded_table(meta: EmbeddingVariableMeta,
 
 
 def state_specs(optimizer: SparseOptimizer, dim: int, spec: ShardingSpec):
-    slot_spec = {name: P(spec.model_axis)
-                 for name in optimizer.slot_shapes(dim)}
-    return table_lib.TableState(weights=P(spec.model_axis), slots=slot_spec)
+    row = spec.row_spec()
+    slot_spec = {name: row for name in optimizer.slot_shapes(dim)}
+    return table_lib.TableState(weights=row, slots=slot_spec)
 
 
 def state_shardings(state_specs, mesh: Mesh):
@@ -145,20 +184,51 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
     otherwise rebuild + retrace the shard_map closure every call."""
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    def _pull(weights, idx):
-        s = lax.axis_index(spec.model_axis)
-        flat = idx.ravel()
-        shard, local = spec.shard_and_local(flat)
-        # invalid indices (negative or beyond the padded vocab) are owned by
-        # nobody -> psum returns zero rows, same contract as table_lib.pull
-        owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
-        rows = jnp.take(weights, jnp.where(owned, local, 0), axis=0, mode="clip")
-        rows = jnp.where(owned[:, None], rows, jnp.zeros_like(rows))
-        rows = lax.psum(rows, spec.model_axis)
-        return rows.reshape(idx.shape + (dim,))
+    if spec.plane == "a2a":
+        grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
+            mesh, spec.shard_axes, spec.model_axis, batch_sharded)
+        sentinel = dedup.FILL
+
+        def _pull(weights, idx):
+            me = a2a.linear_shard_id(grid_axes, grid_sizes)
+
+            def resolve(keys):
+                shard, local = spec.shard_and_local(keys)
+                mine = ((keys >= 0) & (keys < spec.padded_vocab)
+                        & (shard == me))
+                rows = jnp.take(weights, jnp.where(mine, local, 0), axis=0,
+                                mode="clip")
+                return jnp.where(mine[:, None], rows, jnp.zeros_like(rows))
+
+            def owner(keys):
+                shard, _ = spec.shard_and_local(keys)
+                valid = (keys >= 0) & (keys < spec.padded_vocab)
+                return jnp.where(valid, shard, spec.num_shards).astype(
+                    jnp.int32)
+
+            rows = a2a.exchange_pull(
+                idx.ravel(), resolve, owner, sentinel=sentinel, dim=dim,
+                num_shards=spec.num_shards, grid_axes=grid_axes,
+                grid_sizes=grid_sizes, split_axes=split_axes,
+                split_sizes=split_sizes, capacity=spec.a2a_capacity,
+                slack=spec.a2a_slack)
+            return rows.reshape(idx.shape + (dim,))
+    else:
+        def _pull(weights, idx):
+            s = lax.axis_index(spec.model_axis)
+            flat = idx.ravel()
+            shard, local = spec.shard_and_local(flat)
+            # invalid indices (negative or beyond the padded vocab) are owned
+            # by nobody -> psum returns zero rows, like table_lib.pull
+            owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
+            rows = jnp.take(weights, jnp.where(owned, local, 0), axis=0,
+                            mode="clip")
+            rows = jnp.where(owned[:, None], rows, jnp.zeros_like(rows))
+            rows = lax.psum(rows, spec.model_axis)
+            return rows.reshape(idx.shape + (dim,))
 
     fn = shard_map(_pull, mesh=mesh,
-                   in_specs=(P(spec.model_axis), batch_spec),
+                   in_specs=(spec.row_spec(), batch_spec),
                    out_specs=batch_spec,
                    check_vma=False)
     return jax.jit(fn)
@@ -190,27 +260,59 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                    slot_names: tuple):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    def _apply(weights, slots, idx, g):
-        s = lax.axis_index(spec.model_axis)
-        flat = idx.ravel()
-        g2 = g.reshape(-1, dim)
-        if batch_sharded:
-            flat = lax.all_gather(flat, spec.data_axis, tiled=True)
-            g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
-        shard, local = spec.shard_and_local(flat)
-        owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
-        # non-owned entries become index -1 -> dropped inside apply_gradients
-        masked = jnp.where(owned, local, -1)
-        local_state = table_lib.TableState(weights=weights, slots=slots)
-        new_state = table_lib.apply_gradients(
-            local_state, optimizer, masked, g2,
-            dedup_capacity=dedup_capacity)
-        return new_state.weights, new_state.slots
+    if spec.plane == "a2a":
+        grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
+            mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
-    slot_specs = {name: P(spec.model_axis) for name in slot_names}
+        def _apply(weights, slots, idx, g):
+            me = a2a.linear_shard_id(grid_axes, grid_sizes)
+            local_state = table_lib.TableState(weights=weights, slots=slots)
+
+            def owner(keys):
+                shard, _ = spec.shard_and_local(keys)
+                valid = (keys >= 0) & (keys < spec.padded_vocab)
+                return jnp.where(valid, shard, spec.num_shards).astype(
+                    jnp.int32)
+
+            def apply_fn(keys, grads, counts):
+                shard, local = spec.shard_and_local(keys)
+                mine = ((keys >= 0) & (keys < spec.padded_vocab)
+                        & (shard == me))
+                masked = jnp.where(mine, local, -1)
+                new = table_lib.apply_gradients(
+                    local_state, optimizer, masked, grads,
+                    dedup_capacity=dedup_capacity, in_counts=counts)
+                return new.weights, new.slots
+
+            return a2a.exchange_push(
+                idx.ravel(), g.reshape(-1, dim), apply_fn, owner,
+                sentinel=dedup.FILL, num_shards=spec.num_shards,
+                grid_axes=grid_axes, grid_sizes=grid_sizes,
+                split_axes=split_axes, split_sizes=split_sizes,
+                capacity=spec.a2a_capacity, slack=spec.a2a_slack)
+    else:
+        def _apply(weights, slots, idx, g):
+            s = lax.axis_index(spec.model_axis)
+            flat = idx.ravel()
+            g2 = g.reshape(-1, dim)
+            if batch_sharded:
+                flat = lax.all_gather(flat, spec.data_axis, tiled=True)
+                g2 = lax.all_gather(g2, spec.data_axis, tiled=True)
+            shard, local = spec.shard_and_local(flat)
+            owned = (shard == s) & (flat >= 0) & (flat < spec.padded_vocab)
+            # non-owned entries become index -1 -> dropped in apply_gradients
+            masked = jnp.where(owned, local, -1)
+            local_state = table_lib.TableState(weights=weights, slots=slots)
+            new_state = table_lib.apply_gradients(
+                local_state, optimizer, masked, g2,
+                dedup_capacity=dedup_capacity)
+            return new_state.weights, new_state.slots
+
+    slot_specs = {name: spec.row_spec() for name in slot_names}
     fn = shard_map(_apply, mesh=mesh,
-                   in_specs=(P(spec.model_axis), slot_specs, batch_spec, batch_spec),
-                   out_specs=(P(spec.model_axis), slot_specs),
+                   in_specs=(spec.row_spec(), slot_specs, batch_spec,
+                             batch_spec),
+                   out_specs=(spec.row_spec(), slot_specs),
                    check_vma=False)
     return jax.jit(fn)
 

@@ -107,12 +107,15 @@ def apply_gradients(state: TableState,
                     indices: jnp.ndarray,
                     grads: jnp.ndarray,
                     *,
-                    dedup_capacity: Optional[int] = None) -> TableState:
+                    dedup_capacity: Optional[int] = None,
+                    in_counts: Optional[jnp.ndarray] = None) -> TableState:
     """Push + update in one step: combine duplicate grads, update touched rows.
 
     ``indices`` is [n] (or any shape), ``grads`` matches with a trailing
     [dim]. Rows not referenced are untouched (no state decay), duplicates are
     summed with counts — the reference's documented sparse-update contract.
+    ``in_counts`` ([n]) marks grads that are already pre-reduced sums of that
+    many originals (owner side of the all-to-all exchange).
     """
     dim = state.dim
     flat_idx = indices.ravel()
@@ -124,7 +127,8 @@ def apply_gradients(state: TableState,
     # negative indices are invalid keys: pull clamps them to row 0, the
     # update must NOT let them wrap around onto a real row.
     valid = valid & (uniq >= 0)
-    summed, counts = dedup.combine_gradients(flat_grads, inverse, capacity)
+    summed, counts = dedup.combine_gradients(flat_grads, inverse, capacity,
+                                             in_counts)
 
     # Gather touched rows + slots; padding slots gather row 0 then are dropped
     # on the scatter, so their (garbage) update never lands.

@@ -1,0 +1,234 @@
+"""Owner-routed all-to-all data plane: parity + routing invariants.
+
+The a2a plane must be numerically indistinguishable from the psum plane (and
+from the single-device core) — same contract the reference enforces between
+its one-node and N-node paths (c_api_test.h matrix). Routing internals
+(bucketing, grid transpose, overflow accounting) are checked separately.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                               EmbeddingVariableMeta, apply_gradients,
+                               create_table, make_optimizer, pull)
+from openembedding_tpu import hash_table as hash_lib
+from openembedding_tpu.parallel import alltoall as a2a
+from openembedding_tpu.parallel import sharded_hash as sh
+from openembedding_tpu.parallel import sharded_table as st
+from openembedding_tpu.parallel.mesh import create_mesh
+
+VOCAB, DIM = 64, 4
+
+
+# --- routing primitives -----------------------------------------------------
+
+def test_bucketize_assigns_dense_slots():
+    owner = jnp.asarray([2, 0, 2, 5, 0, 2], jnp.int32)  # 5 >= num_shards: drop
+    dest, ok = a2a.bucketize(owner, num_shards=4, capacity=2)
+    dest, ok = np.asarray(dest), np.asarray(ok)
+    assert not ok[3] and dest[3] == 4 * 2
+    # owner 0 entries fill slots 0..1 of bucket 0; owner 2 fills bucket 2,
+    # third owner-2 entry overflows capacity 2
+    assert sorted(dest[[1, 4]].tolist()) == [0, 1]
+    in2 = dest[[0, 2, 5]]
+    assert sorted(in2.tolist())[:2] == [2 * 2, 2 * 2 + 1]
+    assert ok.sum() == 4  # one dropped by owner, one by capacity
+
+
+def test_bucket_capacity_floors_and_exact():
+    # small slices are exact (capacity == slice size)
+    assert a2a.bucket_capacity(16, 8) == 16
+    # large slices get slack * mean rounded to 8
+    c = a2a.bucket_capacity(4096, 8, slack=2.0)
+    assert c >= 2 * (4096 // 8) and c % 8 == 0
+    # explicit override wins
+    assert a2a.bucket_capacity(4096, 8, capacity=128) == 128
+
+
+def test_dropped_accumulators_gated(devices8):
+    """Structured-skew overflow is observable via the gated counters."""
+    from openembedding_tpu.utils import observability as obs
+    mesh = create_mesh(1, 8, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=8 * 512)
+    opt = make_optimizer({"category": "sgd", "learning_rate": 0.1})
+    # capacity 4 per destination + 64 keys all owned by shard 0 => drops
+    spec = st.make_sharding_spec(meta, mesh, plane="a2a", a2a_capacity=4)
+    state = st.create_sharded_table(
+        meta, opt, {"category": "constant", "value": 0.0}, mesh=mesh,
+        spec=spec)
+    idx = jnp.asarray(np.arange(0, 8 * 64, 8, dtype=np.int32))  # all ≡ 0 mod 8
+    obs.GLOBAL.reset()
+    obs.set_evaluate_performance(True)
+    try:
+        st.pull_sharded(state, idx, mesh=mesh, spec=spec,
+                        batch_sharded=False).block_until_ready()
+        jax.effects_barrier()
+        snap = obs.GLOBAL.snapshot()
+        assert snap.get("a2a_dropped_pull", {}).get("count", 0) > 0
+    finally:
+        obs.set_evaluate_performance(False)
+        obs.GLOBAL.reset()
+
+
+def test_routing_overflow_counts(devices8):
+    # 1 hot owner: every key lands on shard 0 => overflow for small capacity
+    idx = np.arange(0, 8 * 64, 8, dtype=np.int32)  # all ≡ 0 mod 8
+    n = a2a.routing_overflow(idx, num_shards=8, slice_parts=1,
+                             owner_of=lambda u: u % 8, capacity=16)
+    assert n == 64 - 16
+    # uniform keys with auto capacity: no overflow
+    idx = np.arange(512, dtype=np.int32)
+    assert a2a.routing_overflow(idx, 8, 1, lambda u: u % 8) == 0
+
+
+# --- array-table parity ------------------------------------------------------
+
+@pytest.mark.parametrize("data,model", [(1, 8), (2, 4), (8, 1)])
+def test_a2a_matches_single_and_psum(devices8, data, model):
+    mesh = create_mesh(data, model, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=VOCAB)
+    opt = make_optimizer({"category": "adam", "learning_rate": 0.05})
+    init = {"category": "constant", "value": 0.5}
+    spec = st.make_sharding_spec(meta, mesh, plane="a2a")
+    pspec = st.make_sharding_spec(meta, mesh, plane="psum")
+    assert spec.num_shards == mesh.size
+    assert pspec.num_shards == mesh.shape["model"]
+
+    sharded = st.create_sharded_table(meta, opt, init, mesh=mesh, spec=spec)
+    psharded = st.create_sharded_table(meta, opt, init, mesh=mesh, spec=pspec)
+    single = create_table(meta, opt, init, capacity=spec.padded_vocab)
+
+    rng = np.random.RandomState(0)
+    B = 32
+    for step in range(3):
+        # include invalid ids (negative / out of range): zero rows + dropped
+        idx = rng.randint(-3, VOCAB + 3, size=B).astype(np.int32)
+        grads = rng.randn(B, DIM).astype(np.float32)
+        jidx, jg = jnp.asarray(idx), jnp.asarray(grads)
+
+        got = st.pull_sharded(sharded, jidx, mesh=mesh, spec=spec)
+        shard, local = spec.shard_and_local(jidx)
+        phys = jnp.where((jidx >= 0) & (jidx < VOCAB),
+                         shard * spec.rows_per_shard + local, -1)
+        want = pull(single, phys)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+        # replicated-batch (serving) path agrees
+        got_r = st.pull_sharded(sharded, jidx, mesh=mesh, spec=spec,
+                                batch_sharded=False)
+        np.testing.assert_allclose(np.asarray(got_r), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+        # psum plane agrees
+        got_p = st.pull_sharded(psharded, jidx, mesh=mesh, spec=pspec)
+        np.testing.assert_allclose(np.asarray(got_p), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+        sharded = st.apply_gradients_sharded(sharded, opt, jidx, jg,
+                                             mesh=mesh, spec=spec)
+        single = apply_gradients(single, opt, phys, jg)
+        psharded = st.apply_gradients_sharded(psharded, opt, jidx, jg,
+                                              mesh=mesh, spec=pspec)
+
+    np.testing.assert_allclose(np.asarray(sharded.weights),
+                               np.asarray(single.weights),
+                               rtol=1e-5, atol=1e-5)
+    for k in single.slots:
+        np.testing.assert_allclose(np.asarray(sharded.slots[k]),
+                                   np.asarray(single.slots[k]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_a2a_replicated_batch_apply(devices8):
+    """batch_sharded=False apply: updates land once, not once per device."""
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=VOCAB)
+    opt = make_optimizer({"category": "sgd", "learning_rate": 1.0})
+    init = {"category": "constant", "value": 0.0}
+    spec = st.make_sharding_spec(meta, mesh, plane="a2a")
+    state = st.create_sharded_table(meta, opt, init, mesh=mesh, spec=spec)
+    idx = jnp.asarray([3, 3, 7], jnp.int32)
+    g = jnp.ones((3, DIM), jnp.float32)
+    state = st.apply_gradients_sharded(state, opt, idx, g, mesh=mesh,
+                                       spec=spec, batch_sharded=False)
+    rows = st.pull_sharded(state, jnp.asarray([3, 7], jnp.int32), mesh=mesh,
+                           spec=spec, batch_sharded=False)
+    np.testing.assert_allclose(np.asarray(rows)[0], -2.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rows)[1], -1.0, rtol=1e-6)
+
+
+# --- hash-table parity -------------------------------------------------------
+
+@pytest.mark.parametrize("data,model", [(2, 4), (8, 1)])
+def test_a2a_hash_matches_single(devices8, data, model):
+    mesh = create_mesh(data, model, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer({"category": "adagrad", "learning_rate": 0.1})
+    init = {"category": "constant", "value": 0.25}
+    spec = sh.make_hash_sharding_spec(mesh, total_capacity=2048, plane="a2a")
+    state = sh.create_sharded_hash_table(meta, opt, mesh=mesh, spec=spec)
+    # ground truth: one big single-device table with the same base rng
+    single = hash_lib.create_hash_table(meta, opt, capacity=2048,
+                                        rng=jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(7)
+    B = 32
+    for step in range(3):
+        keys = (rng.randint(0, 1 << 30, size=B) * 2654435761 % (1 << 31)
+                ).astype(np.int32)
+        keys[1] = keys[0]  # duplicates combine
+        g = rng.randn(B, DIM).astype(np.float32)
+        jk, jg = jnp.asarray(keys), jnp.asarray(g)
+        got = sh.pull_sharded(state, jk, init, mesh=mesh, spec=spec)
+        want = hash_lib.pull(single, jk, init)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+        state = sh.apply_gradients_sharded(state, opt, init, jk, jg,
+                                           mesh=mesh, spec=spec)
+        single = hash_lib.apply_gradients(single, opt, init, jk, jg)
+        assert int(state.insert_failures) == 0
+
+    got = sh.pull_sharded(state, jk, None, mesh=mesh, spec=spec)
+    want = hash_lib.pull(single, jk, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+# --- end-to-end through the collection ---------------------------------------
+
+def test_collection_planes_agree(devices8):
+    """Same model trained on a2a and psum planes: identical states."""
+    mesh = create_mesh(2, 4, devices8)
+
+    def run(plane):
+        specs = (
+            EmbeddingSpec(name="bounded", input_dim=VOCAB, output_dim=DIM,
+                          initializer={"category": "constant", "value": 0.1},
+                          plane=plane),
+            EmbeddingSpec(name="hashed", input_dim=-1, output_dim=DIM,
+                          hash_capacity=1024, plane=plane),
+        )
+        coll = EmbeddingCollection(specs, mesh)
+        states = coll.init(jax.random.PRNGKey(3))
+        rng = np.random.RandomState(11)
+        for _ in range(2):
+            inputs = {
+                "bounded": jnp.asarray(
+                    rng.randint(0, VOCAB, size=16).astype(np.int32)),
+                "hashed": jnp.asarray(
+                    (rng.randint(0, 1 << 28, size=16) * 7919).astype(np.int32)),
+            }
+            rows = coll.pull(states, inputs)
+            grads = {k: jnp.ones_like(v) * 0.5 for k, v in rows.items()}
+            states = coll.apply_gradients(states, inputs, grads)
+        rows = coll.pull(states, inputs)
+        return {k: np.asarray(v) for k, v in rows.items()}
+
+    got_a2a = run("a2a")
+    got_psum = run("psum")
+    for k in got_a2a:
+        np.testing.assert_allclose(got_a2a[k], got_psum[k],
+                                   rtol=1e-5, atol=1e-6)
