@@ -41,6 +41,13 @@ def parse_args(argv=None):
     p.add_argument("--no-fused", dest="fused", action="store_false")
     p.add_argument("--hash", action="store_true",
                    help="unbounded hash tables instead of bounded buckets")
+    p.add_argument("--sparse_as_dense", type=int, default=0, metavar="N",
+                   help="keep embeddings with vocab <= N as dense data-"
+                   "parallel params (the reference's --cache hybrid, "
+                   "exb.py:617-632); needs --no-fused")
+    p.add_argument("--plane", default="a2a", choices=["a2a", "psum"],
+                   help="sparse data plane: owner-routed all-to-all "
+                   "(default) or the psum/all_gather baseline")
     p.add_argument("--data_parallel", type=int, default=1,
                    help="mesh data-axis size")
     p.add_argument("--save", default="", help="checkpoint dir to write")
@@ -74,17 +81,30 @@ def main(argv=None):
                   "learning_rate": args.learning_rate}
 
     if args.fused:
+        if args.sparse_as_dense:
+            print("--sparse_as_dense needs --no-fused (a fused group is one "
+                  "big table); ignoring")
         specs, mapper = make_fused_specs(
             features, vocab, args.embedding_dim, optimizer=opt_config,
-            hash_capacity=1 << 22)
+            hash_capacity=1 << 22, plane=args.plane)
+        dense_specs = ()
     else:
         specs = deepctr.make_feature_specs(
             features, vocab, args.embedding_dim, optimizer=opt_config,
-            hash_capacity=1 << 22)
+            hash_capacity=1 << 22, plane=args.plane)
         mapper = None
+        if args.sparse_as_dense:
+            from openembedding_tpu import split_sparse_dense
+            specs, dense_specs = split_sparse_dense(
+                specs, args.sparse_as_dense, batch_size=args.batch_size)
+            print(f"sparse_as_dense: {len(dense_specs)} dense-kept, "
+                  f"{len(specs)} sharded")
+        else:
+            dense_specs = ()
     coll = EmbeddingCollection(specs, mesh)
     model = deepctr.build_model(args.model, features)
-    trainer = Trainer(model, coll, optax.adam(args.dense_lr))
+    trainer = Trainer(model, coll, optax.adam(args.dense_lr),
+                      sparse_as_dense=dense_specs or None)
 
     def batches(limit):
         if args.data:

@@ -27,4 +27,6 @@ from .optim.optimizers import make_optimizer, SparseOptimizer
 from .optim.initializers import make_initializer, Initializer
 from .embedding import EmbeddingSpec, EmbeddingCollection
 from .fused import FusedMapper, make_fused_specs
+from .hybrid import (DenseEmbeddings, DenseFeatureSpec, HybridModel,
+                     split_sparse_dense)
 from .training import Trainer, TrainState, binary_logloss

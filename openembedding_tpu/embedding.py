@@ -140,10 +140,15 @@ class EmbeddingCollection:
             for name in self.specs
         ]
         variables.sort(key=lambda v: v.variable_id)
+        # top-level num_shards is the max over variables (informational);
+        # the exact per-variable counts ride in extra for mixed-plane models
         num_shards = max((s.num_shards for s in self._shardings.values()),
                          default=1)
-        return ModelMeta(model_sign=model_sign, model_uri=model_uri,
+        meta = ModelMeta(model_sign=model_sign, model_uri=model_uri,
                          variables=variables, num_shards=num_shards)
+        meta.extra["variable_num_shards"] = {
+            name: s.num_shards for name, s in self._shardings.items()}
+        return meta
 
     # --- state lifecycle ---------------------------------------------------
     def init(self, rng: Optional[jax.Array] = None,

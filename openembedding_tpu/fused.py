@@ -109,7 +109,8 @@ def make_fused_specs(feature_names: Sequence[str],
                      initializer: Any = None,
                      hash_capacity: int = 2**20,
                      key_dtype: str = "int32",
-                     num_shards: int = -1
+                     num_shards: int = -1,
+                     plane: str = "a2a"
                      ) -> Tuple[Tuple[EmbeddingSpec, ...], FusedMapper]:
     """Specs + mapper for one fused table over ``feature_names``.
 
@@ -136,12 +137,12 @@ def make_fused_specs(feature_names: Sequence[str],
         name=name, input_dim=input_dim, output_dim=embedding_dim,
         dtype=dtype, optimizer=optimizer, initializer=emb_init,
         hash_capacity=hash_capacity, key_dtype=key_dtype,
-        num_shards=num_shards)]
+        num_shards=num_shards, plane=plane)]
     if need_linear:
         specs.append(EmbeddingSpec(
             name=name + LINEAR_SUFFIX, input_dim=input_dim, output_dim=1,
             dtype=dtype, optimizer=optimizer,
             initializer={"category": "constant", "value": 0.0},
             hash_capacity=hash_capacity, key_dtype=key_dtype,
-            num_shards=num_shards))
+            num_shards=num_shards, plane=plane))
     return tuple(specs), mapper

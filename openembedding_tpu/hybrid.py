@@ -1,0 +1,149 @@
+"""``sparse_as_dense`` hybrid: small-vocab embeddings as dense params.
+
+Capability parity with the reference's "Cache" mode: embeddings whose vocab
+is small (``input_dim <= sparse_as_dense_size``, default 64, or smaller than
+the batch) are kept as *worker-side dense variables* updated by the plain
+dense optimizer and allreduced with the rest of the model, while big tables
+stay on the sharded PS path — a documented ~+10% benchmark configuration
+(/root/reference/openembedding/tensorflow/exb.py:100-104,241-248 gather +
+unsorted_segment_sum variables; exb.py:617-632 automatic threshold at model
+conversion; documents/en/benchmark.md:24-37).
+
+TPU-native shape: a dense-kept feature is an ordinary flax param (replicated
+over the mesh, optax-updated, grads all-reduced by XLA over the data axis).
+JAX differentiates the gather into exactly the scatter-add the reference
+hand-writes as its custom gradient. Like the reference, dense-kept features
+follow *dense* optimizer semantics (momentum/decay applied every step, not
+only on touched rows — README.md:240 documents the same divergence).
+
+Usage::
+
+    specs = make_feature_specs(names, vocabs, dim)
+    sparse_specs, dense_specs = split_sparse_dense(specs, 64)
+    coll = EmbeddingCollection(sparse_specs, mesh)
+    trainer = Trainer(model, coll, optax.adagrad(...),
+                      sparse_as_dense=dense_specs)
+
+The Trainer wraps the model so dense-kept rows are computed inside the flax
+apply; batches keep one ``sparse`` dict — the Trainer routes each column to
+the right path by name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .embedding import EmbeddingSpec
+from .optim.initializers import make_initializer
+from . import table as table_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseFeatureSpec:
+    """Static description of one dense-kept (sparse_as_dense) feature."""
+
+    name: str
+    input_dim: int
+    output_dim: int
+    dtype: str = "float32"
+    initializer: Optional[tuple] = None  # frozen config items or None
+
+
+def _freeze_config(cfg) -> Optional[tuple]:
+    if cfg is None:
+        return None
+    if isinstance(cfg, dict):
+        return tuple(sorted(cfg.items()))
+    return cfg
+
+
+def _thaw_config(cfg):
+    return dict(cfg) if isinstance(cfg, tuple) else cfg
+
+
+def to_dense_spec(spec: EmbeddingSpec) -> DenseFeatureSpec:
+    if spec.use_hash:
+        raise ValueError(
+            f"hash variable {spec.name!r} cannot be kept dense "
+            "(unbounded key space; the reference's threshold only ever "
+            "converts bounded vocabs, exb.py:617-632)")
+    return DenseFeatureSpec(
+        name=spec.name, input_dim=spec.input_dim, output_dim=spec.output_dim,
+        dtype=spec.dtype, initializer=_freeze_config(spec.initializer))
+
+
+def split_sparse_dense(specs: Sequence[EmbeddingSpec],
+                       sparse_as_dense_size: int = 64,
+                       batch_size: Optional[int] = None
+                       ) -> Tuple[Tuple[EmbeddingSpec, ...],
+                                  Tuple[DenseFeatureSpec, ...]]:
+    """Partition specs into (sharded sparse, dense-kept) by vocab size.
+
+    The reference's conversion rule (exb.py:602,617-632): bounded vocab
+    ``<= sparse_as_dense_size`` — or smaller than the global batch, when
+    given — is cheaper as a dense variable than as PS traffic.
+    """
+    sparse, dense = [], []
+    for spec in specs:
+        small = (not spec.use_hash) and (
+            spec.input_dim <= sparse_as_dense_size
+            or (batch_size is not None and spec.input_dim < batch_size))
+        (dense if small else sparse).append(spec)
+    return tuple(sparse), tuple(to_dense_spec(s) for s in dense)
+
+
+class DenseEmbeddings(nn.Module):
+    """Flax module owning the dense-kept embedding tables.
+
+    Lookup keeps the framework's invalid-index contract (negative or
+    out-of-range ids -> zero rows, gradients dropped), so a feature behaves
+    identically on either path.
+    """
+
+    specs: Tuple[DenseFeatureSpec, ...]
+
+    @nn.compact
+    def __call__(self, ids: Dict[str, jnp.ndarray]
+                 ) -> Dict[str, jnp.ndarray]:
+        rows = {}
+        for s in self.specs:
+            if s.name not in ids:
+                continue
+            init = make_initializer(
+                _thaw_config(s.initializer) or table_lib.DEFAULT_INITIALIZER)
+            table = self.param(
+                s.name,
+                lambda key, shape, dtype, _i=init: _i.init(key, shape, dtype),
+                (s.input_dim, s.output_dim), jnp.dtype(s.dtype))
+            idx = ids[s.name]
+            flat = idx.ravel()
+            valid = (flat >= 0) & (flat < s.input_dim)
+            r = jnp.take(table, jnp.where(valid, flat, 0), axis=0,
+                         mode="clip")
+            r = jnp.where(valid[:, None], r, jnp.zeros_like(r))
+            rows[s.name] = r.reshape(idx.shape + (s.output_dim,))
+        return rows
+
+
+class HybridModel(nn.Module):
+    """Inner CTR model + dense-kept embeddings in one flax apply.
+
+    ``__call__(dense, rows, dense_ids)``: looks up ``dense_ids`` in the
+    module-owned tables, merges with the sharded-path ``rows`` and runs the
+    inner model — the reference's converted model where some Embedding
+    layers became plain tf.Variables and the rest PS variables.
+    """
+
+    inner: nn.Module
+    dense_specs: Tuple[DenseFeatureSpec, ...]
+
+    @nn.compact
+    def __call__(self, dense, rows: Dict[str, jnp.ndarray],
+                 dense_ids: Dict[str, jnp.ndarray]):
+        drows = DenseEmbeddings(self.dense_specs, name="sparse_as_dense")(
+            dense_ids)
+        return self.inner(dense, {**rows, **drows})

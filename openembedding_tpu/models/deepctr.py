@@ -40,7 +40,8 @@ def make_feature_specs(feature_names: Sequence[str],
                        optimizer: Any = None,
                        initializer: Any = None,
                        hash_capacity: int = 2**20,
-                       num_shards: int = -1) -> Tuple[EmbeddingSpec, ...]:
+                       num_shards: int = -1,
+                       plane: str = "a2a") -> Tuple[EmbeddingSpec, ...]:
     """Build the spec list for a set of categorical features.
 
     ``vocab_sizes``: int per feature, or a single int, or -1 for the hash
@@ -59,13 +60,14 @@ def make_feature_specs(feature_names: Sequence[str],
         specs.append(EmbeddingSpec(
             name=name, input_dim=vocab, output_dim=embedding_dim,
             dtype=dtype, optimizer=optimizer, initializer=emb_init,
-            hash_capacity=hash_capacity, num_shards=num_shards))
+            hash_capacity=hash_capacity, num_shards=num_shards, plane=plane))
         if need_linear:
             specs.append(EmbeddingSpec(
                 name=name + LINEAR_SUFFIX, input_dim=vocab, output_dim=1,
                 dtype=dtype, optimizer=optimizer,
                 initializer={"category": "constant", "value": 0.0},
-                hash_capacity=hash_capacity, num_shards=num_shards))
+                hash_capacity=hash_capacity, num_shards=num_shards,
+                plane=plane))
     return tuple(specs)
 
 

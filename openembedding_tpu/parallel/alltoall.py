@@ -51,20 +51,24 @@ from ..ops import dedup
 from ..utils import observability
 
 
-def _record_drops(counter: str, local_dropped: jnp.ndarray) -> None:
+def _record_drops(counter: str, local_dropped: jnp.ndarray,
+                  record: bool) -> None:
     """Gated host accumulation of routed-exchange drops.
 
-    When ``observability.set_evaluate_performance(True)`` is on at **trace
-    time**, every execution adds each device's dropped-entry count to the
-    global accumulator (their sum is the global total) — the same gate the
-    reference puts on its pull_indices/pull_unique counters
+    ``record`` is the trace-time gate (callers thread
+    ``observability.evaluate_performance()`` through their program-cache key
+    so toggling it compiles the right program) — the same gate the reference
+    puts on its pull_indices/pull_unique counters
     (EmbeddingPullOperator.cpp:208-209,244-248). Off by default: a host
-    callback per step would stall TPU pipelining.
+    callback per step would stall TPU pipelining. The callback re-checks the
+    gate at run time so a program traced with recording on goes quiet when
+    the gate is turned off.
     """
-    if observability.evaluate_performance():
-        jax.debug.callback(
-            lambda d: observability.GLOBAL.add(counter, int(d)),
-            local_dropped)
+    if record:
+        def _cb(d):
+            if observability.evaluate_performance():
+                observability.GLOBAL.add(counter, int(d))
+        jax.debug.callback(_cb, local_dropped)
 
 
 def linear_shard_id(axes: Sequence[str], sizes: Sequence[int]) -> jnp.ndarray:
@@ -207,7 +211,8 @@ def exchange_pull(flat_idx: jnp.ndarray,
                   split_axes: Sequence[str],
                   split_sizes: Sequence[int],
                   capacity: int = 0,
-                  slack: float = 2.0) -> jnp.ndarray:
+                  slack: float = 2.0,
+                  record_drops: bool = False) -> jnp.ndarray:
     """Owner-routed lookup of ``flat_idx`` [n] -> rows [n, dim].
 
     ``flat_idx`` must be identical on all ``split_axes`` peers (they divide
@@ -224,7 +229,8 @@ def exchange_pull(flat_idx: jnp.ndarray,
     owners = owner_fn(uniq)
     dest, ok = bucketize(owners, num_shards, cap)
     _record_drops("a2a_dropped_pull",
-                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32))
+                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32),
+                  record_drops)
     send = fill_buckets(uniq, dest, num_shards, cap, sentinel)
     req = grid_all_to_all(send, grid_axes, grid_sizes)
     rows = resolve_fn(req.ravel())
@@ -251,14 +257,18 @@ def exchange_push(flat_idx: jnp.ndarray,
                   split_axes: Sequence[str],
                   split_sizes: Sequence[int],
                   capacity: int = 0,
-                  slack: float = 2.0):
+                  slack: float = 2.0,
+                  record_drops: bool = False):
     """Owner-routed push: pre-reduce, route (key, grad sum, count) to owners.
 
     ``apply_fn(keys [K], grads [K, dim], counts [K])`` runs on the owner with
     the merged per-peer pre-reduces and returns its updated local state
-    (whatever pytree it likes). Entries with count 0 / sentinel key are
-    padding and must be ignored by ``apply_fn`` (both built-in appliers drop
-    them via the invalid-key contract).
+    (whatever pytree it likes). Entries with a sentinel key are padding and
+    must be ignored by ``apply_fn`` (both built-in appliers drop them via the
+    invalid-key contract; their count values are garbage by design).
+
+    Keys and counts share one integer exchange buffer ([.., 2] channels) so
+    a push costs two collectives per mesh axis, not three.
     """
     dim = grads.shape[-1]
     my_part = linear_shard_id(split_axes, split_sizes)
@@ -271,15 +281,16 @@ def exchange_push(flat_idx: jnp.ndarray,
     owners = owner_fn(uniq)
     dest, ok = bucketize(owners, num_shards, cap)
     _record_drops("a2a_dropped_push",
-                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32))
-    send_k = fill_buckets(uniq, dest, num_shards, cap, sentinel)
+                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32),
+                  record_drops)
+    kc = jnp.stack([uniq, counts.astype(uniq.dtype)], axis=1)  # [m, 2]
+    send_kc = fill_buckets(kc, dest, num_shards, cap, sentinel)
     send_g = fill_buckets(summed, dest, num_shards, cap, 0)
-    send_c = fill_buckets(counts, dest, num_shards, cap, 0)
-    rk = grid_all_to_all(send_k, grid_axes, grid_sizes)
+    rkc = grid_all_to_all(send_kc, grid_axes, grid_sizes)
     rg = grid_all_to_all(send_g, grid_axes, grid_sizes)
-    rc = grid_all_to_all(send_c, grid_axes, grid_sizes)
-    k = rk.ravel()
-    return apply_fn(k, rg.reshape((k.shape[0], dim)), rc.ravel())
+    k = rkc[..., 0].ravel()
+    rc = rkc[..., 1].ravel().astype(jnp.int32)
+    return apply_fn(k, rg.reshape((k.shape[0], dim)), rc)
 
 
 def routing_overflow(indices, num_shards: int, slice_parts: int,

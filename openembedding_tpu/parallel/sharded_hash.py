@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..meta import EmbeddingVariableMeta
+from ..utils import observability
 from ..optim.initializers import make_initializer
 from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import hash_table as hash_lib
@@ -192,7 +193,8 @@ def insert_rows_sharded(state: hash_lib.HashTableState,
 
 @functools.lru_cache(maxsize=None)
 def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
-                  dim: int, batch_sharded: bool):
+                  dim: int, batch_sharded: bool,
+                  record_drops: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
     if spec.plane == "a2a":
@@ -222,7 +224,7 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                 num_shards=spec.num_shards, grid_axes=grid_axes,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
-                slack=spec.a2a_slack)
+                slack=spec.a2a_slack, record_drops=record_drops)
             return rows.reshape(idx.shape + (dim,))
     else:
         def _pull(keys, weights, init_rng, idx):
@@ -260,7 +262,8 @@ def pull_sharded(state: hash_lib.HashTableState,
     dim = state.weights.shape[-1]
     if initializer is not None:
         initializer = make_initializer(initializer)
-    fn = _pull_program(mesh, spec, initializer, dim, batch_sharded)
+    fn = _pull_program(mesh, spec, initializer, dim, batch_sharded,
+                       observability.evaluate_performance())
     return fn(state.keys, state.weights, state.init_rng, indices)
 
 
@@ -268,7 +271,7 @@ def pull_sharded(state: hash_lib.HashTableState,
 def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                    optimizer: SparseOptimizer, initializer: Any, dim: int,
                    batch_sharded: bool, dedup_capacity: Optional[int],
-                   slot_names: tuple):
+                   slot_names: tuple, record_drops: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
     if spec.plane == "a2a":
@@ -302,7 +305,8 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                 sentinel=sentinel, num_shards=spec.num_shards,
                 grid_axes=grid_axes, grid_sizes=grid_sizes,
                 split_axes=split_axes, split_sizes=split_sizes,
-                capacity=spec.a2a_capacity, slack=spec.a2a_slack)
+                capacity=spec.a2a_capacity, slack=spec.a2a_slack,
+                record_drops=record_drops)
     else:
         def _apply(keys, weights, slots, init_rng, idx, g):
             flat = idx.ravel()
@@ -349,7 +353,8 @@ def apply_gradients_sharded(state: hash_lib.HashTableState,
     initializer = make_initializer(initializer) if initializer is not None \
         else None
     fn = _apply_program(mesh, spec, optimizer, initializer, dim,
-                        batch_sharded, dedup_capacity, tuple(state.slots))
+                        batch_sharded, dedup_capacity, tuple(state.slots),
+                        observability.evaluate_performance())
     keys, weights, slots, failed = fn(
         state.keys, state.weights, state.slots, state.init_rng,
         indices, grads)

@@ -52,6 +52,7 @@ from jax import shard_map
 
 from ..meta import EmbeddingVariableMeta
 from ..ops import dedup
+from ..utils import observability
 from ..optim.initializers import make_initializer
 from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import table as table_lib
@@ -179,7 +180,7 @@ def state_shardings(state_specs, mesh: Mesh):
 
 @functools.lru_cache(maxsize=None)
 def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
-                  batch_sharded: bool):
+                  batch_sharded: bool, record_drops: bool = False):
     """Cached jitted pull: eager callers (serving lookups, tests) would
     otherwise rebuild + retrace the shard_map closure every call."""
     batch_spec = P(spec.data_axis) if batch_sharded else P()
@@ -211,7 +212,7 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
                 num_shards=spec.num_shards, grid_axes=grid_axes,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
-                slack=spec.a2a_slack)
+                slack=spec.a2a_slack, record_drops=record_drops)
             return rows.reshape(idx.shape + (dim,))
     else:
         def _pull(weights, idx):
@@ -249,7 +250,8 @@ def pull_sharded(state: table_lib.TableState,
     gather + one psum over ICI.
     """
     dim = state.weights.shape[-1]
-    fn = _pull_program(mesh, spec, dim, batch_sharded)
+    fn = _pull_program(mesh, spec, dim, batch_sharded,
+                       observability.evaluate_performance())
     return fn(state.weights, indices)
 
 
@@ -257,7 +259,7 @@ def pull_sharded(state: table_lib.TableState,
 def _apply_program(mesh: Mesh, spec: ShardingSpec,
                    optimizer: SparseOptimizer, dim: int,
                    batch_sharded: bool, dedup_capacity: Optional[int],
-                   slot_names: tuple):
+                   slot_names: tuple, record_drops: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
     if spec.plane == "a2a":
@@ -289,7 +291,8 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                 sentinel=dedup.FILL, num_shards=spec.num_shards,
                 grid_axes=grid_axes, grid_sizes=grid_sizes,
                 split_axes=split_axes, split_sizes=split_sizes,
-                capacity=spec.a2a_capacity, slack=spec.a2a_slack)
+                capacity=spec.a2a_capacity, slack=spec.a2a_slack,
+                record_drops=record_drops)
     else:
         def _apply(weights, slots, idx, g):
             s = lax.axis_index(spec.model_axis)
@@ -337,6 +340,7 @@ def apply_gradients_sharded(state: table_lib.TableState,
     dim = state.weights.shape[-1]
     optimizer = make_optimizer(optimizer)
     fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
-                        dedup_capacity, tuple(state.slots))
+                        dedup_capacity, tuple(state.slots),
+                        observability.evaluate_performance())
     weights, slots = fn(state.weights, state.slots, indices, grads)
     return table_lib.TableState(weights=weights, slots=slots)

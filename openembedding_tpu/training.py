@@ -60,7 +60,20 @@ class Trainer:
 
     def __init__(self, module, collection: EmbeddingCollection,
                  dense_optimizer: optax.GradientTransformation,
-                 loss_fn: Callable = binary_logloss):
+                 loss_fn: Callable = binary_logloss,
+                 sparse_as_dense: Optional[Any] = None):
+        """``sparse_as_dense``: DenseFeatureSpecs (from
+        ``hybrid.split_sparse_dense``) kept as flax params inside the model —
+        the reference's "Cache" hybrid. Batch ``sparse`` columns are routed
+        by name: dense-kept features never touch the sharded path."""
+        if sparse_as_dense:
+            from .hybrid import HybridModel
+            module = HybridModel(inner=module,
+                                 dense_specs=tuple(sparse_as_dense))
+            self._dense_names = frozenset(
+                s.name for s in sparse_as_dense)
+        else:
+            self._dense_names = frozenset()
         self.module = module
         self.collection = collection
         self.tx = dense_optimizer
@@ -72,18 +85,40 @@ class Trainer:
         self._eval_step = None
 
     # --- initialization ----------------------------------------------------
+    def _split_sparse(self, sparse: Dict[str, Any]):
+        """Route batch columns: sharded-path inputs vs dense-kept ids."""
+        if not self._dense_names:
+            return sparse, None
+        pull = {k: v for k, v in sparse.items() if k not in self._dense_names}
+        dense_ids = {k: v for k, v in sparse.items()
+                     if k in self._dense_names}
+        return pull, dense_ids
+
+    def _apply(self, params, dense, rows, dense_ids):
+        if self._dense_names:
+            return self.module.apply({"params": params}, dense, rows,
+                                     dense_ids)
+        return self.module.apply({"params": params}, dense, rows)
+
     def init(self, rng: jax.Array, sample_batch: Dict[str, Any]) -> TrainState:
         """Initialize dense params (replicated) + all embedding tables."""
         emb_rng, dense_rng = jax.random.split(rng)
         emb = self.collection.init(emb_rng)
+        pull_inputs, dense_ids = self._split_sparse(sample_batch["sparse"])
         # dense init only needs row SHAPES — zeros via eval_shape avoid
         # dispatching one pull program per variable before training starts
         row_shapes = jax.eval_shape(
             lambda e, s: self.collection.pull(e, s, batch_sharded=False),
-            emb, sample_batch["sparse"])
+            emb, pull_inputs)
         rows = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
                             row_shapes)
-        variables = self.module.init(dense_rng, sample_batch.get("dense"), rows)
+        if self._dense_names:
+            variables = self.module.init(dense_rng,
+                                         sample_batch.get("dense"), rows,
+                                         dense_ids)
+        else:
+            variables = self.module.init(dense_rng,
+                                         sample_batch.get("dense"), rows)
         params = variables["params"]
         set_repl = partial(jax.device_put, device=self._replicated)
         params = jax.tree.map(set_repl, params)
@@ -93,16 +128,15 @@ class Trainer:
 
     # --- steps ---------------------------------------------------------------
     def _build_train_step(self):
-        collection, module, tx, loss_fn = (self.collection, self.module,
-                                           self.tx, self.loss_fn)
+        collection, tx, loss_fn = self.collection, self.tx, self.loss_fn
 
         def step_fn(state: TrainState, batch) -> tuple:
-            sparse = batch["sparse"]
-            rows = collection.pull(state.emb, sparse)
+            pull_inputs, dense_ids = self._split_sparse(batch["sparse"])
+            rows = collection.pull(state.emb, pull_inputs)
 
             def lfn(params, rows):
-                logits = module.apply({"params": params},
-                                      batch.get("dense"), rows)
+                logits = self._apply(params, batch.get("dense"), rows,
+                                     dense_ids)
                 return loss_fn(logits, batch["label"])
 
             loss, (dense_g, row_g) = jax.value_and_grad(
@@ -110,7 +144,7 @@ class Trainer:
             updates, opt_state = tx.update(dense_g, state.opt_state,
                                            state.params)
             params = optax.apply_updates(state.params, updates)
-            emb = collection.apply_gradients(state.emb, sparse, row_g)
+            emb = collection.apply_gradients(state.emb, pull_inputs, row_g)
             new_state = TrainState(step=state.step + 1, params=params,
                                    opt_state=opt_state, emb=emb)
             return new_state, {"loss": loss}
@@ -118,12 +152,13 @@ class Trainer:
         return jax.jit(step_fn, donate_argnums=(0,))
 
     def _build_eval_step(self):
-        collection, module = self.collection, self.module
+        collection = self.collection
 
         def eval_fn(state: TrainState, batch):
-            rows = collection.pull(state.emb, batch["sparse"])
-            logits = module.apply({"params": state.params},
-                                  batch.get("dense"), rows)
+            pull_inputs, dense_ids = self._split_sparse(batch["sparse"])
+            rows = collection.pull(state.emb, pull_inputs)
+            logits = self._apply(state.params, batch.get("dense"), rows,
+                                 dense_ids)
             return jax.nn.sigmoid(logits.reshape(-1))
 
         return jax.jit(eval_fn)
