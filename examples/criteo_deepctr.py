@@ -48,6 +48,10 @@ def parse_args(argv=None):
     p.add_argument("--plane", default="a2a", choices=["a2a", "psum"],
                    help="sparse data plane: owner-routed all-to-all "
                    "(default) or the psum/all_gather baseline")
+    p.add_argument("--hist_len", type=int, default=0, metavar="L",
+                   help="add a DIN-style variable-length behavior-history "
+                   "feature (padded to L, mean-pooled; reference "
+                   "RaggedTensor lookups). Synthetic data + --no-fused only")
     p.add_argument("--data_parallel", type=int, default=1,
                    help="mesh data-axis size")
     p.add_argument("--save", default="", help="checkpoint dir to write")
@@ -101,6 +105,19 @@ def main(argv=None):
                   f"{len(specs)} sharded")
         else:
             dense_specs = ()
+    hist = args.hist_len and not args.fused and not args.data
+    if args.hist_len and not hist:
+        print("--hist_len needs --no-fused and synthetic data; ignoring")
+    if hist:
+        from openembedding_tpu import EmbeddingSpec
+        features = tuple(features) + ("hist",)
+        specs = tuple(specs) + (
+            EmbeddingSpec(name="hist", input_dim=vocab, output_dim=args.embedding_dim,
+                          optimizer=opt_config, pooling="mean",
+                          hash_capacity=1 << 22, plane=args.plane),
+            EmbeddingSpec(name="hist:linear", input_dim=vocab, output_dim=1,
+                          optimizer=opt_config, pooling="sum",
+                          hash_capacity=1 << 22, plane=args.plane))
     coll = EmbeddingCollection(specs, mesh)
     model = deepctr.build_model(args.model, features)
     trainer = Trainer(model, coll, optax.adam(args.dense_lr),
@@ -120,7 +137,25 @@ def main(argv=None):
                                              num_batches=limit)
         if mapper is not None:
             return (mapper.fuse_batch(b) for b in reader)
-        return criteo.add_linear_columns(reader)
+        reader = criteo.add_linear_columns(reader)
+        if hist:
+            from openembedding_tpu import pad_id_for, pad_ragged
+            pad = pad_id_for(coll.specs["hist"])  # EMPTY sentinel for --hash
+            rng = np.random.RandomState(7)
+
+            def with_hist(it):
+                for b in it:
+                    n = len(b["label"])
+                    h = pad_ragged(
+                        [rng.randint(0, max(args.num_buckets, 2),
+                                     rng.randint(0, args.hist_len + 1))
+                         for _ in range(n)], max_len=args.hist_len,
+                        pad_id=pad)
+                    b["sparse"] = {**b["sparse"], "hist": h,
+                                   "hist:linear": h}
+                    yield b
+            reader = with_hist(reader)
+        return reader
 
     it = iter(batches(args.steps + 1))
     first = next(it)

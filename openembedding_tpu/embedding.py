@@ -45,6 +45,7 @@ from . import table as table_lib
 from .parallel import sharded_table as st
 from .parallel import sharded_hash as sh
 from .parallel.mesh import MODEL_AXIS
+from . import ragged
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +67,10 @@ class EmbeddingSpec:
     plane: str = "a2a"               # "a2a" owner-routed | "psum" baseline
     a2a_capacity: int = 0            # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0           # auto bucket = slack * mean
+    pooling: Optional[str] = None    # sequence combiner: sum | mean | sqrtn;
+                                     # inputs become [B, L] padded id matrices
+                                     # (ragged.py; reference RaggedTensor
+                                     # lookups, exb.py:315-321)
 
     @property
     def use_hash(self) -> bool:
@@ -214,16 +219,24 @@ class EmbeddingCollection:
         for name, idx in inputs.items():
             spec = self.specs[name]
             if spec.use_hash:
-                rows[name] = sh.pull_sharded(
+                r = sh.pull_sharded(
                     states[name], idx,
                     None if read_only else self._initializers[name],
                     mesh=self.mesh, spec=self._shardings[name],
                     batch_sharded=batch_sharded)
             else:
-                rows[name] = st.pull_sharded(
+                r = st.pull_sharded(
                     states[name], idx, mesh=self.mesh,
                     spec=self._shardings[name], batch_sharded=batch_sharded)
+            if spec.pooling:
+                r = ragged.pool_rows(r, idx, spec.pooling,
+                                     ragged.pad_id_for(spec),
+                                     self._pool_vocab(spec))
+            rows[name] = r
         return rows
+
+    def _pool_vocab(self, spec: EmbeddingSpec) -> Optional[int]:
+        return None if spec.use_hash else spec.input_dim
 
     def apply_gradients(self, states: Dict[str, Any],
                         inputs: Dict[str, jnp.ndarray],
@@ -237,6 +250,12 @@ class EmbeddingCollection:
         new_states = dict(states)
         for name, g in row_grads.items():
             spec = self.specs[name]
+            if spec.pooling:
+                # pooled features carry [B, dim] grads; expand with the
+                # pooling VJP so each valid slot updates like a raw lookup
+                g = ragged.expand_pooled_grads(
+                    g, inputs[name], spec.pooling, ragged.pad_id_for(spec),
+                    self._pool_vocab(spec))
             if spec.use_hash:
                 new_states[name] = sh.apply_gradients_sharded(
                     states[name], self._optimizers[name],

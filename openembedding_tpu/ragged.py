@@ -1,0 +1,118 @@
+"""Ragged / variable-length sequence features: padding + pooling.
+
+Capability parity with the reference's RaggedTensor lookups
+(/root/reference/openembedding/tensorflow/exb.py:315-321 — ``sparse_read``
+maps flat values of a RaggedTensor through the pull op) and TF's sparse
+combiners (sum / mean / sqrtn). Dynamic row lengths are hostile to XLA, so
+the TPU-native shape is **padded [B, L] id matrices**:
+
+* padding slots hold an *invalid* id — ``-1`` for bounded vocabs, the hash
+  EMPTY sentinel for hash variables (``pad_id_for``). The framework-wide
+  invalid-index contract (zero pull rows, dropped gradients) then makes the
+  padding mathematically inert with no extra masks.
+* pooling is declared on the spec (``EmbeddingSpec(pooling="mean")``):
+  ``EmbeddingCollection.pull`` reduces ``[B, L, dim] -> [B, dim]`` and
+  ``apply_gradients`` expands the pooled row-gradient with the matching
+  VJP — the same custom-gradient structure the reference builds around its
+  pull op (exb.py:89-104).
+
+``sum``: plain sum (padding rows are zero). ``mean``: sum / count of valid
+ids (clamped at 1). ``sqrtn``: sum / sqrt(count) — TF's third combiner.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+POOLINGS = ("sum", "mean", "sqrtn")
+
+
+def pad_id_for(spec) -> int:
+    """Canonical padding id for one EmbeddingSpec's key space."""
+    if spec.use_hash:
+        from . import hash_table as hash_lib
+        return hash_lib.empty_key(jnp.dtype(spec.key_dtype))
+    return -1
+
+
+def pad_ragged(sequences: Iterable[Sequence[int]],
+               max_len: Optional[int] = None,
+               pad_id: int = -1,
+               dtype=np.int32) -> np.ndarray:
+    """Host-side: list of variable-length id lists -> [B, L] padded matrix.
+
+    Sequences longer than ``max_len`` keep their most recent ``max_len`` ids
+    (recommendation behavior histories truncate from the front).
+    """
+    info = np.iinfo(np.dtype(dtype))
+    if not (info.min <= pad_id <= info.max):
+        raise ValueError(
+            f"pad_id {pad_id} does not fit dtype {np.dtype(dtype)} — for "
+            "int64-keyed hash features pass dtype=np.int64 (numpy would "
+            "silently wrap the sentinel onto a valid key)")
+    seqs = [np.asarray(s, dtype=dtype).ravel() for s in sequences]
+    if max_len is None:
+        max_len = max((s.size for s in seqs), default=1) or 1
+    out = np.full((len(seqs), max_len), pad_id, dtype=dtype)
+    for i, s in enumerate(seqs):
+        if s.size > max_len:
+            s = s[-max_len:]
+        out[i, :s.size] = s
+    return out
+
+
+def valid_mask(ids: jnp.ndarray, pad_id: int,
+               vocab: Optional[int] = None) -> jnp.ndarray:
+    """[B, L] bool: slots holding a real id (pull's validity contract)."""
+    if vocab is not None and pad_id == -1:
+        return (ids >= 0) & (ids < vocab)
+    return ids != jnp.asarray(pad_id, ids.dtype)
+
+
+def seq_lengths(ids: jnp.ndarray, pad_id: int,
+                vocab: Optional[int] = None) -> jnp.ndarray:
+    """[B] count of valid ids per row (clamped below at 1 for division)."""
+    n = jnp.sum(valid_mask(ids, pad_id, vocab), axis=-1)
+    return jnp.maximum(n, 1)
+
+
+def _scale(pooling: str, ids: jnp.ndarray, pad_id: int,
+           vocab: Optional[int], dtype) -> jnp.ndarray:
+    """[B, 1] divisor applied to the pooled sum (and to expanded grads)."""
+    if pooling == "sum":
+        return jnp.ones((ids.shape[0], 1), dtype)
+    n = seq_lengths(ids, pad_id, vocab).astype(dtype)[:, None]
+    return n if pooling == "mean" else jnp.sqrt(n)
+
+
+def pool_rows(rows: jnp.ndarray, ids: jnp.ndarray, pooling: str,
+              pad_id: int, vocab: Optional[int] = None) -> jnp.ndarray:
+    """[B, L, dim] -> [B, dim] combiner. Padding rows are zero by contract,
+    so the sum needs no mask; mean/sqrtn divide by the true lengths."""
+    if pooling not in POOLINGS:
+        raise ValueError(f"unknown pooling {pooling!r}; known: {POOLINGS}")
+    if rows.ndim != 3:
+        raise ValueError(
+            f"pooling needs [B, L, dim] rows, got shape {rows.shape} — "
+            "sequence features take [B, L] padded id matrices")
+    s = jnp.sum(rows, axis=1)
+    return s / _scale(pooling, ids, pad_id, vocab, s.dtype)
+
+
+def expand_pooled_grads(g: jnp.ndarray, ids: jnp.ndarray, pooling: str,
+                        pad_id: int,
+                        vocab: Optional[int] = None) -> jnp.ndarray:
+    """VJP of :func:`pool_rows` wrt the rows: [B, dim] -> [B, L, dim].
+
+    Every valid slot receives the pooled grad (scaled for mean/sqrtn);
+    padding slots receive it too but their invalid ids make the update a
+    no-op downstream, keeping the expansion mask-free.
+    """
+    if pooling not in POOLINGS:
+        raise ValueError(f"unknown pooling {pooling!r}; known: {POOLINGS}")
+    scaled = g / _scale(pooling, ids, pad_id, vocab, g.dtype)
+    return jnp.broadcast_to(scaled[:, None, :],
+                            (ids.shape[0], ids.shape[1], g.shape[-1]))

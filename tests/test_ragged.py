@@ -1,0 +1,146 @@
+"""Ragged / sequence features: padding, pooling, gradient expansion.
+
+The reference supports RaggedTensor lookups (exb.py:315-321); the TPU-native
+contract is padded [B, L] ids + spec-declared pooling. A pooled feature must
+behave exactly like pulling raw [B, L, dim] rows and pooling by hand —
+including gradients.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec, Trainer,
+                               pad_ragged, pad_id_for)
+from openembedding_tpu import ragged
+from openembedding_tpu.models import deepctr
+from openembedding_tpu.parallel.mesh import create_mesh
+
+VOCAB, DIM = 48, 4
+
+
+def test_pad_ragged():
+    out = pad_ragged([[1, 2, 3], [7], []], pad_id=-1)
+    assert out.shape == (3, 3)
+    np.testing.assert_array_equal(out[0], [1, 2, 3])
+    np.testing.assert_array_equal(out[1], [7, -1, -1])
+    np.testing.assert_array_equal(out[2], [-1, -1, -1])
+    # truncation keeps the most recent ids
+    out = pad_ragged([[1, 2, 3, 4]], max_len=2)
+    np.testing.assert_array_equal(out[0], [3, 4])
+
+
+@pytest.mark.parametrize("pooling", ["sum", "mean", "sqrtn"])
+def test_pooled_pull_matches_manual(devices8, pooling):
+    mesh = create_mesh(2, 4, devices8)
+    raw = EmbeddingSpec(name="s", input_dim=VOCAB, output_dim=DIM,
+                        initializer={"category": "normal", "stddev": 0.1})
+    pooled = EmbeddingSpec(name="s", input_dim=VOCAB, output_dim=DIM,
+                           initializer={"category": "normal", "stddev": 0.1},
+                           pooling=pooling)
+    coll_raw = EmbeddingCollection((raw,), mesh)
+    coll_pool = EmbeddingCollection((pooled,), mesh)
+    states = coll_raw.init(jax.random.PRNGKey(0))
+
+    ids = jnp.asarray(pad_ragged([[1, 2, 2], [5], [], [40, 7]], max_len=4))
+    ids = jnp.tile(ids, (2, 1))  # batch 8, divisible by data axis
+    rows_raw = coll_raw.pull(states, {"s": ids})          # [8, 4, DIM]
+    got = coll_pool.pull(states, {"s": ids})["s"]         # [8, DIM]
+
+    lengths = np.maximum((np.asarray(ids) >= 0).sum(1), 1)[:, None]
+    want = np.asarray(rows_raw["s"]).sum(axis=1)
+    if pooling == "mean":
+        want = want / lengths
+    elif pooling == "sqrtn":
+        want = want / np.sqrt(lengths)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("pooling", ["sum", "mean"])
+def test_pooled_apply_matches_manual(devices8, pooling):
+    """apply_gradients(pooled grads) == apply_gradients(hand-expanded)."""
+    mesh = create_mesh(2, 4, devices8)
+    kw = dict(input_dim=VOCAB, output_dim=DIM,
+              initializer={"category": "constant", "value": 0.2},
+              optimizer={"category": "adagrad", "learning_rate": 0.1})
+    coll_raw = EmbeddingCollection((EmbeddingSpec(name="s", **kw),), mesh)
+    coll_pool = EmbeddingCollection(
+        (EmbeddingSpec(name="s", pooling=pooling, **kw),), mesh)
+    s_raw = coll_raw.init(jax.random.PRNGKey(1))
+    s_pool = jax.tree.map(lambda x: x, s_raw)
+
+    ids = jnp.asarray(pad_ragged([[3, 3, 9], [12], [], [1, 2]], max_len=3))
+    ids = jnp.tile(ids, (2, 1))
+    g = jnp.asarray(np.random.RandomState(0).randn(8, DIM), jnp.float32)
+
+    lengths = jnp.maximum((ids >= 0).sum(1), 1).astype(jnp.float32)[:, None]
+    scaled = g if pooling == "sum" else g / lengths
+    expanded = jnp.broadcast_to(scaled[:, None, :], (8, 3, DIM))
+
+    s_raw = coll_raw.apply_gradients(s_raw, {"s": ids}, {"s": expanded})
+    s_pool = coll_pool.apply_gradients(s_pool, {"s": ids}, {"s": g})
+    np.testing.assert_allclose(np.asarray(s_pool["s"].weights),
+                               np.asarray(s_raw["s"].weights),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_hash_sequence_feature(devices8):
+    """Hash variables pool too; padding is the EMPTY sentinel."""
+    mesh = create_mesh(2, 4, devices8)
+    spec = EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
+                         hash_capacity=512, pooling="mean",
+                         initializer={"category": "constant", "value": 0.5})
+    pad = pad_id_for(spec)
+    assert pad == np.iinfo(np.int32).min
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(pad_ragged([[101, 202], [303], []], max_len=2,
+                                 pad_id=pad))
+    ids = jnp.tile(ids, (4, 1))[:8]
+    rows = coll.pull(states, {"h": ids})["h"]
+    rows = np.asarray(rows)
+    # missing keys -> init rows (0.5); mean over valid slots stays 0.5,
+    # empty sequences are all-padding -> zeros
+    np.testing.assert_allclose(rows[0], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(rows[2], 0.0)
+    g = jnp.ones((8, DIM), jnp.float32)
+    states = coll.apply_gradients(states, {"h": ids}, {"h": g})
+    assert int(states["h"].insert_failures) == 0
+    # only 3 distinct keys materialized
+    assert int(jax.device_get(states["h"].num_used())) == 3
+
+
+def test_pooled_feature_trains_in_model(devices8):
+    """DIN-style: a behavior-history column pooled into DeepFM."""
+    mesh = create_mesh(2, 4, devices8)
+    names = ("item", "hist")
+    specs = (
+        EmbeddingSpec(name="item", input_dim=VOCAB, output_dim=DIM),
+        EmbeddingSpec(name="hist", input_dim=VOCAB, output_dim=DIM,
+                      pooling="mean"),
+        EmbeddingSpec(name="item:linear", input_dim=VOCAB, output_dim=1),
+        EmbeddingSpec(name="hist:linear", input_dim=VOCAB, output_dim=1,
+                      pooling="sum"),
+    )
+    coll = EmbeddingCollection(specs, mesh)
+    import optax
+    trainer = Trainer(deepctr.DeepFM(feature_names=names), coll,
+                      optax.adam(1e-3))
+    rng = np.random.RandomState(0)
+
+    def batch():
+        item = rng.randint(0, VOCAB, 16).astype(np.int32)
+        hist = pad_ragged([rng.randint(0, VOCAB, rng.randint(0, 5))
+                           for _ in range(16)], max_len=6)
+        return {"label": (item % 2).astype(np.float32), "dense": None,
+                "sparse": {"item": item, "hist": hist,
+                           "item:linear": item, "hist:linear": hist}}
+
+    state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(batch()))
+    for _ in range(3):
+        state, m = trainer.train_step(state, batch())
+        assert np.isfinite(float(m["loss"]))
+    scores = trainer.eval_step(state, batch())
+    assert scores.shape == (16,)
