@@ -107,6 +107,10 @@ class EmbeddingCollection:
         for i, spec in enumerate(specs):
             if spec.name in self.specs:
                 raise ValueError(f"duplicate embedding name {spec.name!r}")
+            if spec.pooling is not None and spec.pooling not in ragged.POOLINGS:
+                raise ValueError(
+                    f"embedding {spec.name!r}: unknown pooling "
+                    f"{spec.pooling!r}; known: {ragged.POOLINGS}")
             self.specs[spec.name] = spec
             self._variable_ids[spec.name] = i
             self._optimizers[spec.name] = make_optimizer(
@@ -153,6 +157,12 @@ class EmbeddingCollection:
                          variables=variables, num_shards=num_shards)
         meta.extra["variable_num_shards"] = {
             name: s.num_shards for name, s in self._shardings.items()}
+        poolings = {name: s.pooling for name, s in self.specs.items()
+                    if s.pooling}
+        if poolings:
+            # serving rebuilds specs from the meta alone; pooled lookups
+            # must keep their combiner (registry._specs_from_meta)
+            meta.extra["variable_pooling"] = poolings
         return meta
 
     # --- state lifecycle ---------------------------------------------------

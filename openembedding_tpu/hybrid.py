@@ -51,6 +51,7 @@ class DenseFeatureSpec:
     output_dim: int
     dtype: str = "float32"
     initializer: Optional[tuple] = None  # frozen config items or None
+    pooling: Optional[str] = None        # sequence combiner, as EmbeddingSpec
 
 
 def _freeze_config(cfg) -> Optional[tuple]:
@@ -73,7 +74,8 @@ def to_dense_spec(spec: EmbeddingSpec) -> DenseFeatureSpec:
             "converts bounded vocabs, exb.py:617-632)")
     return DenseFeatureSpec(
         name=spec.name, input_dim=spec.input_dim, output_dim=spec.output_dim,
-        dtype=spec.dtype, initializer=_freeze_config(spec.initializer))
+        dtype=spec.dtype, initializer=_freeze_config(spec.initializer),
+        pooling=spec.pooling)
 
 
 def split_sparse_dense(specs: Sequence[EmbeddingSpec],
@@ -125,7 +127,13 @@ class DenseEmbeddings(nn.Module):
             r = jnp.take(table, jnp.where(valid, flat, 0), axis=0,
                          mode="clip")
             r = jnp.where(valid[:, None], r, jnp.zeros_like(r))
-            rows[s.name] = r.reshape(idx.shape + (s.output_dim,))
+            r = r.reshape(idx.shape + (s.output_dim,))
+            if s.pooling:
+                # pooled sequence features combine here; autodiff provides
+                # the VJP the sharded path writes by hand
+                from . import ragged
+                r = ragged.pool_rows(r, idx, s.pooling, -1, s.input_dim)
+            rows[s.name] = r
         return rows
 
 

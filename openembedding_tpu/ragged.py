@@ -22,7 +22,7 @@ ids (clamped at 1). ``sqrtn``: sum / sqrt(count) — TF's third combiner.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional, Sequence, Tuple
+from typing import Iterable, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np

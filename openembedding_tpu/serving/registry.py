@@ -66,6 +66,7 @@ def _specs_from_meta(meta: ModelMeta, hash_capacity: int,
     (capacity/key dtype) comes from the meta's ``hash_variables`` extra when
     the checkpoint recorded it, so serving tables can hold every trained row."""
     hash_info = meta.extra.get("hash_variables", {})
+    poolings = meta.extra.get("variable_pooling", {})
     specs = []
     for v in sorted(meta.variables, key=lambda v: v.variable_id):
         hash_var = v.meta.vocabulary_size >= UNBOUNDED_VOCAB
@@ -79,7 +80,8 @@ def _specs_from_meta(meta: ModelMeta, hash_capacity: int,
             optimizer={"category": "default"},
             hash_capacity=int(info.get("hash_capacity", hash_capacity)),
             key_dtype=info.get("key_dtype", "int32"),
-            num_shards=num_shards))
+            num_shards=num_shards,
+            pooling=poolings.get(v.name)))
     return specs
 
 

@@ -112,6 +112,51 @@ def test_hash_sequence_feature(devices8):
     assert int(jax.device_get(states["h"].num_used())) == 3
 
 
+def test_invalid_pooling_rejected_at_construction(devices8):
+    mesh = create_mesh(2, 4, devices8)
+    with pytest.raises(ValueError, match="avg"):
+        EmbeddingCollection(
+            (EmbeddingSpec(name="x", input_dim=8, output_dim=DIM,
+                           pooling="avg"),), mesh)
+
+
+def test_pooled_dense_kept_feature(devices8):
+    """sparse_as_dense carries pooling: small-vocab sequence features pool
+    inside DenseEmbeddings too."""
+    from openembedding_tpu.hybrid import to_dense_spec, DenseEmbeddings
+    spec = EmbeddingSpec(name="hist", input_dim=16, output_dim=DIM,
+                         initializer={"category": "constant", "value": 0.5},
+                         pooling="mean")
+    mod = DenseEmbeddings((to_dense_spec(spec),))
+    ids = jnp.asarray(pad_ragged([[1, 2], [7], []], max_len=3))
+    params = mod.init(jax.random.PRNGKey(0), {"hist": ids})
+    rows = np.asarray(mod.apply(params, {"hist": ids})["hist"])
+    assert rows.shape == (3, DIM)
+    np.testing.assert_allclose(rows[0], 0.5, rtol=1e-6)  # mean of two 0.5s
+    np.testing.assert_allclose(rows[2], 0.0)             # empty sequence
+
+
+def test_pooling_survives_serving_round_trip(devices8, tmp_path):
+    """A pooled spec checkpointed + rebuilt by the registry keeps pooling."""
+    from openembedding_tpu import checkpoint as ckpt
+    from openembedding_tpu.serving.registry import ModelRegistry
+    mesh = create_mesh(2, 4, devices8)
+    spec = EmbeddingSpec(name="hist", input_dim=VOCAB, output_dim=DIM,
+                         initializer={"category": "constant", "value": 0.25},
+                         pooling="mean")
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "m")
+    ckpt.save_checkpoint(path, coll, states, model_sign="pooled-1")
+    reg = ModelRegistry(create_mesh(1, 1, mesh.devices.ravel()[:1]))
+    sign = reg.create_model(path)
+    model = reg.find_model(sign)
+    ids = jnp.asarray(pad_ragged([[1, 2], []], max_len=2))
+    rows = np.asarray(model.lookup("hist", ids))
+    assert rows.shape == (2, DIM)  # pooled, not [2, 2, DIM]
+    np.testing.assert_allclose(rows[0], 0.25, rtol=1e-6)
+
+
 def test_pooled_feature_trains_in_model(devices8):
     """DIN-style: a behavior-history column pooled into DeepFM."""
     mesh = create_mesh(2, 4, devices8)
