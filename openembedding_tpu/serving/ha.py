@@ -1,0 +1,261 @@
+"""Replicated serving: replica daemons, failover routing, restore-on-respawn.
+
+Capability parity with the reference's serving HA plane:
+
+* the reference places shard x replica over PS servers and every pull picks
+  one live replica per shard (/root/reference/openembedding/client/Model.cpp:
+  153-186, server/EmbeddingPullOperator.cpp:50-57 ``pick_one_replica``);
+  a SIGKILLed server is replaced by ``server --restore``, which rebuilds its
+  shards from a living replica via the coordinated-restore iterator or from
+  the dump URI (server/EmbeddingRestoreOperator.cpp:12-152, entry/server.cc:
+  53-56); the chaos test kills servers mid-lookup and requires continuous
+  service (entry/c_api_ha_test.cpp:150-210).
+
+* TPU-native: a serving *process* holds one full copy of every table (one
+  SPMD program over its local mesh) — a process IS a replica, so replica
+  placement collapses to "run N identical daemons". The pieces:
+
+  - :func:`replica_main` / :func:`spawn_replica` — one replica daemon:
+    registry + REST controller. Booting with ``--peers`` performs
+    **restore-from-peer**: it fetches a living replica's model catalog
+    (GET /health) and re-creates every NORMAL model from its checkpoint
+    URI. The hand-off gives the catalog; the dump gives the state — and
+    because serving tables are read-only, the dump *is* the replica state,
+    collapsing the reference's two restore paths into one.
+  - :class:`RoutingClient` — ``pick_one_replica`` + retry: lookups rotate
+    over replicas from a random start, skip dead ones, and only fail when
+    no replica answers (the reference serving test's 500 ms retry loop,
+    entry/c_api_test.h:117-121).
+  - liveness — every replica exposes GET /health; GET /cluster on any
+    replica health-probes its peers (rest.py), and
+    :meth:`RoutingClient.nodes` aggregates the same client-side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .rest import probe_health
+
+
+# --- replica daemon ---------------------------------------------------------
+
+def replica_main(argv: Optional[Sequence[str]] = None) -> int:
+    """Entry point of one serving replica (the reference's ``server`` +
+    ``controller`` daemons in one process).
+
+    --port P          REST port (0 = ephemeral, printed on stdout)
+    --load SIGN=URI   model(s) to serve at boot (repeatable)
+    --peers H:P,...   living replicas; restore their catalog on boot
+                      (``server --restore`` equivalent)
+    """
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--load", action="append", default=[])
+    p.add_argument("--peers", default="")
+    p.add_argument("--hash_capacity", type=int, default=2**20)
+    args = p.parse_args(argv)
+
+    import jax
+    from .registry import ModelRegistry
+    from .rest import ControllerServer
+    from ..parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    registry = ModelRegistry(mesh,
+                             default_hash_capacity=args.hash_capacity)
+    peers = [e for e in args.peers.split(",") if e]
+    server = ControllerServer(registry, port=args.port, peers=peers).start()
+    print(f"replica: listening on {server.port}", flush=True)
+
+    for item in args.load:
+        sign, _, uri = item.partition("=")
+        registry.create_model(uri, model_sign=sign or None, block=True)
+        print(f"replica: loaded {sign or uri}", flush=True)
+
+    if peers:
+        n = restore_from_peers(registry, peers)
+        print(f"replica: restored {n} model(s) from peers", flush=True)
+
+    print("replica: ready", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def restore_from_peers(registry, peers: Sequence[str],
+                       wait: float = 30.0) -> int:
+    """Re-create every NORMAL model living peers serve (catalog hand-off).
+
+    Aggregates the catalogs of ALL live peers (a replica must not pass its
+    own endpoint here — it would see its own empty catalog as live). Peers
+    still loading (models in CREATING) are polled for up to ``wait`` seconds
+    so concurrently-booting clusters converge; a model whose checkpoint
+    cannot be read is skipped with a log line instead of killing the
+    replacement replica. Returns the number restored.
+    """
+    deadline = time.time() + wait
+    catalog: Dict[str, str] = {}
+    while True:
+        catalog.clear()
+        creating = False
+        for ep in peers:
+            h = probe_health(ep, timeout=3.0)
+            if not h or not h.get("ok"):
+                continue
+            for m in h.get("models", []):
+                status = m.get("model_status")
+                if status == "NORMAL":
+                    catalog.setdefault(m["model_sign"], m["model_uri"])
+                elif status == "CREATING":
+                    creating = True
+        if catalog or not creating or time.time() >= deadline:
+            break
+        time.sleep(0.5)
+    n = 0
+    for sign, uri in catalog.items():
+        try:
+            registry.create_model(uri, model_sign=sign, block=True)
+            n += 1
+        except ValueError:
+            pass  # already loading/loaded locally
+        except RuntimeError as e:
+            print(f"replica: restore of {sign!r} from {uri!r} failed: {e}",
+                  flush=True)
+    return n
+
+
+def spawn_replica(port: int, *, load: Sequence[str] = (),
+                  peers: Sequence[str] = (),
+                  env: Optional[Dict[str, str]] = None,
+                  devices: int = 1) -> subprocess.Popen:
+    """Start a replica daemon as a child process (test/driver helper)."""
+    cmd = [sys.executable, "-m", "openembedding_tpu.serving.ha",
+           "--port", str(port)]
+    for item in load:
+        cmd += ["--load", item]
+    if peers:
+        cmd += ["--peers", ",".join(peers)]
+    child_env = {**os.environ, **(env or {})}
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    child_env.setdefault("JAX_NUM_CPU_DEVICES", str(devices))
+    child_env.pop("XLA_FLAGS", None)
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child_env["PYTHONPATH"] = root + os.pathsep + child_env.get(
+        "PYTHONPATH", "")
+    return subprocess.Popen(cmd, env=child_env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def wait_ready(endpoint: str, timeout: float = 120.0,
+               sign: Optional[str] = None) -> bool:
+    """Poll /health until the replica answers (and serves ``sign`` if given)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        h = probe_health(endpoint)
+        if h and h.get("ok"):
+            if sign is None:
+                return True
+            for m in h.get("models", []):
+                if m.get("model_sign") == sign and \
+                        m.get("model_status") == "NORMAL":
+                    return True
+        time.sleep(0.3)
+    return False
+
+
+# --- routing client ---------------------------------------------------------
+
+class RoutingClient:
+    """Failover lookup client over N replica endpoints.
+
+    The reference's replica selection + retry: start at a random replica
+    (load spreading, ``pick_one_replica(PickAlgo)``), rotate on failure,
+    raise only when every replica failed. Dead endpoints are remembered as
+    suspect and probed again on later calls (a respawned replica rejoins
+    automatically — there is no registration step, matching the reference
+    where the master only tracks liveness).
+    """
+
+    def __init__(self, endpoints: Sequence[str], timeout: float = 10.0):
+        if not endpoints:
+            raise ValueError("need at least one replica endpoint")
+        self.endpoints = list(endpoints)
+        self.timeout = timeout
+
+    # -- raw http ----------------------------------------------------------
+    def _request(self, endpoint: str, method: str, path: str,
+                 body: Optional[dict] = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://{endpoint}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            payload = r.read()
+        return json.loads(payload) if payload else None
+
+    def _failover(self, method: str, path: str, body=None) -> Any:
+        order = list(self.endpoints)
+        start = random.randrange(len(order))
+        order = order[start:] + order[:start]
+        last_err: Optional[Exception] = None
+        for ep in order:
+            try:
+                return self._request(ep, method, path, body)
+            # NOTE: HTTPError subclasses URLError — it must be caught first,
+            # else every 404 would read as a dead replica
+            except urllib.error.HTTPError as e:
+                if e.code in (409, 503):  # CREATING etc: try another replica
+                    last_err = e
+                    continue
+                raise
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError) as e:
+                last_err = e  # dead/unreachable replica: rotate
+        raise ConnectionError(
+            f"no live replica among {self.endpoints}: {last_err}")
+
+    # -- serving API -------------------------------------------------------
+    def lookup(self, sign: str, variable: Any, indices) -> np.ndarray:
+        """Read-only pull with replica failover (never fails while one
+        replica lives — the chaos-test invariant)."""
+        out = self._failover(
+            "POST", f"/models/{sign}/lookup",
+            {"variable": variable,
+             "indices": np.asarray(indices).tolist()})
+        return np.asarray(out["rows"], dtype=np.float32)
+
+    def create_model(self, model_uri: str, *,
+                     model_sign: Optional[str] = None,
+                     block: bool = True) -> List[str]:
+        """Create the model on EVERY replica (replica placement)."""
+        signs = []
+        for ep in self.endpoints:
+            out = self._request(ep, "POST", "/models",
+                                {"model_uri": model_uri,
+                                 "model_sign": model_sign, "block": block})
+            signs.append(out["model_sign"])
+        return signs
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        """Cluster liveness, client-side aggregated."""
+        from .rest import probe_nodes
+        return probe_nodes(self.endpoints)
+
+
+if __name__ == "__main__":
+    sys.exit(replica_main())

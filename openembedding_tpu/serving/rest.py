@@ -34,7 +34,32 @@ from .registry import ModelRegistry
 DEFAULT_PORT = 8010
 
 
-def make_handler(registry: ModelRegistry):
+def probe_health(endpoint: str, timeout: float = 1.0):
+    """GET /health against ``host:port``; returns the JSON or None if dead."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(f"http://{endpoint}/health",
+                                    timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:  # noqa: BLE001 — any failure = not alive
+        return None
+
+
+def probe_nodes(endpoints):
+    """Liveness + catalog of each endpoint (shared by /cluster and the
+    routing client's node listing)."""
+    out = []
+    for ep in endpoints:
+        h = probe_health(ep)
+        out.append({"endpoint": ep, "alive": bool(h and h.get("ok")),
+                    "models": [m.get("model_sign")
+                               for m in (h or {}).get("models", [])]})
+    return out
+
+
+def make_handler(registry: ModelRegistry, peers=None):
+    peers = list(peers or [])
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet test output
             pass
@@ -55,6 +80,15 @@ def make_handler(registry: ModelRegistry):
 
         def do_GET(self):
             try:
+                if self.path == "/health":
+                    # liveness + model catalog: peers restore from this
+                    # (the living-replica hand-off, EmbeddingRestoreOperator)
+                    return self._send(200, {
+                        "ok": True, "models": registry.show_models()})
+                if self.path == "/cluster":
+                    # cluster liveness through any replica's REST surface —
+                    # the controller's node listing over the master registry
+                    return self._send(200, probe_nodes(peers))
                 if self.path == "/models":
                     return self._send(200, registry.show_models())
                 m = re.fullmatch(r"/models/([^/]+)", self.path)
@@ -127,9 +161,9 @@ class ControllerServer:
     """Threaded HTTP controller (the masterd+controller daemon analogue)."""
 
     def __init__(self, registry: ModelRegistry, port: int = DEFAULT_PORT,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", peers=None):
         self.httpd = ThreadingHTTPServer((host, port),
-                                         make_handler(registry))
+                                         make_handler(registry, peers))
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)

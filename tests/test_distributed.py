@@ -25,8 +25,9 @@ def _free_port() -> int:
 
 def test_two_process_training_step():
     port = _free_port()
+    root = os.path.dirname(os.path.dirname(_WORKER))
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": os.path.dirname(os.path.dirname(_WORKER))}
+           "PYTHONPATH": root + os.pathsep + os.environ.get("PYTHONPATH", "")}
     env.pop("XLA_FLAGS", None)  # workers set their own device counts
     procs = [subprocess.Popen(
         [sys.executable, _WORKER, str(r), str(port)], env=env,

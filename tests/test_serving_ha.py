@@ -1,0 +1,148 @@
+"""Serving HA: replica daemons, failover routing, SIGKILL chaos, restore.
+
+Mirrors the reference's HA test (entry/c_api_ha_test.cpp:150-210): N replica
+processes serve one model; replicas are SIGKILLed mid-lookup; the routing
+client must keep answering while >= 1 replica lives; killed replicas respawn
+with --peers and restore the catalog from a living replica.
+"""
+
+import os
+import signal
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+from openembedding_tpu import checkpoint as ckpt
+from openembedding_tpu.parallel.mesh import create_mesh
+from openembedding_tpu.serving import ha
+
+DIM = 4
+SIGN = "ha-model-1"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory, devices8):
+    """A small checkpoint with recognizable values."""
+    path = str(tmp_path_factory.mktemp("ha") / "model")
+    mesh = create_mesh(1, 1, jax.devices()[:1])
+    spec = EmbeddingSpec(
+        name="emb", input_dim=64, output_dim=DIM,
+        initializer={"category": "constant", "value": 0.5})
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    ckpt.save_checkpoint(path, coll, states, model_sign=SIGN)
+    return path
+
+
+def _cleanup(procs):
+    for p in procs.values():
+        if p and p.poll() is None:
+            p.kill()
+
+
+def _assert_lookup(router):
+    rows = router.lookup(SIGN, "emb", [1, 7, 63])
+    assert rows.shape == (3, DIM)
+    np.testing.assert_allclose(rows, 0.5, rtol=1e-6)
+
+
+def test_restore_from_peer_and_chaos(model_dir):
+    ports = [_free_port() for _ in range(3)]
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    procs = {}
+    try:
+        # boot replica 0 with the model; 1 and 2 restore from peers —
+        # the reference's `server --restore` replacement-node path
+        procs[0] = ha.spawn_replica(ports[0], load=[f"{SIGN}={model_dir}"])
+        assert ha.wait_ready(eps[0], sign=SIGN), _tail(procs[0])
+        for i in (1, 2):
+            procs[i] = ha.spawn_replica(ports[i], peers=[eps[0]])
+            assert ha.wait_ready(eps[i], sign=SIGN), _tail(procs[i])
+
+        router = ha.RoutingClient(eps, timeout=15.0)
+        nodes = router.nodes()
+        assert all(n["alive"] for n in nodes)
+        assert all(SIGN in n["models"] for n in nodes)
+        _assert_lookup(router)
+
+        # GET /cluster through one replica reflects peer liveness
+        import urllib.request, json
+        with urllib.request.urlopen(
+                f"http://{eps[1]}/cluster", timeout=5) as r:
+            cluster = json.loads(r.read())
+        assert {c["endpoint"] for c in cluster} == {eps[0]}
+        assert all(c["alive"] for c in cluster)
+
+        # chaos round 1: SIGKILL one replica mid-service
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait()
+        for _ in range(5):
+            _assert_lookup(router)  # service continues on live replicas
+        nodes = router.nodes()
+        assert sum(n["alive"] for n in nodes) == 2
+
+        # chaos round 2: kill a second — one survivor still serves
+        procs[2].send_signal(signal.SIGKILL)
+        procs[2].wait()
+        for _ in range(5):
+            _assert_lookup(router)
+
+        # respawn both with --peers pointing at the OTHER endpoints (a
+        # replica must not list itself): catalog restored from the living
+        # replica, service returns to full strength
+        for i in (1, 2):
+            others = [e for j, e in enumerate(eps) if j != i]
+            procs[i] = ha.spawn_replica(ports[i], peers=others)
+            assert ha.wait_ready(eps[i], sign=SIGN), _tail(procs[i])
+        nodes = router.nodes()
+        assert all(n["alive"] for n in nodes)
+        _assert_lookup(router)
+
+        # kill the ORIGINAL source replica: restored replicas keep serving
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait()
+        for _ in range(5):
+            _assert_lookup(router)
+    finally:
+        _cleanup(procs)
+
+
+def test_router_propagates_http_errors(model_dir):
+    """A 404 (unknown model) must surface as HTTPError, not as a dead
+    cluster — HTTPError subclasses URLError and must be caught first."""
+    import urllib.error
+    port = _free_port()
+    proc = ha.spawn_replica(port, load=[f"{SIGN}={model_dir}"])
+    try:
+        ep = f"127.0.0.1:{port}"
+        assert ha.wait_ready(ep, sign=SIGN), _tail(proc)
+        router = ha.RoutingClient([ep], timeout=10.0)
+        with pytest.raises(urllib.error.HTTPError):
+            router.lookup("no-such-model", "emb", [0])
+    finally:
+        proc.kill()
+
+
+def test_router_raises_when_all_dead(model_dir):
+    router = ha.RoutingClient([f"127.0.0.1:{_free_port()}"], timeout=2.0)
+    with pytest.raises(ConnectionError, match="no live replica"):
+        router.lookup(SIGN, "emb", [0])
+
+
+def _tail(proc, n=20):
+    try:
+        out = proc.stdout.read() if proc.poll() is not None else ""
+    except Exception:  # noqa: BLE001
+        out = ""
+    return "\n".join((out or "").splitlines()[-n:])
