@@ -8,12 +8,17 @@ EmbeddingLoadOperator.cpp, client/Model.cpp:89-134):
   ordered variable metas, format version; reference Meta.h "0.2", ours
   ``META_FORMAT_VERSION``). Load validates variable metas match before
   touching any table (Model.cpp:110-121).
-* per-variable ``var_<id>_<name>.npz`` — logical-row-order weights (+ named
-  optimizer-state arrays when ``include_optimizer``, the reference's
-  state_line_size != 0 flag, EmbeddingDumpOperator.cpp:36-76); hash variables
-  store (keys, weights, states) triples of live rows only — the reference's
-  streamed (indices, weights, states) blocks with re-globalized keys
-  (EmbeddingShardFile.h:21-23).
+* per-variable ``var_<id>_<name>.d/{weights,slot_*,keys}.npy`` —
+  logical-row-order arrays (+ named optimizer-state files when
+  ``include_optimizer``, the reference's state_line_size != 0 flag,
+  EmbeddingDumpOperator.cpp:36-76); hash variables store (keys, weights,
+  states) triples of live rows only — the reference's streamed (indices,
+  weights, states) blocks with re-globalized keys (EmbeddingShardFile.h:
+  21-23). **Dump and load stream per-shard ~4MB blocks** (device slices on
+  save, memmapped strided reads + direct per-device placement on load), so
+  host memory stays bounded no matter the table size — the reference's
+  server-side block streaming, not a whole-table host copy. Legacy
+  single-file ``var_*.npz`` checkpoints still load.
 * **Shard-topology independence**: arrays are written in *logical id order*
   (the physical mod-layout permutation is undone on save and re-applied on
   load), and hash rows are keyed — so a checkpoint taken on an 8-way mesh
@@ -48,11 +53,19 @@ from .parallel import sharded_table as st
 MODEL_META_FILE = "model_meta"
 DENSE_FILE = "dense_state.msgpack"
 _LOAD_CHUNK = 1 << 16
+# streamed block granularity — the reference dumps ~1MB lines per shard
+# (EmbeddingDumpOperator.cpp:84-87 server_block_num_items)
+_BLOCK_BYTES = 4 << 20
 
 
 def _var_file(variable_id: int, name: str) -> str:
     safe = name.replace("/", "_").replace(":", "__")
     return f"var_{variable_id}_{safe}.npz"
+
+
+def _var_dir(variable_id: int, name: str) -> str:
+    safe = name.replace("/", "_").replace(":", "__")
+    return f"var_{variable_id}_{safe}.d"
 
 
 def _logical_perm(spec: st.ShardingSpec) -> np.ndarray:
@@ -63,6 +76,61 @@ def _logical_perm(spec: st.ShardingSpec) -> np.ndarray:
     return shard * spec.rows_per_shard + local
 
 
+def _logical_slice(spec: st.ShardingSpec, vocab: int, phys_start: int,
+                   n: int):
+    """(file_slice, n_valid) for physical rows [phys_start, phys_start+n).
+
+    A physical block lies inside one shard, and a shard's logical rows form
+    a *basic* numpy slice of the logical-order file — strided (every Nth
+    row) under "mod", contiguous under "div" — so both dump and load move
+    data with plain slice assignments, never fancy indexing.
+    """
+    rps = spec.rows_per_shard
+    s = phys_start // rps
+    l0 = phys_start % rps
+    assert (phys_start + n - 1) // rps == s, "block crosses a shard boundary"
+    if spec.layout == "mod":
+        # shard s owns logical rows l*N + s; valid while < vocab
+        nv_shard = max(0, -(-(vocab - s) // spec.num_shards)) \
+            if s < vocab else 0
+        nv = max(0, min(n, nv_shard - l0))
+        N = spec.num_shards
+        return slice(s + l0 * N, s + (l0 + nv) * N, N), nv
+    nv = max(0, min(n, vocab - phys_start))
+    return slice(phys_start, phys_start + nv), nv
+
+
+def _iter_shard_blocks(arr):
+    """Yield (physical_row_start, host_block) in bounded blocks per shard.
+
+    Streams each addressable shard device->host in ~_BLOCK_BYTES slices —
+    the dump never materializes the whole table on the host, matching the
+    reference's per-shard block streaming (EmbeddingDumpOperator.cpp:50-96).
+    Replicated shards (psum plane: data-axis copies) are emitted once.
+    """
+    for shard in arr.addressable_shards:
+        if shard.replica_id != 0:
+            continue  # psum-plane data-axis replica: identical copy
+        data = shard.data
+        rows = data.shape[0]
+        if not rows:
+            continue
+        start = shard.index[0].start or 0
+        row_bytes = max(1, data.nbytes // rows)
+        per = max(1, _BLOCK_BYTES // row_bytes)
+        for lo in range(0, rows, per):
+            hi = min(rows, lo + per)
+            yield start + lo, np.asarray(jax.device_get(data[lo:hi]))
+
+
+def _require_single_process(what: str) -> None:
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"{what} currently runs on a single-controller process; on a "
+            "multi-host cluster write per-host part files (the reference's "
+            "model_<node>_<fileid> layout) — not implemented yet")
+
+
 def save_checkpoint(path: str,
                     collection: EmbeddingCollection,
                     states: Dict[str, Any],
@@ -71,6 +139,7 @@ def save_checkpoint(path: str,
                     include_optimizer: bool = True,
                     model_sign: str = "") -> None:
     """Dump all embedding variables (+ optional dense pytree) under ``path``."""
+    _require_single_process("save_checkpoint")  # before any writes
     os.makedirs(path, exist_ok=True)
     meta = collection.model_meta(model_sign=model_sign, model_uri=path)
     meta.extra["include_optimizer"] = bool(include_optimizer)
@@ -91,35 +160,184 @@ def save_checkpoint(path: str,
     for name, spec in collection.specs.items():
         state = states[name]
         vid = collection.variable_id(name)
-        arrays = {}
+        vdir = os.path.join(path, _var_dir(vid, name))
+        if os.path.isdir(vdir):
+            # a previous save under a different optimizer could leave stale
+            # slot files behind, which a later load would mistake for state
+            import shutil
+            shutil.rmtree(vdir)
+        os.makedirs(vdir)
         if spec.use_hash:
-            keys = np.asarray(jax.device_get(state.keys))
-            weights = np.asarray(jax.device_get(state.weights))
-            live = keys != hash_lib.empty_key(keys.dtype)
-            arrays["keys"] = keys[live]
-            arrays["weights"] = weights[live]
-            if include_optimizer:
-                for sname, sval in state.slots.items():
-                    arrays[f"slot_{sname}"] = np.asarray(
-                        jax.device_get(sval))[live]
+            _save_hash_var(vdir, state, include_optimizer)
         else:
-            # store only the real vocab rows in logical id order — padding
-            # rows (vocab..padded_vocab) are unreachable by contract and
-            # differ across mesh shapes, so dropping them is what makes the
-            # checkpoint shard-topology independent
-            sspec = collection.sharding_spec(name)
-            perm = _logical_perm(sspec)[:spec.input_dim]
-            arrays["weights"] = np.asarray(
-                jax.device_get(state.weights))[perm]
-            if include_optimizer:
-                for sname, sval in state.slots.items():
-                    arrays[f"slot_{sname}"] = np.asarray(
-                        jax.device_get(sval))[perm]
-        np.savez(os.path.join(path, _var_file(vid, name)), **arrays)
+            _save_array_var(vdir, state, collection.sharding_spec(name),
+                            spec.input_dim, include_optimizer)
 
     if dense_state is not None:
         with open(os.path.join(path, DENSE_FILE), "wb") as f:
             f.write(serialization.to_bytes(jax.device_get(dense_state)))
+
+
+def _save_array_var(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
+                    include_optimizer: bool) -> None:
+    """Stream one bounded variable to ``<vdir>/{weights,slot_*}.npy``.
+
+    Arrays are written in *logical id order* (only the real vocab rows —
+    padding rows differ across mesh shapes and are unreachable), so the
+    checkpoint is shard-topology independent. Each shard's physical block
+    maps to logical positions with vectorized index math; host memory stays
+    bounded by the block size.
+    """
+    targets = {"weights": state.weights}
+    if include_optimizer:
+        for sname, sval in state.slots.items():
+            targets[f"slot_{sname}"] = sval
+    for fname, arr in targets.items():
+        mm = np.lib.format.open_memmap(
+            os.path.join(vdir, fname + ".npy"), mode="w+",
+            dtype=np.dtype(arr.dtype), shape=(vocab,) + arr.shape[1:])
+        for phys_start, block in _iter_shard_blocks(arr):
+            sl, nv = _logical_slice(sspec, vocab, phys_start, block.shape[0])
+            if nv:
+                mm[sl] = block[:nv]
+        mm.flush()
+        del mm
+
+
+def _save_hash_var(vdir: str, state, include_optimizer: bool) -> None:
+    """Stream one hash variable's live rows to ``<vdir>/*.npy``.
+
+    Pass 1 counts live rows per shard on-device (a scalar per shard); pass 2
+    streams (keys, weights, states) blocks and writes the live subset — the
+    reference's streamed (indices, weights, states) block dump with
+    re-globalized keys (EmbeddingShardFile.h:21-23).
+    """
+    empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+    total = int(jax.device_get(state.num_used()))
+    targets = {"keys": state.keys, "weights": state.weights}
+    if include_optimizer:
+        for sname, sval in state.slots.items():
+            targets[f"slot_{sname}"] = sval
+    mms = {
+        fname: np.lib.format.open_memmap(
+            os.path.join(vdir, fname + ".npy"), mode="w+",
+            dtype=np.dtype(arr.dtype), shape=(total,) + arr.shape[1:])
+        for fname, arr in targets.items()
+    }
+    offset = 0
+    for blocks in _aligned_shard_blocks(targets):
+        live = blocks["keys"] != empty
+        n = int(live.sum())
+        if n:
+            for fname, block in blocks.items():
+                mms[fname][offset:offset + n] = block[live]
+        offset += n
+    assert offset == total, (offset, total)
+    for mm in mms.values():
+        mm.flush()
+
+
+def _aligned_shard_blocks(arrays: Dict[str, Any]):
+    """Yield row-aligned host blocks across several identically-sharded
+    arrays (keys + weights + slots share the table's sharding, but their
+    row widths differ, so the block row count must be chosen jointly)."""
+    shard_lists = {
+        f: sorted((s for s in a.addressable_shards if s.replica_id == 0),
+                  key=lambda s: s.index[0].start or 0)
+        for f, a in arrays.items()
+    }
+    for i in range(len(shard_lists["keys"])):
+        datas = {f: sl[i].data for f, sl in shard_lists.items()}
+        rows = datas["keys"].shape[0]
+        if not rows:
+            continue
+        row_bytes = sum(max(1, d.nbytes // rows) for d in datas.values())
+        per = max(1, _BLOCK_BYTES // row_bytes)
+        for lo in range(0, rows, per):
+            hi = min(rows, lo + per)
+            yield {f: np.asarray(jax.device_get(d[lo:hi]))
+                   for f, d in datas.items()}
+
+
+class _NpyDirReader:
+    """dict-like lazy reader over a ``var_*.d`` directory of .npy files.
+
+    Files are opened memmapped so the loader streams from disk instead of
+    materializing whole tables host-side; the same mapping interface as a
+    legacy ``np.load`` npz handle, so one loader serves both formats.
+    """
+
+    def __init__(self, vdir: str):
+        self._vdir = vdir
+        self._names = {f[:-4] for f in os.listdir(vdir) if f.endswith(".npy")}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._names
+
+    def __getitem__(self, name: str):
+        if name not in self._names:
+            raise KeyError(name)
+        return np.load(os.path.join(self._vdir, name + ".npy"),
+                       mmap_mode="r")
+
+
+def _open_var(path: str, vid: int, name: str):
+    vdir = os.path.join(path, _var_dir(vid, name))
+    if os.path.isdir(vdir):
+        return _NpyDirReader(vdir)
+    return np.load(os.path.join(path, _var_file(vid, name)))  # legacy npz
+
+
+def _load_array_var(data, spec, sspec: st.ShardingSpec, optimizer,
+                    shardings, with_opt: bool):
+    """Assemble one bounded variable shard-by-shard from logical-order data.
+
+    For every addressable device, reads exactly its rows (a strided slice of
+    the logical file under the "mod" layout), pads rows beyond the stored
+    vocab with the fill value, and places them directly — host memory peaks
+    at one shard, and no full-table host array ever exists (the streaming
+    inverse of _save_array_var).
+    """
+    vocab = spec.input_dim
+    dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
+    pv = sspec.padded_vocab
+
+    def build(source, fill, store_dtype, row_shape, sharding):
+        global_shape = (pv,) + row_shape
+        locals_ = []
+        devs = sorted(
+            sharding.addressable_devices_indices_map(global_shape).items(),
+            key=lambda kv: kv[1][0].start or 0)
+        stored = 0 if source is None else min(vocab, source.shape[0])
+        for dev, idx in devs:
+            start = idx[0].start or 0
+            stop = idx[0].stop if idx[0].stop is not None else pv
+            local = np.full((stop - start,) + row_shape, fill,
+                            dtype=store_dtype)
+            sl, nv = _logical_slice(sspec, stored, start, stop - start)
+            if nv:
+                # basic (strided/contiguous) memmap slice: streams this
+                # shard's rows without touching the rest of the file
+                local[:nv] = source[sl]
+            locals_.append(jax.device_put(local, dev))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, locals_)
+
+    weights = build(data["weights"], 0.0, dtype, data["weights"].shape[1:],
+                    shardings.weights)
+    new_slots = {}
+    dim = spec.output_dim
+    for sname, sshape in optimizer.slot_shapes(dim).items():
+        sdtype = np.dtype(optimizer.slot_dtype(sname, dtype))
+        fill = optimizer.slot_init(sname)
+        fname = f"slot_{sname}"
+        source = data[fname] if (with_opt and fname in data) else None
+        # absent from the dump (saved without optimizer state, or under a
+        # different optimizer category): fresh slot init, weights kept —
+        # copy_from hot-swap semantics (EmbeddingVariable.cpp:29-60)
+        new_slots[sname] = build(source, fill, sdtype, tuple(sshape),
+                                 shardings.slots[sname])
+    return table_lib.TableState(weights=weights, slots=new_slots)
 
 
 def _check_meta(path: str, collection: EmbeddingCollection) -> ModelMeta:
@@ -159,7 +377,7 @@ def load_checkpoint(path: str,
     out = {}
     for name, spec in collection.specs.items():
         vid = collection.variable_id(name)
-        data = np.load(os.path.join(path, _var_file(vid, name)))
+        data = _open_var(path, vid, name)
         sspec = collection.sharding_spec(name)
         optimizer = collection.optimizer(name)
         if spec.use_hash:
@@ -201,40 +419,9 @@ def load_checkpoint(path: str,
                     "load must deliver every row or fail")
             out[name] = state
         else:
-            # assemble the physical (mod-layout) arrays host-side, padding
-            # rows beyond the stored vocab with zeros / slot-init values (they
-            # are unreachable), then place them sharded
-            perm = _logical_perm(sspec)
-            shardings = collection.state_shardings()[name]
-            dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
-            dim = spec.output_dim
-            pv = sspec.padded_vocab
-
-            def _to_physical(logical_rows, fill, store_dtype):
-                full = np.full((pv,) + logical_rows.shape[1:], fill,
-                               dtype=store_dtype)
-                full[:logical_rows.shape[0]] = logical_rows
-                phys = np.empty_like(full)
-                phys[perm] = full
-                return phys
-
-            weights = _to_physical(data["weights"], 0.0, dtype)
-            new_slots = {}
-            for sname, sshape in optimizer.slot_shapes(dim).items():
-                sdtype = np.dtype(optimizer.slot_dtype(sname, dtype))
-                fill = optimizer.slot_init(sname)
-                if with_opt and f"slot_{sname}" in data:
-                    rows = data[f"slot_{sname}"]
-                else:
-                    # absent from the dump (saved without optimizer state, or
-                    # under a different optimizer category): fresh slot init,
-                    # weights kept — copy_from hot-swap semantics
-                    rows = np.empty((0, *sshape), dtype=sdtype)
-                new_slots[sname] = jax.device_put(
-                    _to_physical(rows, fill, sdtype), shardings.slots[sname])
-            out[name] = table_lib.TableState(
-                weights=jax.device_put(weights, shardings.weights),
-                slots=new_slots)
+            out[name] = _load_array_var(
+                data, spec, sspec, optimizer,
+                collection.state_shardings()[name], with_opt)
     if dense_state_template is not None:
         with open(os.path.join(path, DENSE_FILE), "rb") as f:
             dense = serialization.from_bytes(dense_state_template, f.read())

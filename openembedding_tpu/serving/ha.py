@@ -33,6 +33,7 @@ Capability parity with the reference's serving HA plane:
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import random
@@ -122,7 +123,9 @@ def restore_from_peers(registry, peers: Sequence[str],
                     catalog.setdefault(m["model_sign"], m["model_uri"])
                 elif status == "CREATING":
                     creating = True
-        if catalog or not creating or time.time() >= deadline:
+        # keep polling while any peer model is still loading — a settled
+        # catalog (no CREATING anywhere) or the deadline ends the wait
+        if not creating or time.time() >= deadline:
             break
         time.sleep(0.5)
     n = 0
@@ -223,9 +226,11 @@ class RoutingClient:
                     last_err = e
                     continue
                 raise
-            except (urllib.error.URLError, ConnectionError, OSError,
-                    TimeoutError) as e:
-                last_err = e  # dead/unreachable replica: rotate
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, OSError, TimeoutError) as e:
+                # dead/unreachable replica — including one killed mid-
+                # response (IncompleteRead/RemoteDisconnected): rotate
+                last_err = e
         raise ConnectionError(
             f"no live replica among {self.endpoints}: {last_err}")
 

@@ -3,6 +3,7 @@ optimizer-state preservation, include_optimizer=False, dense export, and meta
 validation — the reference's dump/load matrix (c_api_test.h:303-343 state
 round trip; Model.cpp meta check; exb.py:506-547 dense export)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -174,3 +175,84 @@ def test_trainer_dense_state_roundtrip(devices8, tmp_path):
         np.asarray(jax.tree.leaves(state.params)[0]),
         np.asarray(jax.tree.leaves(dense2["params"])[0]), rtol=1e-6)
     assert int(dense2["step"]) == 1
+
+
+def test_streaming_blocks_roundtrip(devices8, tmp_path, monkeypatch):
+    """Force many sub-shard blocks: a tiny block size must not change the
+    bytes on disk or the reload (the reference's ~1MB line streaming)."""
+    monkeypatch.setattr(ckpt, "_BLOCK_BYTES", 64)  # a handful of rows
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states, idx = train_a_bit(coll, coll.init(jax.random.PRNGKey(0)))
+    before = coll.pull(states, idx, batch_sharded=False)
+    ckpt.save_checkpoint(str(tmp_path / "m"), coll, states)
+    loaded = ckpt.load_checkpoint(str(tmp_path / "m"), coll)
+    after = coll.pull(loaded, idx, batch_sharded=False)
+    for k in before:
+        np.testing.assert_allclose(np.asarray(before[k]),
+                                   np.asarray(after[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_legacy_npz_checkpoint_loads(devices8, tmp_path):
+    """Round-1 checkpoints (one npz per variable) still load."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states, idx = train_a_bit(coll, coll.init(jax.random.PRNGKey(0)))
+    before = coll.pull(states, idx, batch_sharded=False)
+    path = tmp_path / "m"
+    ckpt.save_checkpoint(str(path), coll, states)
+    # repackage each var dir into the legacy single-npz layout
+    import shutil
+    for name in ("arr", "hsh"):
+        vid = coll.variable_id(name)
+        vdir = path / ckpt._var_dir(vid, name)
+        arrays = {f[:-4]: np.load(vdir / f) for f in os.listdir(vdir)}
+        np.savez(path / ckpt._var_file(vid, name), **arrays)
+        shutil.rmtree(vdir)
+    loaded = ckpt.load_checkpoint(str(path), coll)
+    after = coll.pull(loaded, idx, batch_sharded=False)
+    for k in before:
+        np.testing.assert_allclose(np.asarray(before[k]),
+                                   np.asarray(after[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_psum_plane_checkpoint_roundtrip(devices8, tmp_path):
+    """psum-plane tables are replicated over the data axis; the streaming
+    dump must emit each shard once (replica_id filter), not once per copy."""
+    mesh = create_mesh(2, 4, devices8)
+    specs = (EmbeddingSpec(name="arr", input_dim=VOCAB, output_dim=DIM,
+                           plane="psum"),
+             EmbeddingSpec(name="hsh", input_dim=-1, output_dim=DIM,
+                           hash_capacity=512, plane="psum"),)
+    coll = EmbeddingCollection(
+        specs, mesh,
+        default_optimizer={"category": "adam", "learning_rate": 0.05})
+    states, idx = train_a_bit(coll, coll.init(jax.random.PRNGKey(0)))
+    before = coll.pull(states, idx, batch_sharded=False)
+    ckpt.save_checkpoint(str(tmp_path / "m"), coll, states)
+    loaded = ckpt.load_checkpoint(str(tmp_path / "m"), coll)
+    after = coll.pull(loaded, idx, batch_sharded=False)
+    for k in before:
+        np.testing.assert_allclose(np.asarray(before[k]),
+                                   np.asarray(after[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_resave_clears_stale_slot_files(devices8, tmp_path):
+    """Re-saving under an optimizer with fewer slots must not leave the old
+    slot files behind for a later load to mistake for state."""
+    mesh = create_mesh(2, 4, devices8)
+    path = str(tmp_path / "m")
+    adam = EmbeddingCollection(
+        (EmbeddingSpec(name="arr", input_dim=VOCAB, output_dim=DIM),), mesh,
+        default_optimizer={"category": "adam", "learning_rate": 0.05})
+    ckpt.save_checkpoint(path, adam, adam.init(jax.random.PRNGKey(0)))
+    vdir = tmp_path / "m" / ckpt._var_dir(0, "arr")
+    assert (vdir / "slot_m.npy").exists()
+    sgd = EmbeddingCollection(
+        (EmbeddingSpec(name="arr", input_dim=VOCAB, output_dim=DIM),), mesh,
+        default_optimizer={"category": "sgd", "learning_rate": 0.1})
+    ckpt.save_checkpoint(path, sgd, sgd.init(jax.random.PRNGKey(1)))
+    assert not (vdir / "slot_m.npy").exists()
