@@ -30,5 +30,6 @@ from .fused import FusedMapper, make_fused_specs
 from .hybrid import (DenseEmbeddings, DenseFeatureSpec, HybridModel,
                      split_sparse_dense)
 from .ragged import pad_ragged, pad_id_for, pool_rows
+from .offload import HostOffloadedTable, ShardedOffloadedTable
 from . import distributed
 from .training import Trainer, TrainState, binary_logloss

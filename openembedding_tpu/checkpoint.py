@@ -323,8 +323,8 @@ def _load_array_var(data, spec, sspec: st.ShardingSpec, optimizer,
         return jax.make_array_from_single_device_arrays(
             global_shape, sharding, locals_)
 
-    weights = build(data["weights"], 0.0, dtype, data["weights"].shape[1:],
-                    shardings.weights)
+    w = data["weights"]  # bind once: npz access decompresses per access
+    weights = build(w, 0.0, dtype, w.shape[1:], shardings.weights)
     new_slots = {}
     dim = spec.output_dim
     for sname, sshape in optimizer.slot_shapes(dim).items():

@@ -35,12 +35,14 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .embedding import EmbeddingSpec
 from .meta import EmbeddingVariableMeta
 from .optim.initializers import make_initializer
 from .optim.optimizers import make_optimizer
@@ -48,6 +50,67 @@ from . import hash_table as hash_lib
 from . import table as table_lib
 
 OFFLOAD_META_FILE = "offload_meta"
+
+
+def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
+                   work_id: int, persisted_work: int,
+                   host_weights: np.ndarray,
+                   host_slots: Dict[str, np.ndarray],
+                   host_work_id: np.ndarray) -> Dict[str, Any]:
+    """Shared base/delta checkpoint writer (both offload tiers).
+
+    First call writes a base file with every row; later calls write only
+    rows whose watermark moved past ``persisted_work`` — the reference's
+    incremental-commit protocol (PmemEmbeddingTable.h:297-328).
+    """
+    os.makedirs(path, exist_ok=True)
+    meta_path = os.path.join(path, OFFLOAD_META_FILE)
+    chain = []
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            chain = json.load(f)["checkpoints"]
+    if not chain:
+        fname = f"base_{work_id}.npz"
+        np.savez(os.path.join(path, fname),
+                 ids=np.arange(vocab, dtype=np.int64),
+                 weights=host_weights, work_id=host_work_id,
+                 **{f"slot_{k}": v for k, v in host_slots.items()})
+        changed = vocab
+    else:
+        ids = np.nonzero(host_work_id > persisted_work)[0].astype(np.int64)
+        fname = f"inc_{work_id}.npz"
+        np.savez(os.path.join(path, fname),
+                 ids=ids, weights=host_weights[ids],
+                 work_id=host_work_id[ids],
+                 **{f"slot_{k}": v[ids] for k, v in host_slots.items()})
+        changed = int(ids.size)
+    chain.append({"file": fname, "work_id": work_id})
+    with open(meta_path, "w") as f:
+        json.dump({"checkpoints": chain, "vocab": vocab,
+                   "meta": meta.to_json()}, f)
+    return {"file": fname, "rows": changed}
+
+
+def _replay_store(path: str, *, vocab: int, host_weights: np.ndarray,
+                  host_slots: Dict[str, np.ndarray],
+                  host_work_id: np.ndarray) -> int:
+    """Shared restore: replay base + increments (newest wins by order).
+    Returns the highest persisted work id."""
+    with open(os.path.join(path, OFFLOAD_META_FILE)) as f:
+        meta = json.load(f)
+    if int(meta["vocab"]) != vocab:
+        raise ValueError(f"offload checkpoint vocab {meta['vocab']} != "
+                         f"table vocab {vocab}")
+    max_work = 0
+    for entry in meta["checkpoints"]:
+        data = np.load(os.path.join(path, entry["file"]))
+        ids = data["ids"]
+        host_weights[ids] = data["weights"]
+        for sname in host_slots:
+            host_slots[sname][ids] = data[f"slot_{sname}"]
+        host_work_id[ids] = data["work_id"]
+        max_work = max(max_work, int(entry["work_id"]))
+    return max_work
 
 
 class HostOffloadedTable:
@@ -183,56 +246,325 @@ class HostOffloadedTable:
 
     def persist(self, path: str) -> Dict[str, Any]:
         """Incremental checkpoint: base on first call, deltas afterwards."""
-        os.makedirs(path, exist_ok=True)
         self.flush()
-        meta_path = os.path.join(path, OFFLOAD_META_FILE)
-        chain = []
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                chain = json.load(f)["checkpoints"]
-        if not chain:
-            fname = f"base_{self.work_id}.npz"
-            np.savez(os.path.join(path, fname),
-                     ids=np.arange(self.vocab, dtype=np.int64),
-                     weights=self.host_weights,
-                     work_id=self.host_work_id,
-                     **{f"slot_{k}": v for k, v in self.host_slots.items()})
-            changed = self.vocab
-        else:
-            dirty = self.host_work_id > self.persisted_work
-            ids = np.nonzero(dirty)[0].astype(np.int64)
-            fname = f"inc_{self.work_id}.npz"
-            np.savez(os.path.join(path, fname),
-                     ids=ids,
-                     weights=self.host_weights[ids],
-                     work_id=self.host_work_id[ids],
-                     **{f"slot_{k}": v[ids]
-                        for k, v in self.host_slots.items()})
-            changed = int(ids.size)
-        chain.append({"file": fname, "work_id": self.work_id})
-        with open(meta_path, "w") as f:
-            json.dump({"checkpoints": chain, "vocab": self.vocab,
-                       "meta": self.meta.to_json()}, f)
+        out = _persist_store(
+            path, vocab=self.vocab, meta=self.meta, work_id=self.work_id,
+            persisted_work=self.persisted_work,
+            host_weights=self.host_weights, host_slots=self.host_slots,
+            host_work_id=self.host_work_id)
         self.persisted_work = self.work_id
         self._batches_since_persist = 0
-        return {"file": fname, "rows": changed}
+        return out
 
     def restore(self, path: str) -> None:
         """Replay base + increments (newest wins by construction)."""
-        with open(os.path.join(path, OFFLOAD_META_FILE)) as f:
-            meta = json.load(f)
-        if int(meta["vocab"]) != self.vocab:
-            raise ValueError(f"offload checkpoint vocab {meta['vocab']} != "
-                             f"table vocab {self.vocab}")
-        max_work = self.work_id
-        for entry in meta["checkpoints"]:
-            data = np.load(os.path.join(path, entry["file"]))
-            ids = data["ids"]
-            self.host_weights[ids] = data["weights"]
-            for sname in self.host_slots:
-                self.host_slots[sname][ids] = data[f"slot_{sname}"]
-            self.host_work_id[ids] = data["work_id"]
-            max_work = max(max_work, int(entry["work_id"]))
-        self.work_id = max_work + 1
+        max_work = _replay_store(
+            path, vocab=self.vocab, host_weights=self.host_weights,
+            host_slots=self.host_slots, host_work_id=self.host_work_id)
+        # keep the watermark monotonic for an in-place restore of a table
+        # that has trained past the checkpoint
+        self.work_id = max(self.work_id, max_work + 1)
         self.persisted_work = max_work
         self.clear_cache()  # stale pre-restore rows must not write back
+
+
+class ShardedOffloadedTable:
+    """Mesh-sharded offload tier: host store + sharded HBM cache + Trainer.
+
+    The industrial composition of :class:`HostOffloadedTable` with the
+    device mesh (the reference's full PMem tier, PmemEmbeddingTable.h +
+    PmemEmbeddingOptimizerVariable.h, per server shard):
+
+    * the **HBM cache is an ordinary sharded hash table** (``sharded_hash``,
+      owner-routed a2a plane) whose state lives wherever the caller keeps
+      embedding states (e.g. ``TrainState.emb``) — the jitted train step
+      pulls/updates it exactly like any hash variable, zero special-casing
+      in the hot path;
+    * the object itself holds only HOST state: the backing row store
+      (optionally a disk-backed memmap) plus exact ``resident`` / ``dirty``
+      / ``last_touch`` books. Because only :meth:`prepare` inserts and only
+      eviction removes, the host knows cache membership without ever
+      probing the device — the reference tracks the same facts in its DRAM
+      index (PmemEmbeddingTable.h:143-163);
+    * overflow evicts the **least-recently-touched batch** (default: down
+      to half capacity), not the whole cache: the cache is streamed to the
+      host once, dirty rows written back, and the still-hot survivors are
+      re-inserted (the reference's LRU eviction, :382-395);
+    * writeback is **asynchronous**: device->host copies are launched with
+      ``copy_to_host_async`` and a writer thread filters + scatters them
+      into the host store while training continues (the VariableAsyncTask
+      role, variable/VariableAsyncTask.h:12-78). ``prepare``/``persist``
+      join the writer before reading host rows.
+
+    The work_id watermark + incremental base/delta persistence protocol is
+    unchanged from :class:`HostOffloadedTable` (the ICDE'23 checkpoint
+    design, PmemEmbeddingTable.h:285-328).
+    """
+
+    def __init__(self, name: str, meta: EmbeddingVariableMeta,
+                 optimizer: Any, initializer: Any = None, *,
+                 vocab: int, cache_capacity: int, mesh,
+                 persist_pending_window: int = 64,
+                 occupancy_threshold: float = 0.7,
+                 keep_fraction: float = 0.5,
+                 backing_dir: Optional[str] = None,
+                 seed: int = 0):
+        from .parallel import sharded_hash as sh
+        self.name = name
+        self.meta = meta
+        self.mesh = mesh
+        self.optimizer = make_optimizer(optimizer)
+        self.initializer = make_initializer(
+            initializer or table_lib.DEFAULT_INITIALIZER)
+        self._optimizer_config = optimizer
+        self._initializer_config = initializer
+        self.vocab = int(vocab)
+        self.cache_capacity = int(cache_capacity)
+        self.persist_pending_window = persist_pending_window
+        self.occupancy_threshold = occupancy_threshold
+        self.keep_fraction = keep_fraction
+        self.spec = sh.make_hash_sharding_spec(mesh, cache_capacity)
+        dim = meta.embedding_dim
+        dtype = np.dtype(table_lib.resolve_dtype(meta))
+
+        def _alloc(fname, shape, adtype, fill=None):
+            if backing_dir:
+                os.makedirs(backing_dir, exist_ok=True)
+                arr = np.lib.format.open_memmap(
+                    os.path.join(backing_dir, f"{name}_{fname}.npy"),
+                    mode="w+", dtype=adtype, shape=shape)
+            else:
+                arr = np.empty(shape, adtype)
+            if fill is not None:
+                arr[:] = fill
+            return arr
+
+        # host store, eagerly initialized in bounded chunks (a table bigger
+        # than HBM must not be materialized on device either)
+        rng = jax.random.PRNGKey(seed)
+        self.host_weights = _alloc("weights", (self.vocab, dim), dtype)
+        chunk = max(1, (64 << 20) // max(1, dim * dtype.itemsize))
+        for lo in range(0, self.vocab, chunk):
+            hi = min(self.vocab, lo + chunk)
+            self.host_weights[lo:hi] = np.asarray(self.initializer.init(
+                jax.random.fold_in(rng, lo), (hi - lo, dim), dtype))
+        self.host_slots: Dict[str, np.ndarray] = {}
+        for sname, sshape in self.optimizer.slot_shapes(dim).items():
+            sdtype = np.dtype(self.optimizer.slot_dtype(sname, dtype))
+            self.host_slots[sname] = _alloc(
+                f"slot_{sname}", (self.vocab,) + tuple(sshape), sdtype,
+                self.optimizer.slot_init(sname))
+        self.host_work_id = _alloc("work_id", (self.vocab,), np.int64, 0)
+
+        self._resident = np.zeros(self.vocab, bool)
+        self._dirty = np.zeros(self.vocab, bool)
+        self._last_touch = np.zeros(self.vocab, np.int64)
+        self.work_id = 1
+        self.persisted_work = 0
+        self._batches_since_persist = 0
+        self._writer: Optional[threading.Thread] = None
+        self._writer_err: Optional[BaseException] = None
+
+    # --- spec / state creation ---------------------------------------------
+    def embedding_spec(self, **kw) -> EmbeddingSpec:
+        """The EmbeddingSpec to register this variable under in a
+        collection: a hash table (the cache) with this table's configs."""
+        return EmbeddingSpec(
+            name=self.name, input_dim=-1, output_dim=self.meta.embedding_dim,
+            dtype=self.meta.datatype, optimizer=self._optimizer_config,
+            initializer=self._initializer_config,
+            hash_capacity=self.cache_capacity, **kw)
+
+    def create_cache(self, rng: Optional[jax.Array] = None):
+        from .parallel import sharded_hash as sh
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return sh.create_sharded_hash_table(
+            self.meta, self.optimizer, mesh=self.mesh, spec=self.spec,
+            rng=rng)
+
+    # --- writer thread ------------------------------------------------------
+    def _join_writeback(self) -> None:
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
+        if self._writer_err is not None:
+            err, self._writer_err = self._writer_err, None
+            raise RuntimeError("async writeback failed") from err
+
+    def _start_writeback(self, cache, dirty_ids: np.ndarray,
+                         after: Optional[Callable[[], None]] = None) -> None:
+        """Launch device->host copy of the cache + background scatter of
+        ``dirty_ids`` rows into the host store."""
+        self._join_writeback()
+        arrays = {"keys": cache.keys, "weights": cache.weights,
+                  **{f"slot_{k}": v for k, v in cache.slots.items()}}
+        for a in arrays.values():
+            for shard in a.addressable_shards:
+                shard.data.copy_to_host_async()
+        work = self.work_id
+
+        def _run():
+            try:
+                host = {k: np.asarray(jax.device_get(v))
+                        for k, v in arrays.items()}
+                keys = host["keys"]
+                # the jitted step auto-inserts whatever batch keys it sees;
+                # out-of-range ids must not index the vocab-sized host store
+                # (negative would alias a real row — silent corruption)
+                live = (keys != hash_lib.empty_key(keys.dtype)) \
+                    & (keys >= 0) & (keys < self.vocab)
+                ids = keys[live]
+                mask = np.zeros(self.vocab, bool)
+                mask[dirty_ids] = True
+                sel = mask[ids]
+                ids = ids[sel]
+                if ids.size:
+                    self.host_weights[ids] = host["weights"][live][sel]
+                    for sname in self.host_slots:
+                        self.host_slots[sname][ids] = \
+                            host[f"slot_{sname}"][live][sel]
+                    self.host_work_id[ids] = work
+                if after is not None:
+                    after()
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                # updates not written: re-mark so a later flush retries
+                # (over-marking rows re-dirtied meanwhile is harmless)
+                self._dirty[dirty_ids] = True
+                self._writer_err = e
+
+        # clear eagerly so updates landing DURING the writeback re-mark
+        # their rows; restored on failure above
+        self._dirty[dirty_ids] = False
+        self._writer = threading.Thread(target=_run, daemon=True)
+        self._writer.start()
+
+    # --- cache management ---------------------------------------------------
+    def _insert_from_host(self, cache, ids: np.ndarray):
+        from .parallel import sharded_hash as sh
+        chunk = 1 << 16
+        key_dtype = np.dtype(cache.keys.dtype)
+        for lo in range(0, ids.size, chunk):
+            sub = ids[lo:lo + chunk]
+            size = min(chunk, max(1, ids.size))
+            ck = np.full((size,), hash_lib.empty_key(key_dtype), key_dtype)
+            ck[:sub.size] = sub
+            cw = np.zeros((size,) + self.host_weights.shape[1:],
+                          self.host_weights.dtype)
+            cw[:sub.size] = self.host_weights[sub]
+            srows = {}
+            for sname, arr in self.host_slots.items():
+                cs = np.zeros((size,) + arr.shape[1:], arr.dtype)
+                cs[:sub.size] = arr[sub]
+                srows[sname] = jnp.asarray(cs)
+            cache = sh.insert_rows_sharded(
+                cache, jnp.asarray(ck), jnp.asarray(cw), srows,
+                mesh=self.mesh, spec=self.spec)
+        if int(jax.device_get(cache.insert_failures)) > 0:
+            raise RuntimeError(
+                f"offloaded table {self.name!r}: HBM cache insert overflow "
+                "— raise cache_capacity or lower occupancy_threshold")
+        return cache
+
+    def prepare(self, cache, ids):
+        """Make every (unique, valid) batch id cache-resident; returns the
+        updated cache state. Evicts the least-recently-touched rows first
+        when the incoming set would overflow the load-factor budget."""
+        # join FIRST: the caller's next jitted step may donate (delete) the
+        # very cache buffers an in-flight async flush is still reading, and
+        # host rows must be current before any gather below
+        self._join_writeback()
+        ids = np.unique(np.asarray(ids).ravel())
+        ids = ids[(ids >= 0) & (ids < self.vocab)]
+        self._last_touch[ids] = self.work_id
+        missing = ids[~self._resident[ids]]
+        budget = int(self.occupancy_threshold * self.cache_capacity)
+        if int(self._resident.sum()) + missing.size > budget:
+            cache = self._evict(cache, protect=ids, budget=budget,
+                                incoming=missing.size)
+            missing = ids[~self._resident[ids]]
+        if missing.size == 0:
+            return cache
+        cache = self._insert_from_host(cache, missing)
+        self._resident[missing] = True
+        return cache
+
+    def _evict(self, cache, protect: np.ndarray, budget: int,
+               incoming: int):
+        """LRU-batch eviction: write back dirty rows, keep the hottest
+        survivors, rebuild the cache with them (open-addressing tables
+        never delete, so eviction = writeback + rebuild-from-host)."""
+        self._join_writeback()
+        resident_ids = np.nonzero(self._resident)[0]
+        keep_target = max(0, min(int(self.keep_fraction * budget),
+                                 budget - incoming))
+        prot = np.zeros(self.vocab, bool)
+        prot[protect] = True
+        candidates = resident_ids[~prot[resident_ids]]
+        order = np.argsort(self._last_touch[candidates], kind="stable")
+        keep_protected = resident_ids[prot[resident_ids]]
+        n_keep = max(0, keep_target - keep_protected.size)
+        keep = np.concatenate([keep_protected, candidates[order][::-1][:n_keep]])
+        # writeback every dirty resident row (host becomes fully current),
+        # synchronously — the rebuild below must read current host rows
+        dirty_ids = resident_ids[self._dirty[resident_ids]]
+        self._start_writeback(cache, dirty_ids)
+        self._join_writeback()
+        cache = self.create_cache(jax.random.PRNGKey(int(self.work_id)))
+        self._resident[:] = False
+        if keep.size:
+            cache = self._insert_from_host(cache, np.sort(keep))
+            self._resident[keep] = True
+        return cache
+
+    # --- step bookkeeping ---------------------------------------------------
+    def note_update(self, ids) -> None:
+        """Record that the jitted step applied gradients for ``ids``
+        (host-side dirty marks + work watermark advance)."""
+        ids = np.unique(np.asarray(ids).ravel())
+        ids = ids[(ids >= 0) & (ids < self.vocab)]
+        self._dirty[ids] = True
+        self.work_id += 1
+        self._batches_since_persist += 1
+
+    # --- persistence --------------------------------------------------------
+    def flush(self, cache) -> int:
+        """Asynchronously write back all dirty rows (cache stays intact)."""
+        dirty_ids = np.nonzero(self._dirty)[0]
+        if dirty_ids.size:
+            self._start_writeback(cache, dirty_ids)
+        return int(dirty_ids.size)
+
+    @property
+    def should_persist(self) -> bool:
+        used = int(self._resident.sum())
+        return (self._batches_since_persist >= self.persist_pending_window
+                or used >= self.occupancy_threshold * self.cache_capacity)
+
+    def persist(self, cache, path: str) -> Dict[str, Any]:
+        """Incremental checkpoint (base on first call, deltas afterwards)."""
+        self.flush(cache)
+        self._join_writeback()
+        out = _persist_store(
+            path, vocab=self.vocab, meta=self.meta, work_id=self.work_id,
+            persisted_work=self.persisted_work,
+            host_weights=self.host_weights, host_slots=self.host_slots,
+            host_work_id=self.host_work_id)
+        self.persisted_work = self.work_id
+        self._batches_since_persist = 0
+        return out
+
+    def restore(self, path: str):
+        """Replay base + increments into the host store; returns a FRESH
+        empty cache state (pre-restore cache rows must not write back)."""
+        self._join_writeback()
+        max_work = _replay_store(
+            path, vocab=self.vocab, host_weights=self.host_weights,
+            host_slots=self.host_slots, host_work_id=self.host_work_id)
+        self.work_id = max(self.work_id, max_work + 1)
+        self.persisted_work = max_work
+        self._batches_since_persist = 0
+        self._resident[:] = False
+        self._dirty[:] = False
+        self._last_touch[:] = 0
+        return self.create_cache(jax.random.PRNGKey(int(self.work_id)))

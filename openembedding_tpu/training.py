@@ -61,11 +61,19 @@ class Trainer:
     def __init__(self, module, collection: EmbeddingCollection,
                  dense_optimizer: optax.GradientTransformation,
                  loss_fn: Callable = binary_logloss,
-                 sparse_as_dense: Optional[Any] = None):
+                 sparse_as_dense: Optional[Any] = None,
+                 offload: Optional[Dict[str, Any]] = None):
         """``sparse_as_dense``: DenseFeatureSpecs (from
         ``hybrid.split_sparse_dense``) kept as flax params inside the model —
         the reference's "Cache" hybrid. Batch ``sparse`` columns are routed
-        by name: dense-kept features never touch the sharded path."""
+        by name: dense-kept features never touch the sharded path.
+
+        ``offload``: name -> ShardedOffloadedTable for variables whose host
+        store exceeds HBM (the reference's PMem tier). The variable's cache
+        state lives in ``TrainState.emb`` like any hash variable; the
+        Trainer auto-prepares each batch's rows before the jitted step and
+        records dirty marks after it (PmemEmbeddingOptimizerVariable.h's
+        pre-touch + work advance)."""
         if sparse_as_dense:
             from .hybrid import HybridModel
             module = HybridModel(inner=module,
@@ -78,6 +86,12 @@ class Trainer:
         self.collection = collection
         self.tx = dense_optimizer
         self.loss_fn = loss_fn
+        self.offload = dict(offload or {})
+        for oname in self.offload:
+            if oname not in collection.specs:
+                raise ValueError(
+                    f"offloaded variable {oname!r} is not in the collection; "
+                    "register table.embedding_spec() in its specs")
         self.mesh = collection.mesh
         self._replicated = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -166,7 +180,27 @@ class Trainer:
     def train_step(self, state: TrainState, batch) -> tuple:
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        return self._train_step(state, self.shard_batch(batch))
+        state = self.prepare_offload(state, batch)
+        state, metrics = self._train_step(state, self.shard_batch(batch))
+        for name, table in self.offload.items():
+            table.note_update(batch["sparse"][name])
+        return state, metrics
+
+    def prepare_offload(self, state: TrainState, batch) -> TrainState:
+        """Pre-touch offloaded rows for this batch (host->HBM cache inserts).
+
+        train_step calls this automatically; for evaluation, call it
+        yourself and eval with the returned state:
+
+            state = trainer.prepare_offload(state, batch)
+            scores = trainer.eval_step(state, batch)
+        """
+        if not self.offload:
+            return state
+        emb = dict(state.emb)
+        for name, table in self.offload.items():
+            emb[name] = table.prepare(emb[name], batch["sparse"][name])
+        return state.replace(emb=emb)
 
     def eval_step(self, state: TrainState, batch) -> jnp.ndarray:
         if self._eval_step is None:

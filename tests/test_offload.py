@@ -9,7 +9,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from openembedding_tpu import EmbeddingVariableMeta
+from openembedding_tpu import EmbeddingVariableMeta, make_optimizer
 from openembedding_tpu.offload import HostOffloadedTable
 
 DIM = 4
@@ -116,3 +116,143 @@ def test_restore_vocab_mismatch(tmp_path):
         cache_capacity=64)
     with pytest.raises(ValueError, match="vocab"):
         t2.restore(p)
+
+
+# --- sharded offload tier ----------------------------------------------------
+
+class TestShardedOffload:
+    def _make(self, mesh, vocab=1024, cache=128, **kw):
+        from openembedding_tpu.offload import ShardedOffloadedTable
+        meta = EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=vocab)
+        return ShardedOffloadedTable(
+            "off", meta, {"category": "adagrad", "learning_rate": 0.1},
+            {"category": "constant", "value": 0.25},
+            vocab=vocab, cache_capacity=cache, mesh=mesh, **kw)
+
+    def _ground_truth_steps(self, batches):
+        """Plain in-HBM array table trained on the same stream."""
+        from openembedding_tpu import create_table, apply_gradients, pull
+        meta = EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=1024)
+        opt = make_optimizer({"category": "adagrad", "learning_rate": 0.1})
+        t = create_table(meta, opt,
+                         {"category": "constant", "value": 0.25})
+        for ids, grads in batches:
+            t = apply_gradients(t, opt, jnp.asarray(ids), jnp.asarray(grads))
+        return t
+
+    def _stream(self, steps, seed=0):
+        rng = np.random.RandomState(seed)
+        out = []
+        for i in range(steps):
+            # rotate through id ranges so the small cache must evict
+            lo = (i * 160) % 800
+            ids = rng.randint(lo, lo + 200, 64).astype(np.int32)
+            out.append((ids, rng.randn(64, 4).astype(np.float32)))
+        return out
+
+    def test_eviction_parity_with_plain_table(self, devices8):
+        from openembedding_tpu.parallel.mesh import create_mesh
+        from openembedding_tpu.parallel import sharded_hash as sh
+        mesh = create_mesh(2, 4, devices8)
+        table = self._make(mesh, cache=256)
+        cache = table.create_cache()
+        stream = self._stream(8)
+        for ids, grads in stream:
+            cache = table.prepare(cache, ids)
+            rows = sh.pull_sharded(cache, jnp.asarray(ids), None,
+                                   mesh=mesh, spec=table.spec,
+                                   batch_sharded=False)
+            cache = sh.apply_gradients_sharded(
+                cache, table.optimizer, table.initializer,
+                jnp.asarray(ids), jnp.asarray(grads),
+                mesh=mesh, spec=table.spec, batch_sharded=False)
+            table.note_update(ids)
+        want = self._ground_truth_steps(stream)
+        # flush everything and compare host store to ground truth
+        table.flush(cache)
+        table._join_writeback()
+        from openembedding_tpu import pull
+        probe = np.arange(1024, dtype=np.int32)
+        np.testing.assert_allclose(
+            table.host_weights, np.asarray(pull(want, jnp.asarray(probe))),
+            rtol=1e-5, atol=1e-6)
+
+    def test_persist_kill_restore_continue(self, devices8, tmp_path):
+        """The reference's pmem_c_api_test.cpp:7-37 flow: train, persist,
+        crash, restore, continue — equals an uninterrupted run."""
+        from openembedding_tpu.parallel.mesh import create_mesh
+        from openembedding_tpu.parallel import sharded_hash as sh
+        mesh = create_mesh(2, 4, devices8)
+        pdir = str(tmp_path / "persist")
+        stream = self._stream(6, seed=3)
+
+        def run(table, cache, items):
+            for ids, grads in items:
+                cache = table.prepare(cache, ids)
+                cache = sh.apply_gradients_sharded(
+                    cache, table.optimizer, table.initializer,
+                    jnp.asarray(ids), jnp.asarray(grads),
+                    mesh=mesh, spec=table.spec, batch_sharded=False)
+                table.note_update(ids)
+            return cache
+
+        t1 = self._make(mesh, cache=256)
+        c1 = run(t1, t1.create_cache(), stream[:3])
+        t1.persist(c1, pdir)              # base checkpoint
+        c1 = run(t1, c1, stream[3:])
+        t1.persist(c1, pdir)              # incremental delta
+        t1.flush(c1); t1._join_writeback()
+        want = t1.host_weights.copy()
+
+        # crash: a FRESH process-equivalent restores and replays nothing —
+        # the persisted state must already be complete
+        t2 = self._make(mesh, cache=256)
+        c2 = t2.restore(pdir)
+        np.testing.assert_allclose(t2.host_weights, want,
+                                   rtol=1e-6, atol=1e-7)
+        # restore resumes at the batch AFTER the persisted watermark
+        assert t2.work_id == t1.work_id + 1
+        assert t2.persisted_work == t1.persisted_work
+        # continue training from the restored state: both runs agree
+        more = self._stream(2, seed=9)
+        c1 = run(t1, c1, more)
+        c2 = run(t2, c2, more)
+        t1.flush(c1); t1._join_writeback()
+        t2.flush(c2); t2._join_writeback()
+        np.testing.assert_allclose(t2.host_weights, t1.host_weights,
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_trainer_integration(self, devices8):
+        """Offloaded variable trains through Trainer.fit + eval path."""
+        import optax
+        from openembedding_tpu import EmbeddingCollection, Trainer
+        from openembedding_tpu.models import deepctr
+        from openembedding_tpu.parallel.mesh import create_mesh
+        mesh = create_mesh(2, 4, devices8)
+        table = self._make(mesh, vocab=4096, cache=256)
+        spec = table.embedding_spec()
+        lin = table.embedding_spec().__class__(
+            **{**table.embedding_spec().__dict__, "name": "off:linear",
+               "output_dim": 1})
+        coll = EmbeddingCollection((spec, lin), mesh)
+        trainer = Trainer(
+            deepctr.LogisticRegression(feature_names=("off",)),
+            coll, optax.sgd(0.1), offload={"off": table})
+        rng = np.random.RandomState(0)
+
+        def batch():
+            ids = rng.randint(0, 4096, 32).astype(np.int32)
+            return {"label": (ids % 2).astype(np.float32), "dense": None,
+                    "sparse": {"off": ids, "off:linear": ids}}
+
+        state = trainer.init(jax.random.PRNGKey(0),
+                             trainer.shard_batch(batch()))
+        for _ in range(3):
+            b = batch()
+            state, m = trainer.train_step(state, b)
+            assert np.isfinite(float(m["loss"]))
+        assert table.work_id > 1
+        b = batch()
+        state = trainer.prepare_offload(state, b)
+        scores = trainer.eval_step(state, b)
+        assert scores.shape == (32,)
