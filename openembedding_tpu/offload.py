@@ -36,7 +36,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -357,6 +357,7 @@ class ShardedOffloadedTable:
         self.host_work_id = _alloc("work_id", (self.vocab,), np.int64, 0)
 
         self._resident = np.zeros(self.vocab, bool)
+        self._resident_count = 0  # kept exact; vocab-sized sums are O(GB)
         self._dirty = np.zeros(self.vocab, bool)
         self._last_touch = np.zeros(self.vocab, np.int64)
         self.work_id = 1
@@ -368,12 +369,15 @@ class ShardedOffloadedTable:
     # --- spec / state creation ---------------------------------------------
     def embedding_spec(self, **kw) -> EmbeddingSpec:
         """The EmbeddingSpec to register this variable under in a
-        collection: a hash table (the cache) with this table's configs."""
-        return EmbeddingSpec(
+        collection: a hash table (the cache) with this table's configs.
+        Any field may be overridden via ``kw`` (e.g. a companion
+        ``name=.../output_dim=1`` linear spec)."""
+        base = dict(
             name=self.name, input_dim=-1, output_dim=self.meta.embedding_dim,
             dtype=self.meta.datatype, optimizer=self._optimizer_config,
             initializer=self._initializer_config,
-            hash_capacity=self.cache_capacity, **kw)
+            hash_capacity=self.cache_capacity)
+        return EmbeddingSpec(**{**base, **kw})
 
     def create_cache(self, rng: Optional[jax.Array] = None):
         from .parallel import sharded_hash as sh
@@ -392,8 +396,7 @@ class ShardedOffloadedTable:
             err, self._writer_err = self._writer_err, None
             raise RuntimeError("async writeback failed") from err
 
-    def _start_writeback(self, cache, dirty_ids: np.ndarray,
-                         after: Optional[Callable[[], None]] = None) -> None:
+    def _start_writeback(self, cache, dirty_ids: np.ndarray) -> None:
         """Launch device->host copy of the cache + background scatter of
         ``dirty_ids`` rows into the host store."""
         self._join_writeback()
@@ -425,8 +428,6 @@ class ShardedOffloadedTable:
                         self.host_slots[sname][ids] = \
                             host[f"slot_{sname}"][live][sel]
                     self.host_work_id[ids] = work
-                if after is not None:
-                    after()
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 # updates not written: re-mark so a later flush retries
                 # (over-marking rows re-dirtied meanwhile is harmless)
@@ -446,7 +447,11 @@ class ShardedOffloadedTable:
         key_dtype = np.dtype(cache.keys.dtype)
         for lo in range(0, ids.size, chunk):
             sub = ids[lo:lo + chunk]
-            size = min(chunk, max(1, ids.size))
+            # pad to the next power of two: miss counts are data-dependent
+            # and the jitted insert program compiles per shape — a handful
+            # of bucket sizes instead of one compile per distinct count
+            size = 1 << max(5, int(np.ceil(np.log2(max(2, sub.size)))))
+            size = min(size, chunk)
             ck = np.full((size,), hash_lib.empty_key(key_dtype), key_dtype)
             ck[:sub.size] = sub
             cw = np.zeros((size,) + self.host_weights.shape[1:],
@@ -479,7 +484,7 @@ class ShardedOffloadedTable:
         self._last_touch[ids] = self.work_id
         missing = ids[~self._resident[ids]]
         budget = int(self.occupancy_threshold * self.cache_capacity)
-        if int(self._resident.sum()) + missing.size > budget:
+        if self._resident_count + missing.size > budget:
             cache = self._evict(cache, protect=ids, budget=budget,
                                 incoming=missing.size)
             missing = ids[~self._resident[ids]]
@@ -487,6 +492,7 @@ class ShardedOffloadedTable:
             return cache
         cache = self._insert_from_host(cache, missing)
         self._resident[missing] = True
+        self._resident_count += int(missing.size)
         return cache
 
     def _evict(self, cache, protect: np.ndarray, budget: int,
@@ -512,9 +518,11 @@ class ShardedOffloadedTable:
         self._join_writeback()
         cache = self.create_cache(jax.random.PRNGKey(int(self.work_id)))
         self._resident[:] = False
+        self._resident_count = 0
         if keep.size:
             cache = self._insert_from_host(cache, np.sort(keep))
             self._resident[keep] = True
+            self._resident_count = int(keep.size)
         return cache
 
     # --- step bookkeeping ---------------------------------------------------
@@ -537,9 +545,9 @@ class ShardedOffloadedTable:
 
     @property
     def should_persist(self) -> bool:
-        used = int(self._resident.sum())
         return (self._batches_since_persist >= self.persist_pending_window
-                or used >= self.occupancy_threshold * self.cache_capacity)
+                or self._resident_count
+                >= self.occupancy_threshold * self.cache_capacity)
 
     def persist(self, cache, path: str) -> Dict[str, Any]:
         """Incremental checkpoint (base on first call, deltas afterwards)."""
@@ -565,6 +573,7 @@ class ShardedOffloadedTable:
         self.persisted_work = max_work
         self._batches_since_persist = 0
         self._resident[:] = False
+        self._resident_count = 0
         self._dirty[:] = False
         self._last_touch[:] = 0
         return self.create_cache(jax.random.PRNGKey(int(self.work_id)))

@@ -89,6 +89,17 @@ def make_handler(registry: ModelRegistry, peers=None):
                     # cluster liveness through any replica's REST surface —
                     # the controller's node listing over the master registry
                     return self._send(200, probe_nodes(peers))
+                if self.path == "/metrics":
+                    # prometheus text exposition (reference server.cc:32-36)
+                    from ..utils.observability import prometheus_text
+                    body = prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
                 if self.path == "/models":
                     return self._send(200, registry.show_models())
                 m = re.fullmatch(r"/models/([^/]+)", self.path)

@@ -104,6 +104,37 @@ def record_batch_stats(sparse: Dict[str, np.ndarray],
         acc.add("pull_unique", np.unique(arr).size)
 
 
+def _prom_name(name: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return out.lstrip("0123456789_") or "metric"
+
+
+def prometheus_text(accumulator: Optional[Accumulator] = None,
+                    prefix: str = "oe") -> str:
+    """Render the accumulator in Prometheus text exposition format.
+
+    The serving controller exposes this at GET /metrics — parity with the
+    reference PS daemon's prometheus exposer (entry/server.cc:32-36,
+    --enable_metrics/--metrics_url). Counters become ``<prefix>_<name>_total``;
+    timers contribute ``_seconds_total`` and ``_calls_total`` pairs.
+    """
+    acc = accumulator or GLOBAL
+    lines = []
+    snap = acc.snapshot()
+    for name in sorted(snap):
+        base = f"{prefix}_{_prom_name(name)}"
+        fields = snap[name]
+        if "count" in fields:
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total {fields['count']:.10g}")
+        if "seconds" in fields:
+            lines.append(f"# TYPE {base}_seconds_total counter")
+            lines.append(f"{base}_seconds_total {fields['seconds']:.10g}")
+            lines.append(f"# TYPE {base}_calls_total counter")
+            lines.append(f"{base}_calls_total {fields['calls']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 class Reporter:
     """Rank-0 periodic metrics printer (WorkerContext reporter thread).
 

@@ -1,0 +1,33 @@
+"""Examples ARE the integration suite — the reference runs every
+examples/run/*.sh in CI (build.sh:95-151). Here the canonical CLI runs for a
+few steps in-process (fast: shares the warmed JAX runtime) across the main
+configuration axes."""
+
+import sys
+
+import pytest
+
+
+def _run(argv):
+    from examples import criteo_deepctr
+    assert criteo_deepctr.main(argv) == 0
+
+
+BASE = ["--num_buckets", "2048", "--batch_size", "128", "--steps", "4",
+        "--embedding_dim", "4", "--data_parallel", "2", "--log_every", "0"]
+
+
+def test_example_fused_deepfm(devices8, tmp_path):
+    _run(["--model", "deepfm", *BASE,
+          "--save", str(tmp_path / "ck")])
+    _run(["--model", "deepfm", *BASE, "--steps", "0",
+          "--load", str(tmp_path / "ck"), "--eval_steps", "2"])
+
+
+def test_example_wdl_psum_plane(devices8):
+    _run(["--model", "wdl", *BASE, "--plane", "psum"])
+
+
+def test_example_lr_hybrid_and_history(devices8):
+    _run(["--model", "lr", *BASE, "--no-fused",
+          "--sparse_as_dense", "2048", "--hist_len", "4"])

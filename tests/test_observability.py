@@ -71,3 +71,36 @@ def test_streaming_auc_exact_cases():
     auc4 = obs.StreamingAUC()
     auc4.update([1, 1], [0.5, 0.6])
     assert auc4.result() == 0.5
+
+
+def test_prometheus_text_and_endpoint(devices8):
+    """Accumulator -> prometheus text, scrapeable via the REST controller
+    (the reference PS daemon's --enable_metrics exposer, server.cc:32-36)."""
+    import urllib.request
+    import jax
+    from openembedding_tpu.utils import observability as obs
+    from openembedding_tpu.serving.registry import ModelRegistry
+    from openembedding_tpu.serving.rest import ControllerServer
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    obs.GLOBAL.reset()
+    obs.GLOBAL.add("pull_indices", 512)
+    with obs.vtimer("train_step"):
+        pass
+    text = obs.prometheus_text()
+    assert "# TYPE oe_pull_indices_total counter" in text
+    assert "oe_pull_indices_total 512" in text
+    assert "oe_train_step_seconds_total" in text
+    assert "oe_train_step_calls_total 1" in text
+
+    reg = ModelRegistry(create_mesh(1, 1, jax.devices()[:1]))
+    srv = ControllerServer(reg, port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "oe_pull_indices_total 512" in body
+    finally:
+        srv.stop()
+        obs.GLOBAL.reset()

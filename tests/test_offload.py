@@ -231,9 +231,7 @@ class TestShardedOffload:
         mesh = create_mesh(2, 4, devices8)
         table = self._make(mesh, vocab=4096, cache=256)
         spec = table.embedding_spec()
-        lin = table.embedding_spec().__class__(
-            **{**table.embedding_spec().__dict__, "name": "off:linear",
-               "output_dim": 1})
+        lin = table.embedding_spec(name="off:linear", output_dim=1)
         coll = EmbeddingCollection((spec, lin), mesh)
         trainer = Trainer(
             deepctr.LogisticRegression(feature_names=("off",)),
