@@ -1,89 +1,236 @@
-"""Benchmark driver: DeepFM training throughput, one JSON line to stdout.
+"""Benchmark driver: sparse-embedding training throughput + checkpoint IO.
 
-Mirrors the reference's headline benchmark (test/benchmark/criteo_deepctr.py,
-documents/en/benchmark.md:41-52): DeepFM, embedding dim 9, Adagrad, 26
-categorical features with hashed ids, batch 4096 per chip, Criteo-shaped
-synthetic stream. The reference's Criteo-1TB number is 692k examples/s on
-8 GPU workers + 1 PS = 86.5k examples/s per accelerator chip —
-``vs_baseline`` is examples/s/chip against that per-chip rate.
+Default invocation (the driver contract) runs the headline config and prints
+ONE JSON line. ``--suite`` runs the full matrix — the reference benchmarks
+across model families, dims, table kinds and dataset skew
+(test/benchmark/criteo_deepctr.py flags + documents/en/benchmark.md) — one
+JSON line per config, and writes ``bench_suite.json``.
+
+Headline baseline: the reference's Criteo-1TB number (692k examples/s on
+8 GPU workers + 1 PS, documents/en/benchmark.md:41-52) = 86.5k examples/s
+per accelerator chip; ``vs_baseline`` is examples/s/chip against that.
+Checkpoint baseline: 78 GB in 869 s = 0.09 GB/s (benchmark.md:52-55).
+
+Per-config extras: ``emb_gbps`` estimates achieved HBM traffic on the
+embedding path (gather reads + update read/writes incl. optimizer slots) —
+the honest utilization number for a bandwidth-bound workload (an MXU-centric
+MFU would flatter it: the dense MLP is a small fraction of the work).
 """
 
+import argparse
 import json
-import os
 import sys
 import time
 
 import numpy as np
 
-REF_PER_CHIP = 692_000 / 8  # examples/s per accelerator in the reference
+REF_PER_CHIP = 692_000 / 8     # examples/s per accelerator in the reference
+REF_CKPT_GBPS = 78.0 / 869.0   # reference checkpoint throughput
 
 
-def main():
+def build(config, mesh):
     import jax
-    import jax.numpy as jnp
     import optax
 
     from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.data import criteo
     from openembedding_tpu.fused import make_fused_specs
     from openembedding_tpu.models import deepctr
+
+    features = tuple(criteo.SPARSE_NAMES)
+    if config.get("fused", True):
+        specs, mapper = make_fused_specs(
+            features, -1 if config.get("hash") else config["vocab"],
+            config["dim"],
+            optimizer={"category": "adagrad", "learning_rate": 0.01},
+            hash_capacity=config.get("hash_capacity", 1 << 22))
+    else:
+        specs = deepctr.make_feature_specs(
+            features, config["vocab"], config["dim"],
+            optimizer={"category": "adagrad", "learning_rate": 0.01})
+        mapper = None
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model(config.get("model", "deepfm"),
+                                          features),
+                      coll, optax.adagrad(0.01))
+    return features, coll, trainer, mapper
+
+
+def make_batches(config, features, mapper, n=8):
+    from openembedding_tpu.data import criteo
+    batch = config["batch"]
+    if config.get("zipf"):
+        stream = criteo.synthetic_criteo(
+            batch, num_buckets=config["vocab"], num_batches=n)
+        raw = list(stream)
+    else:
+        rng = np.random.RandomState(0)
+        raw = []
+        for _ in range(n):
+            sparse = {f: rng.randint(0, config["vocab"], batch)
+                      .astype(np.int32) for f in features}
+            raw.append({"label": (rng.rand(batch) > 0.75).astype(np.float32),
+                        "dense": rng.randn(batch, 13).astype(np.float32),
+                        "sparse": sparse})
+    if mapper is not None:
+        return [mapper.fuse_batch(b) for b in raw]
+    return list(criteo.add_linear_columns(raw))
+
+
+def emb_bytes_per_step(config, batch):
+    """Estimated embedding-path HBM bytes per step: gather reads of B*F rows
+    (dim + 1 linear) + update read/write of touched rows incl. one adagrad
+    slot (approximating touched ~= B*F; dedup lowers it under zipf)."""
+    f = 26
+    row = (config["dim"] + 1) * 4
+    gather = batch * f * row
+    update = 2 * batch * f * (row * 2)   # read+write of weights+slot rows
+    return gather + update
+
+
+def run_config(name, config, *, steps, warmup):
+    import jax
     from openembedding_tpu.parallel.mesh import create_mesh
 
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
-    # one chip: pure model placement; multi-chip: (data, model) split
     data_ax = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
     mesh = create_mesh(data_ax, n_dev // data_ax)
+    batch = config["batch"]
 
-    features = tuple(f"c{i}" for i in range(26))
-    batch = 4096
-    dim = 9
-    vocab_per_feature = 1 << 20  # bounded ids (hashed host-side like TSV path)
-
-    specs, mapper = make_fused_specs(
-        features, vocab_per_feature, dim,
-        optimizer={"category": "adagrad", "learning_rate": 0.01})
-    coll = EmbeddingCollection(specs, mesh)
-    trainer = Trainer(deepctr.build_model("deepfm", features), coll,
-                      optax.adagrad(0.01))
-
-    rng = np.random.RandomState(0)
-
-    def make_batch():
-        sparse = {f: rng.randint(0, vocab_per_feature, batch).astype(np.int32)
-                  for f in features}
-        return mapper.fuse_batch({
-            "label": (rng.rand(batch) > 0.5).astype(np.float32),
-            "dense": rng.randn(batch, 13).astype(np.float32),
-            "sparse": sparse,
-        })
-
-    batches = [make_batch() for _ in range(8)]
+    features, coll, trainer, mapper = build(config, mesh)
+    batches = make_batches(config, features, mapper)
     state = trainer.init(jax.random.PRNGKey(0),
                          trainer.shard_batch(batches[0]))
-
-    # warmup: first call compiles; the next ~30 let the runtime reach steady
-    # state (executable caching / autotuning on the device link)
-    warmup = 35 if platform != "cpu" else 1
     for i in range(warmup):
         state, m = trainer.train_step(state, batches[i % len(batches)])
     jax.block_until_ready(m["loss"])
 
-    steps = 60 if platform != "cpu" else 5
     t0 = time.perf_counter()
     for i in range(steps):
         state, m = trainer.train_step(state, batches[i % len(batches)])
     jax.block_until_ready(m["loss"])
     dt = time.perf_counter() - t0
 
-    examples_per_sec = steps * batch / dt
-    per_chip = examples_per_sec / n_dev
-    print(json.dumps({
-        "metric": f"deepfm_dim9_adagrad_examples_per_sec_{platform}{n_dev}",
-        "value": round(examples_per_sec, 1),
+    eps = steps * batch / dt
+    result = {
+        "metric": f"{name}_examples_per_sec_{platform}{n_dev}",
+        "value": round(eps, 1),
         "unit": "examples/s",
-        "vs_baseline": round(per_chip / REF_PER_CHIP, 3),
-    }))
+        "vs_baseline": round(eps / n_dev / REF_PER_CHIP, 3),
+        "per_chip": round(eps / n_dev, 1),
+        "step_ms": round(1000 * dt / steps, 3),
+        "emb_gbps": round(emb_bytes_per_step(config, batch) * steps
+                          / dt / 1e9, 2),
+        "config": dict(config),
+    }
+    if config.get("checkpoint"):
+        result.update(run_checkpoint(coll, state))
+    del state
+    return result
+
+
+def run_checkpoint(coll, state):
+    """Save+load wall time for this config's tables (reference: 78GB/869s)."""
+    import shutil
+    import tempfile
+    import jax
+    from openembedding_tpu import checkpoint as ckpt
+
+    nbytes = sum(x.nbytes for x in jax.tree.leaves(state.emb))
+    d = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        t0 = time.perf_counter()
+        ckpt.save_checkpoint(d, coll, state.emb)
+        save_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loaded = ckpt.load_checkpoint(d, coll)
+        jax.block_until_ready(jax.tree.leaves(loaded))
+        load_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    gb = nbytes / 1e9
+    return {
+        "ckpt_gb": round(gb, 3),
+        "ckpt_save_s": round(save_s, 2),
+        "ckpt_load_s": round(load_s, 2),
+        "ckpt_gbps_vs_ref": round(gb / max(save_s, 1e-9) / REF_CKPT_GBPS, 2),
+    }
+
+
+# The matrix: the reference benchmarks WDL/DeepFM/xDeepFM at dims 9 and 64
+# over hashed Criteo ids (benchmark.md). "vocab" is PER FEATURE (26 features
+# -> total rows = 26 * vocab): bigvocab lands at 26 * 2^22 ~= 2^26.7 total
+# rows (dim 9 + linear + adagrad slots ~= 9 GB HBM) — a non-toy table; the
+# OOM guard skips configs the local chip cannot hold.
+CONFIGS = {
+    "deepfm_dim9": {"model": "deepfm", "dim": 9, "vocab": 1 << 20,
+                    "batch": 4096},
+    "deepfm_dim9_zipf_bigvocab": {
+        "model": "deepfm", "dim": 9, "vocab": 1 << 22, "batch": 4096,
+        "zipf": True},
+    "deepfm_dim64": {"model": "deepfm", "dim": 64, "vocab": 1 << 18,
+                     "batch": 4096, "zipf": True},
+    # checkpoint timing on a deliberately small table: the bench link
+    # (tunneled chip) moves ~10 MB/s device->host, so GB-scale dumps are
+    # link-bound; the per-GB rate extrapolates
+    "ckpt_dim9": {"model": "deepfm", "dim": 9, "vocab": 1 << 16,
+                  "batch": 4096, "checkpoint": True},
+    "deepfm_dim9_hash": {"model": "deepfm", "dim": 9, "vocab": 1 << 22,
+                         "batch": 4096, "zipf": True, "hash": True,
+                         "hash_capacity": 1 << 23},
+    "deepfm_dim9_per_feature": {"model": "deepfm", "dim": 9,
+                                "vocab": 1 << 18, "batch": 4096,
+                                "fused": False},
+    "wdl_dim64": {"model": "wdl", "dim": 64, "vocab": 1 << 18,
+                  "batch": 4096, "zipf": True},
+    "xdeepfm_dim16": {"model": "xdeepfm", "dim": 16, "vocab": 1 << 20,
+                      "batch": 2048, "zipf": True},
+}
+HEADLINE = "deepfm_dim9"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--suite", action="store_true",
+                   help="run every config (one JSON line each + "
+                        "bench_suite.json); default runs the headline only")
+    p.add_argument("--configs", default="",
+                   help="comma-separated subset of configs to run")
+    p.add_argument("--steps", type=int, default=0, help="0 = auto")
+    args = p.parse_args(argv)
+
+    import jax
+    platform = jax.devices()[0].platform
+    steps = args.steps or (60 if platform != "cpu" else 5)
+    warmup = 35 if platform != "cpu" else 1
+
+    if args.configs:
+        names = [n.strip() for n in args.configs.split(",") if n.strip()]
+    elif args.suite:
+        names = list(CONFIGS)
+    else:
+        names = [HEADLINE]
+
+    results = []
+    for name in names:
+        try:
+            r = run_config(name, CONFIGS[name], steps=steps, warmup=warmup)
+        except Exception as e:  # noqa: BLE001 — a config too big for this
+            # chip (OOM) must not kill the rest of the suite
+            r = {"metric": name, "error": f"{type(e).__name__}: {e}"}
+        results.append(r)
+        if args.suite or args.configs:
+            print(json.dumps(r), flush=True)
+    if not (args.suite or args.configs):
+        print(json.dumps(results[0]))
+    if args.suite:
+        with open("bench_suite.json", "w") as f:
+            json.dump(results, f, indent=2)
+    # a failed config must fail the invocation — a driver/CI gating on the
+    # exit status should not see a silent benchmark regression
+    return 1 if any("error" in r for r in results) else 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,466 @@
+// oe_serving.cc — native serving runtime (see oe_serving.h).
+//
+// Design: mmap the .npy files (zero copy-in, the OS pages rows on demand —
+// the role the reference's in-RAM PS shards + zero-copy RpcView play for
+// its serving cluster, server/RpcView.h), parse the two self-describing
+// formats involved (model_meta JSON, numpy .npy headers) with small local
+// parsers so the library has no dependencies beyond the C++17 standard
+// library, and serve lookups lock-free (the maps are immutable after load).
+
+#include "oe_serving.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser (objects/arrays/strings/numbers/bools/null) — enough
+// for model_meta, which this framework writes itself.
+// ---------------------------------------------------------------------------
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json* get(const std::string& key) const {
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  void skip() {
+    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+  }
+  bool consume(char c) {
+    skip();
+    if (p < end && *p == c) { ++p; return true; }
+    return false;
+  }
+  Json parse() {
+    skip();
+    Json j;
+    if (p >= end) { ok = false; return j; }
+    switch (*p) {
+      case '{': {
+        ++p;
+        j.kind = Json::kObj;
+        skip();
+        if (consume('}')) return j;
+        do {
+          skip();
+          Json key = parse_string();
+          if (!ok || !consume(':')) { ok = false; return j; }
+          j.obj[key.str] = parse();
+        } while (ok && consume(','));
+        if (!consume('}')) ok = false;
+        return j;
+      }
+      case '[': {
+        ++p;
+        j.kind = Json::kArr;
+        skip();
+        if (consume(']')) return j;
+        do {
+          j.arr.push_back(parse());
+        } while (ok && consume(','));
+        if (!consume(']')) ok = false;
+        return j;
+      }
+      case '"':
+        return parse_string();
+      case 't':
+        if (end - p >= 4 && !std::strncmp(p, "true", 4)) {
+          p += 4; j.kind = Json::kBool; j.b = true; return j;
+        }
+        ok = false; return j;
+      case 'f':
+        if (end - p >= 5 && !std::strncmp(p, "false", 5)) {
+          p += 5; j.kind = Json::kBool; return j;
+        }
+        ok = false; return j;
+      case 'n':
+        if (end - p >= 4 && !std::strncmp(p, "null", 4)) { p += 4; return j; }
+        ok = false; return j;
+      default: {
+        char* num_end = nullptr;
+        j.num = std::strtod(p, &num_end);
+        if (num_end == p || num_end > end) { ok = false; return j; }
+        j.kind = Json::kNum;
+        p = num_end;
+        return j;
+      }
+    }
+  }
+  Json parse_string() {
+    Json j;
+    skip();
+    if (p >= end || *p != '"') { ok = false; return j; }
+    ++p;
+    j.kind = Json::kStr;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': j.str += '\n'; break;
+          case 't': j.str += '\t'; break;
+          case 'r': j.str += '\r'; break;
+          case 'u':  // checkpoint names are ascii; keep escapes verbatim
+            j.str += "\\u";
+            break;
+          default: j.str += *p;
+        }
+      } else {
+        j.str += *p;
+      }
+      ++p;
+    }
+    if (p >= end) { ok = false; return j; }
+    ++p;
+    return j;
+  }
+};
+
+bool read_file(const std::string& path, std::string* out) {
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(n < 0 ? 0 : static_cast<size_t>(n));
+  size_t got = n > 0 ? std::fread(&(*out)[0], 1, out->size(), f) : 0;
+  std::fclose(f);
+  return got == out->size();
+}
+
+// ---------------------------------------------------------------------------
+// Memory-mapped .npy array (v1.0/2.0 headers, C-order little-endian).
+// ---------------------------------------------------------------------------
+struct NpyArray {
+  void* map = nullptr;
+  size_t map_size = 0;
+  const char* data = nullptr;   // first element
+  std::string dtype;            // e.g. "<f4", "<i8"
+  size_t itemsize = 0;
+  std::vector<int64_t> shape;
+
+  ~NpyArray() {
+    if (map) ::munmap(map, map_size);
+  }
+  int64_t rows() const { return shape.empty() ? 0 : shape[0]; }
+  int64_t row_elems() const {
+    int64_t n = 1;
+    for (size_t i = 1; i < shape.size(); ++i) n *= shape[i];
+    return n;
+  }
+};
+
+std::unique_ptr<NpyArray> open_npy(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    set_error("cannot open " + path);
+    return nullptr;
+  }
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < 10) {
+    ::close(fd);
+    set_error("cannot stat " + path);
+    return nullptr;
+  }
+  auto arr = std::make_unique<NpyArray>();
+  arr->map_size = static_cast<size_t>(st.st_size);
+  arr->map = ::mmap(nullptr, arr->map_size, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (arr->map == MAP_FAILED) {
+    arr->map = nullptr;
+    set_error("mmap failed for " + path);
+    return nullptr;
+  }
+  const unsigned char* b = static_cast<const unsigned char*>(arr->map);
+  if (std::memcmp(b, "\x93NUMPY", 6) != 0) {
+    set_error("not a .npy file: " + path);
+    return nullptr;
+  }
+  int major = b[6];
+  size_t header_len, header_off;
+  if (major == 1) {
+    header_len = b[8] | (b[9] << 8);
+    header_off = 10;
+  } else {
+    header_len = b[8] | (b[9] << 8) | (b[10] << 16)
+        | (static_cast<size_t>(b[11]) << 24);
+    header_off = 12;
+  }
+  if (header_off + header_len > arr->map_size) {
+    set_error("corrupt .npy header in " + path);
+    return nullptr;
+  }
+  std::string header(reinterpret_cast<const char*>(b + header_off),
+                     header_len);
+  // parse "{'descr': '<f4', 'fortran_order': False, 'shape': (8, 4), }"
+  auto find_val = [&](const std::string& key) -> std::string {
+    size_t k = header.find("'" + key + "'");
+    if (k == std::string::npos) return "";
+    size_t c = header.find(':', k);
+    if (c == std::string::npos) return "";
+    size_t s = c + 1;
+    while (s < header.size() && header[s] == ' ') ++s;
+    size_t e = s;
+    if (header[s] == '\'') {
+      e = header.find('\'', s + 1);
+      return header.substr(s + 1, e - s - 1);
+    }
+    if (header[s] == '(') {
+      e = header.find(')', s);
+      return header.substr(s, e - s + 1);
+    }
+    while (e < header.size() && header[e] != ',' && header[e] != '}') ++e;
+    return header.substr(s, e - s);
+  };
+  arr->dtype = find_val("descr");
+  if (find_val("fortran_order").find("True") != std::string::npos) {
+    set_error("fortran-order arrays unsupported: " + path);
+    return nullptr;
+  }
+  std::string shape = find_val("shape");
+  const char* sp = shape.c_str();
+  while (*sp) {
+    if (std::isdigit(static_cast<unsigned char>(*sp))) {
+      arr->shape.push_back(std::strtoll(sp, const_cast<char**>(&sp), 10));
+    } else {
+      ++sp;
+    }
+  }
+  if (arr->dtype.size() < 3) {
+    set_error("bad dtype in " + path);
+    return nullptr;
+  }
+  arr->itemsize = std::strtoul(arr->dtype.c_str() + 2, nullptr, 10);
+  arr->data = reinterpret_cast<const char*>(b + header_off + header_len);
+  // a truncated file (disk-full / killed writer) must fail the LOAD, not
+  // SIGSEGV the serving process at the first past-the-end lookup
+  size_t need = arr->itemsize;
+  for (int64_t d : arr->shape) need *= static_cast<size_t>(d);
+  if (header_off + header_len + need > arr->map_size) {
+    set_error("truncated .npy data in " + path);
+    return nullptr;
+  }
+  return arr;
+}
+
+float load_elem_as_float(const NpyArray& a, int64_t idx) {
+  const char* p = a.data + idx * a.itemsize;
+  char c = a.dtype[1];
+  if (c == 'f' && a.itemsize == 4) {
+    float v;
+    std::memcpy(&v, p, 4);
+    return v;
+  }
+  if (c == 'f' && a.itemsize == 8) {
+    double v;
+    std::memcpy(&v, p, 8);
+    return static_cast<float>(v);
+  }
+  return 0.0f;
+}
+
+int64_t load_elem_as_i64(const NpyArray& a, int64_t idx) {
+  const char* p = a.data + idx * a.itemsize;
+  if (a.itemsize == 4) {
+    int32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+  }
+  int64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Public handles
+// ---------------------------------------------------------------------------
+struct oe_variable {
+  std::string name;
+  int variable_id = 0;
+  int dim = 0;
+  int64_t vocab = 0;      // -1 => hash
+  std::unique_ptr<NpyArray> weights;
+  std::unique_ptr<NpyArray> keys;  // hash only
+  std::unordered_map<int64_t, int64_t> index;  // hash key -> row
+};
+
+struct oe_model {
+  std::string sign;
+  std::vector<std::unique_ptr<oe_variable>> variables;
+  std::unordered_map<std::string, oe_variable*> by_name;
+  std::unordered_map<int, oe_variable*> by_id;
+};
+
+extern "C" {
+
+const char* oe_last_error(void) { return g_error.c_str(); }
+
+oe_model* oe_model_load(const char* path) {
+  g_error.clear();
+  std::string meta_text;
+  std::string root(path);
+  if (!read_file(root + "/model_meta", &meta_text)) {
+    set_error("cannot read " + root + "/model_meta");
+    return nullptr;
+  }
+  JsonParser jp{meta_text.c_str(), meta_text.c_str() + meta_text.size()};
+  Json meta = jp.parse();
+  if (!jp.ok || meta.kind != Json::kObj) {
+    set_error("model_meta is not valid JSON");
+    return nullptr;
+  }
+  auto model = std::make_unique<oe_model>();
+  if (const Json* s = meta.get("model_sign")) model->sign = s->str;
+  const Json* vars = meta.get("variables");
+  if (!vars || vars->kind != Json::kArr) {
+    set_error("model_meta has no variables list");
+    return nullptr;
+  }
+  // 2^63: the unbounded-vocab marker (reference Meta.h use_hash_table)
+  const double kUnbounded = 9.0e18;
+  for (const Json& v : vars->arr) {
+    auto var = std::make_unique<oe_variable>();
+    if (const Json* n = v.get("name")) var->name = n->str;
+    if (const Json* i = v.get("variable_id"))
+      var->variable_id = static_cast<int>(i->num);
+    // ModelVariableMeta serializes flat: datatype/embedding_dim/
+    // vocabulary_size alongside variable_id/name (meta.py to_json)
+    if (const Json* d = v.get("embedding_dim"))
+      var->dim = static_cast<int>(d->num);
+    double vocab = 0;
+    if (const Json* vv = v.get("vocabulary_size")) vocab = vv->num;
+    if (var->dim <= 0) {
+      set_error("variable " + var->name + " has no embedding_dim");
+      return nullptr;
+    }
+    bool hash = vocab >= kUnbounded;
+    var->vocab = hash ? -1 : static_cast<int64_t>(vocab);
+
+    std::string safe = var->name;
+    for (char& c : safe) {
+      if (c == '/') c = '_';
+    }
+    size_t pos;
+    while ((pos = safe.find(':')) != std::string::npos)
+      safe.replace(pos, 1, "__");
+    std::string vdir = root + "/var_" + std::to_string(var->variable_id)
+        + "_" + safe + ".d";
+    var->weights = open_npy(vdir + "/weights.npy");
+    if (!var->weights) return nullptr;
+    if (var->weights->row_elems() != var->dim) {
+      set_error("weights dim mismatch for " + var->name);
+      return nullptr;
+    }
+    if (hash) {
+      var->keys = open_npy(vdir + "/keys.npy");
+      if (!var->keys) return nullptr;
+      int64_t n = var->keys->rows();
+      var->index.reserve(static_cast<size_t>(n) * 2);
+      for (int64_t i = 0; i < n; ++i) {
+        var->index.emplace(load_elem_as_i64(*var->keys, i), i);
+      }
+    }
+    model->by_name[var->name] = var.get();
+    model->by_id[var->variable_id] = var.get();
+    model->variables.push_back(std::move(var));
+  }
+  return model.release();
+}
+
+void oe_model_free(oe_model* model) { delete model; }
+
+const char* oe_model_sign(const oe_model* model) {
+  return model->sign.c_str();
+}
+
+int oe_model_num_variables(const oe_model* model) {
+  return static_cast<int>(model->variables.size());
+}
+
+oe_variable* oe_model_variable(oe_model* model, const char* name) {
+  auto it = model->by_name.find(name);
+  if (it == model->by_name.end()) {
+    set_error(std::string("unknown variable ") + name);
+    return nullptr;
+  }
+  return it->second;
+}
+
+oe_variable* oe_model_variable_by_id(oe_model* model, int variable_id) {
+  auto it = model->by_id.find(variable_id);
+  if (it == model->by_id.end()) {
+    set_error("unknown variable id " + std::to_string(variable_id));
+    return nullptr;
+  }
+  return it->second;
+}
+
+const char* oe_variable_name(const oe_variable* var) {
+  return var->name.c_str();
+}
+int oe_variable_id(const oe_variable* var) { return var->variable_id; }
+int oe_variable_dim(const oe_variable* var) { return var->dim; }
+int64_t oe_variable_vocab(const oe_variable* var) { return var->vocab; }
+int64_t oe_variable_rows(const oe_variable* var) {
+  return var->weights->rows();
+}
+
+int oe_pull_weights(const oe_variable* var, const int64_t* keys, int64_t n,
+                    float* out) {
+  g_error.clear();
+  const NpyArray& w = *var->weights;
+  const int dim = var->dim;
+  const bool f32 = w.dtype[1] == 'f' && w.itemsize == 4;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t row = -1;
+    if (var->vocab >= 0) {
+      if (keys[i] >= 0 && keys[i] < var->vocab) row = keys[i];
+    } else {
+      auto it = var->index.find(keys[i]);
+      if (it != var->index.end()) row = it->second;
+    }
+    float* dst = out + i * dim;
+    if (row < 0) {
+      std::memset(dst, 0, sizeof(float) * dim);
+    } else if (f32) {
+      std::memcpy(dst, w.data + row * dim * 4, sizeof(float) * dim);
+    } else {
+      for (int d = 0; d < dim; ++d) {
+        dst[d] = load_elem_as_float(w, row * dim + d);
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,65 @@
+/* oe_serving — native (C ABI) serving runtime for openembedding_tpu
+ * checkpoints.
+ *
+ * Capability parity with the reference's C ABI + packed serving library
+ * (/root/reference/openembedding/entry/c_api.h — the ~60 exb_* functions
+ * TF-Serving loads through libcexb_pack.so so inference needs no Python):
+ * this library memory-maps a checkpoint directory written by
+ * openembedding_tpu.checkpoint.save_checkpoint (model_meta JSON +
+ * var_<id>_<name>.d/*.npy) and serves read-only row lookups from C/C++.
+ *
+ *   oe_model*    m = oe_model_load("/path/to/ckpt");
+ *   oe_variable* v = oe_model_variable(m, "fields");
+ *   float* out = malloc(n * oe_variable_dim(v) * sizeof(float));
+ *   oe_pull_weights(v, keys, n, out);   // missing/invalid keys -> zeros
+ *
+ * The lookup contract matches the Python serving registry's read-only pull
+ * (reference EmbeddingPullOperator read_only path): bounded variables index
+ * rows directly (out-of-range -> zero rows); hash variables resolve through
+ * an in-memory key index rebuilt from keys.npy at load (unknown keys ->
+ * zero rows). Thread-safe for concurrent lookups after load.
+ */
+#ifndef OE_SERVING_H_
+#define OE_SERVING_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct oe_model oe_model;
+typedef struct oe_variable oe_variable;
+
+/* Last error message of the calling thread ("" if none). */
+const char* oe_last_error(void);
+
+/* Load a checkpoint directory; NULL on error (see oe_last_error). */
+oe_model* oe_model_load(const char* path);
+void oe_model_free(oe_model* model);
+
+/* Model signature recorded in model_meta (may be empty). */
+const char* oe_model_sign(const oe_model* model);
+
+int oe_model_num_variables(const oe_model* model);
+oe_variable* oe_model_variable(oe_model* model, const char* name);
+oe_variable* oe_model_variable_by_id(oe_model* model, int variable_id);
+
+const char* oe_variable_name(const oe_variable* var);
+int oe_variable_id(const oe_variable* var);
+int oe_variable_dim(const oe_variable* var);
+/* Bounded vocabulary size, or -1 for an unbounded (hash) key space. */
+int64_t oe_variable_vocab(const oe_variable* var);
+/* Number of stored rows (== vocab for bounded, live rows for hash). */
+int64_t oe_variable_rows(const oe_variable* var);
+
+/* Read-only pull: out must hold n * dim floats. Returns 0, or -1 on error.
+ * Invalid/unknown keys yield zero rows (the serving contract). */
+int oe_pull_weights(const oe_variable* var, const int64_t* keys, int64_t n,
+                    float* out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* OE_SERVING_H_ */

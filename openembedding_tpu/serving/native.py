@@ -1,0 +1,129 @@
+"""ctypes bindings for the native serving runtime (native/oe_serving.cc).
+
+The reference serves inference through a packed C++ library so TF-Serving
+needs no Python (entry/c_api.h exb_* ABI + libcexb_pack.so); here the same
+role is a small dependency-free C++17 library that memory-maps a checkpoint
+directory and answers read-only pulls. These bindings exist for tests and
+for Python hosts that want the zero-JAX lookup path; C++ serving stacks
+link ``liboe_serving.so`` directly against ``native/oe_serving.h``.
+
+Build: ``make -C native`` (g++ only, no dependencies).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "liboe_serving.so")
+
+
+def build_library(force: bool = False) -> str:
+    """Compile liboe_serving.so if absent (or ``force``); returns its path."""
+    if not force and os.path.exists(_LIB_PATH):
+        return _LIB_PATH
+    if not os.path.isdir(_NATIVE_DIR):
+        raise RuntimeError(
+            "native/ sources not found — the native serving library builds "
+            "from a source checkout (make -C native); from an installed "
+            "package, build it there and pass lib_path to NativeModel")
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"native build failed:\n{e.stdout}\n{e.stderr}") from e
+    return _LIB_PATH
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.oe_last_error.restype = ctypes.c_char_p
+    lib.oe_model_load.restype = ctypes.c_void_p
+    lib.oe_model_load.argtypes = [ctypes.c_char_p]
+    lib.oe_model_free.argtypes = [ctypes.c_void_p]
+    lib.oe_model_sign.restype = ctypes.c_char_p
+    lib.oe_model_sign.argtypes = [ctypes.c_void_p]
+    lib.oe_model_num_variables.restype = ctypes.c_int
+    lib.oe_model_num_variables.argtypes = [ctypes.c_void_p]
+    lib.oe_model_variable.restype = ctypes.c_void_p
+    lib.oe_model_variable.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.oe_model_variable_by_id.restype = ctypes.c_void_p
+    lib.oe_model_variable_by_id.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.oe_variable_name.restype = ctypes.c_char_p
+    lib.oe_variable_name.argtypes = [ctypes.c_void_p]
+    lib.oe_variable_dim.restype = ctypes.c_int
+    lib.oe_variable_dim.argtypes = [ctypes.c_void_p]
+    lib.oe_variable_vocab.restype = ctypes.c_int64
+    lib.oe_variable_vocab.argtypes = [ctypes.c_void_p]
+    lib.oe_variable_rows.restype = ctypes.c_int64
+    lib.oe_variable_rows.argtypes = [ctypes.c_void_p]
+    lib.oe_pull_weights.restype = ctypes.c_int
+    lib.oe_pull_weights.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float)]
+    return lib
+
+
+class NativeModel:
+    """A checkpoint served by the native library (read-only lookups)."""
+
+    def __init__(self, path: str, lib_path: Optional[str] = None):
+        self._lib = _bind(ctypes.CDLL(lib_path or build_library()))
+        self._model = self._lib.oe_model_load(path.encode())
+        if not self._model:
+            raise RuntimeError(
+                f"native load failed: {self._lib.oe_last_error().decode()}")
+
+    def close(self) -> None:
+        if self._model:
+            self._lib.oe_model_free(self._model)
+            self._model = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def sign(self) -> str:
+        return self._lib.oe_model_sign(self._model).decode()
+
+    @property
+    def num_variables(self) -> int:
+        return self._lib.oe_model_num_variables(self._model)
+
+    def _var(self, variable) -> ctypes.c_void_p:
+        if isinstance(variable, int):
+            v = self._lib.oe_model_variable_by_id(self._model, variable)
+        else:
+            v = self._lib.oe_model_variable(self._model, variable.encode())
+        if not v:
+            raise KeyError(self._lib.oe_last_error().decode())
+        return v
+
+    def variable_dim(self, variable) -> int:
+        return self._lib.oe_variable_dim(self._var(variable))
+
+    def variable_vocab(self, variable) -> int:
+        return self._lib.oe_variable_vocab(self._var(variable))
+
+    def lookup(self, variable, keys: Sequence[int]) -> np.ndarray:
+        """Read-only pull: [n] keys -> [n, dim] float32 rows (missing/
+        invalid keys -> zero rows)."""
+        v = self._var(variable)
+        dim = self._lib.oe_variable_dim(v)
+        k = np.ascontiguousarray(np.asarray(keys, dtype=np.int64).ravel())
+        out = np.zeros((k.size, dim), np.float32)
+        rc = self._lib.oe_pull_weights(
+            v, k.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), k.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(self._lib.oe_last_error().decode())
+        return out.reshape(np.asarray(keys).shape + (dim,))

@@ -1,0 +1,107 @@
+"""Native C++ serving runtime: lookups must match the Python registry.
+
+The reference's serving data plane is a packed C++ library (libcexb_pack.so,
+exb_* C ABI) loaded without Python; liboe_serving.so plays that role over
+this framework's checkpoint format. Ground truth here is the Python
+registry's read-only pull on the same checkpoint.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+from openembedding_tpu import checkpoint as ckpt
+from openembedding_tpu.parallel.mesh import create_mesh
+
+DIM = 4
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    from openembedding_tpu.serving import native
+    return native.build_library()
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory, devices8):
+    """A trained-ish checkpoint with one bounded and one hash variable."""
+    mesh = create_mesh(2, 4, jax.devices()[:8])
+    specs = (
+        EmbeddingSpec(name="arr", input_dim=100, output_dim=DIM,
+                      initializer={"category": "normal", "stddev": 0.3}),
+        EmbeddingSpec(name="hsh:linear", input_dim=-1, output_dim=DIM,
+                      hash_capacity=512,
+                      initializer={"category": "normal", "stddev": 0.3}),
+    )
+    coll = EmbeddingCollection(
+        specs, mesh, default_optimizer={"category": "adagrad",
+                                        "learning_rate": 0.1})
+    states = coll.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    hkeys = (rng.randint(1, 1 << 30, 40) * 7919).astype(np.int32)
+    for _ in range(2):
+        inputs = {"arr": jnp.asarray(rng.randint(0, 100, 32, dtype=np.int64)
+                                     .astype(np.int32)),
+                  "hsh:linear": jnp.asarray(rng.choice(hkeys, 32))}
+        rows = coll.pull(states, inputs, batch_sharded=False)
+        grads = {k: jnp.ones_like(v) for k, v in rows.items()}
+        states = coll.apply_gradients(states, inputs, grads,
+                                      batch_sharded=False)
+    path = str(tmp_path_factory.mktemp("native") / "model")
+    ckpt.save_checkpoint(path, coll, states, model_sign="native-1")
+    return path, coll, states, hkeys
+
+
+def test_native_matches_python_registry(native_lib, saved_model):
+    from openembedding_tpu.serving.native import NativeModel
+    path, coll, states, hkeys = saved_model
+    with NativeModel(path, native_lib) as m:
+        assert m.sign == "native-1"
+        assert m.num_variables == 2
+        assert m.variable_dim("arr") == DIM
+        assert m.variable_vocab("arr") == 100
+        assert m.variable_vocab("hsh:linear") == -1
+
+        # bounded: all rows + invalid ids
+        probe = np.concatenate([np.arange(100), [-1, 100, 10**7]])
+        got = m.lookup("arr", probe)
+        # ground truth: out-of-vocab ids are invalid (-1 -> zero rows)
+        gt_ids = np.where((probe < 0) | (probe >= 100), -1, probe)
+        want = np.asarray(coll.pull(
+            states, {"arr": jnp.asarray(gt_ids.astype(np.int32))},
+            batch_sharded=False, read_only=True)["arr"])
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+        # hash: trained keys return their rows, unknown keys zeros
+        got = m.lookup("hsh:linear", hkeys.astype(np.int64))
+        want = np.asarray(coll.pull(
+            states, {"hsh:linear": jnp.asarray(hkeys)},
+            batch_sharded=False, read_only=True)["hsh:linear"])
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(
+            m.lookup("hsh:linear", [123456789]), 0.0)
+
+        # lookup by variable id too (exb_get_model_variable takes ids)
+        got = m.lookup(0, np.arange(10))
+        np.testing.assert_allclose(got, want := np.asarray(coll.pull(
+            states, {"arr": jnp.arange(10, dtype=jnp.int32)},
+            batch_sharded=False, read_only=True)["arr"]),
+            rtol=1e-6, atol=1e-7)
+
+
+def test_native_errors(native_lib, tmp_path, saved_model):
+    from openembedding_tpu.serving.native import NativeModel
+    with pytest.raises(RuntimeError, match="model_meta"):
+        NativeModel(str(tmp_path / "nope"), native_lib)
+    path = saved_model[0]
+    with NativeModel(path, native_lib) as m:
+        with pytest.raises(KeyError):
+            m.lookup("missing_var", [0])
