@@ -205,7 +205,7 @@ def main(argv=None):
                 dense_state={"params": state.params,
                              "opt_state": state.opt_state,
                              "step": state.step},
-                model_sign=f"criteo-{int(state.step)}")
+                model_sign=trainer.model_sign(state))
         print(f"saved checkpoint to {args.save}")
     return 0
 

@@ -271,6 +271,13 @@ std::unique_ptr<NpyArray> open_npy(const std::string& path) {
   return arr;
 }
 
+bool weights_dtype_supported(const NpyArray& a) {
+  char c = a.dtype[1];
+  // f4/f8, plus bfloat16 (numpy writes ml_dtypes bfloat16 as '<V2')
+  return (c == 'f' && (a.itemsize == 4 || a.itemsize == 8))
+      || (c == 'V' && a.itemsize == 2);
+}
+
 float load_elem_as_float(const NpyArray& a, int64_t idx) {
   const char* p = a.data + idx * a.itemsize;
   char c = a.dtype[1];
@@ -283,6 +290,14 @@ float load_elem_as_float(const NpyArray& a, int64_t idx) {
     double v;
     std::memcpy(&v, p, 8);
     return static_cast<float>(v);
+  }
+  if (c == 'V' && a.itemsize == 2) {  // bfloat16: high 16 bits of an f32
+    uint16_t h;
+    std::memcpy(&h, p, 2);
+    uint32_t bits = static_cast<uint32_t>(h) << 16;
+    float v;
+    std::memcpy(&v, &bits, 4);
+    return v;
   }
   return 0.0f;
 }
@@ -379,6 +394,19 @@ oe_model* oe_model_load(const char* path) {
     if (!var->weights) return nullptr;
     if (var->weights->row_elems() != var->dim) {
       set_error("weights dim mismatch for " + var->name);
+      return nullptr;
+    }
+    if (!weights_dtype_supported(*var->weights)) {
+      set_error("unsupported weights dtype " + var->weights->dtype
+                + " for " + var->name);
+      return nullptr;
+    }
+    // a bounded table must hold exactly its vocabulary: a key bound-checked
+    // against the meta vocab must never index past the mapped rows
+    if (var->vocab >= 0 && var->weights->rows() != var->vocab) {
+      set_error("weights rows " + std::to_string(var->weights->rows())
+                + " != vocabulary " + std::to_string(var->vocab)
+                + " for " + var->name);
       return nullptr;
     }
     if (hash) {

@@ -149,7 +149,11 @@ class ModelMeta:
         )
 
     def dumps(self) -> str:
-        return json.dumps(self.to_json(), indent=2, sort_keys=True)
+        # ensure_ascii=False: variable names go into file paths verbatim, so
+        # the meta must carry the same UTF-8 bytes (the native loader reads
+        # them raw, it does not decode \\u escapes)
+        return json.dumps(self.to_json(), indent=2, sort_keys=True,
+                          ensure_ascii=False)
 
     @classmethod
     def loads(cls, text: str) -> "ModelMeta":

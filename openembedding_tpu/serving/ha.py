@@ -62,22 +62,29 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     """
     import argparse
     p = argparse.ArgumentParser()
-    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port", type=int, default=None)
     p.add_argument("--load", action="append", default=[])
     p.add_argument("--peers", default="")
-    p.add_argument("--hash_capacity", type=int, default=2**20)
+    p.add_argument("--hash_capacity", type=int, default=None)
+    p.add_argument("--config", default="",
+                   help="EnvConfig JSON file (serving section: port, "
+                        "replica_num, hash_capacity)")
     args = p.parse_args(argv)
 
     import jax
     from .registry import ModelRegistry
     from .rest import ControllerServer
     from ..parallel.mesh import create_mesh
+    from ..utils.envconfig import EnvConfig
 
+    cfg = EnvConfig.load(path=args.config or None).serving
+    port = args.port if args.port is not None else cfg.port
+    hash_capacity = (args.hash_capacity if args.hash_capacity is not None
+                     else cfg.hash_capacity)
     mesh = create_mesh(1, len(jax.devices()))
-    registry = ModelRegistry(mesh,
-                             default_hash_capacity=args.hash_capacity)
+    registry = ModelRegistry(mesh, default_hash_capacity=hash_capacity)
     peers = [e for e in args.peers.split(",") if e]
-    server = ControllerServer(registry, port=args.port, peers=peers).start()
+    server = ControllerServer(registry, port=port, peers=peers).start()
     print(f"replica: listening on {server.port}", flush=True)
 
     for item in args.load:

@@ -93,6 +93,11 @@ class Trainer:
                     f"offloaded variable {oname!r} is not in the collection; "
                     "register table.embedding_spec() in its specs")
         self.mesh = collection.mesh
+        # serving signature: "<uuid>-<version>", version == step — the
+        # reference's model_version variable bumped per optimizer step and
+        # stamped at save (exb.py:213-218, py_api.cc:130-138)
+        import uuid as _uuid
+        self.model_uuid = _uuid.uuid4().hex[:12]
         self._replicated = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self._train_step = None
@@ -217,13 +222,30 @@ class Trainer:
             return jax.device_put(x, self._batch_sharding)
         return jax.tree.map(place, batch)
 
+    def model_sign(self, state: TrainState) -> str:
+        """Version-stamped serving signature for this state."""
+        return f"{self.model_uuid}-{int(jax.device_get(state.step))}"
+
     def fit(self, state: TrainState, batches, *, log_every: int = 0,
-            log_fn=print):
-        """Simple host loop over an iterable of batches (model.fit analogue)."""
+            log_fn=print, persist_dir: Optional[str] = None):
+        """Simple host loop over an iterable of batches (model.fit analogue).
+
+        ``persist_dir``: incremental-persist offloaded tables whenever they
+        signal ``should_persist`` — the reference's AutoPersist callback
+        (test/benchmark/criteo_deepctr.py:113-124 polling
+        should_persist_server_model each batch).
+        """
         last = None
         for i, batch in enumerate(batches):
             state, metrics = self.train_step(state, batch)
             last = metrics
+            if persist_dir:
+                for name, table in self.offload.items():
+                    if table.should_persist:
+                        info = table.persist(state.emb[name],
+                                             f"{persist_dir}/{name}")
+                        if log_every:
+                            log_fn(f"persisted {name}: {info}")
             if log_every and (i + 1) % log_every == 0:
                 log_fn(f"step {i + 1}: loss={float(metrics['loss']):.5f}")
         return state, last

@@ -1,0 +1,186 @@
+"""Typed system-config tree — the reference's EnvConfig equivalent.
+
+The reference drives every subsystem off a declarative YAML config tree
+with per-field defaults and checkers
+(/root/reference/openembedding/client/EnvConfig.{h,cpp} — rpc/master/server
+sections, each field validated at load). The TPU build deletes the rpc and
+master sections (XLA collectives + JAX coordination replace them) and keeps
+the knobs that still exist, one frozen dataclass per section:
+
+* ``a2a``      — owner-routed exchange sizing (bucket capacity / slack);
+* ``offload``  — host-offload tier budgets (the reference's
+  server.cache_size / pmem block);
+* ``serving``  — controller port, default replica count, hash capacity
+  (controller.cc flags, c_api create_model defaults);
+* ``report``   — accumulator reporting interval + the performance-
+  evaluation gate (server.report_interval, pico_is_evaluate_performance).
+
+Load precedence: built-in defaults < JSON/YAML-subset file < environment
+(``OE_<SECTION>_<FIELD>``) < explicit dict — every layer validated, unknown
+keys rejected with the known set named (the reference's Configure checkers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .config import to_bool
+
+_CHECKS: Dict[type, Dict[str, Tuple[Callable[[Any], bool], str]]] = {}
+
+
+def _check(cls, field: str, pred: Callable[[Any], bool], msg: str):
+    _CHECKS.setdefault(cls, {})[field] = (pred, msg)
+
+
+def _validate(obj) -> None:
+    for field, (pred, msg) in _CHECKS.get(type(obj), {}).items():
+        v = getattr(obj, field)
+        if not pred(v):
+            raise ValueError(
+                f"{type(obj).__name__}.{field} = {v!r}: {msg}")
+
+
+@dataclasses.dataclass(frozen=True)
+class A2AConfig:
+    """Owner-routed exchange sizing (parallel/alltoall.py)."""
+
+    capacity: int = 0        # per-destination bucket rows; 0 = auto
+    slack: float = 2.0       # auto capacity = slack * mean bucket
+
+    def __post_init__(self):
+        _validate(self)
+
+
+_check(A2AConfig, "capacity", lambda v: v >= 0, "must be >= 0 (0 = auto)")
+_check(A2AConfig, "slack", lambda v: v > 0, "must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class OffloadConfig:
+    """Host-offload tier budgets (offload.py; reference server.cache_size
+    MB=1024 + PMem pool knobs, EnvConfig.h:54-63)."""
+
+    cache_capacity: int = 1 << 20
+    occupancy_threshold: float = 0.7
+    persist_pending_window: int = 64
+    keep_fraction: float = 0.5
+
+    def __post_init__(self):
+        _validate(self)
+
+
+_check(OffloadConfig, "cache_capacity", lambda v: v > 0, "must be > 0")
+_check(OffloadConfig, "occupancy_threshold", lambda v: 0 < v <= 1,
+       "must be in (0, 1]")
+_check(OffloadConfig, "persist_pending_window", lambda v: v > 0,
+       "must be > 0")
+_check(OffloadConfig, "keep_fraction", lambda v: 0 <= v < 1,
+       "must be in [0, 1)")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Serving controller defaults (serving/; reference controller.cc
+    port 8010, create_model replica_num=3)."""
+
+    port: int = 8010
+    replica_num: int = 3
+    hash_capacity: int = 1 << 20
+
+    def __post_init__(self):
+        _validate(self)
+
+
+_check(ServingConfig, "port", lambda v: 0 <= v < 65536,
+       "must be a port number (0 = ephemeral)")
+_check(ServingConfig, "replica_num", lambda v: v >= 1, "must be >= 1")
+_check(ServingConfig, "hash_capacity", lambda v: v > 0, "must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReportConfig:
+    """Observability (utils/observability.py; reference
+    server.report_interval + pico_is_evaluate_performance)."""
+
+    report_interval: float = 0.0   # seconds; 0 disables the reporter
+    evaluate_performance: bool = False
+
+    def __post_init__(self):
+        _validate(self)
+
+
+_check(ReportConfig, "report_interval", lambda v: v >= 0, "must be >= 0")
+
+_SECTIONS = {"a2a": A2AConfig, "offload": OffloadConfig,
+             "serving": ServingConfig, "report": ReportConfig}
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvConfig:
+    """The full tree. Sections are frozen dataclasses; see module docs."""
+
+    a2a: A2AConfig = dataclasses.field(default_factory=A2AConfig)
+    offload: OffloadConfig = dataclasses.field(default_factory=OffloadConfig)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    report: ReportConfig = dataclasses.field(default_factory=ReportConfig)
+
+    @classmethod
+    def load(cls, config: Optional[Dict[str, Any]] = None,
+             path: Optional[str] = None,
+             env: Optional[Dict[str, str]] = None) -> "EnvConfig":
+        """defaults < ``path`` (JSON file) < ``env`` (OE_SECTION_FIELD) <
+        ``config`` dict. Unknown sections/fields raise, values are coerced
+        to the declared field types."""
+        tree: Dict[str, Dict[str, Any]] = {}
+
+        def merge(src: Dict[str, Any], origin: str):
+            for section, fields in src.items():
+                if section not in _SECTIONS:
+                    raise ValueError(
+                        f"unknown config section {section!r} ({origin}); "
+                        f"known: {sorted(_SECTIONS)}")
+                if not isinstance(fields, dict):
+                    raise ValueError(
+                        f"config section {section!r} must be a mapping")
+                known = {f.name for f in
+                         dataclasses.fields(_SECTIONS[section])}
+                unknown = set(fields) - known
+                if unknown:
+                    raise ValueError(
+                        f"unknown {section} options {sorted(unknown)} "
+                        f"({origin}); known: {sorted(known)}")
+                tree.setdefault(section, {}).update(fields)
+
+        if path:
+            with open(path) as f:
+                merge(json.load(f), origin=path)
+        env = os.environ if env is None else env
+        env_tree: Dict[str, Dict[str, str]] = {}
+        for key, val in env.items():
+            if not key.startswith("OE_"):
+                continue
+            parts = key[3:].lower().split("_", 1)
+            if len(parts) == 2 and parts[0] in _SECTIONS:
+                env_tree.setdefault(parts[0], {})[parts[1]] = val
+        if env_tree:
+            merge(env_tree, origin="environment")
+        if config:
+            merge(config, origin="config dict")
+
+        sections = {}
+        for name, scls in _SECTIONS.items():
+            fields = {}
+            defaults = scls()
+            for k, v in tree.get(name, {}).items():
+                want = type(getattr(defaults, k))
+                fields[k] = to_bool(v) if want is bool else want(v)
+            sections[name] = scls(**fields)
+        return cls(**sections)
+
+    def to_json(self) -> Dict[str, Dict[str, Any]]:
+        return {name: dataclasses.asdict(getattr(self, name))
+                for name in _SECTIONS}

@@ -1,0 +1,57 @@
+"""EnvConfig typed tree: defaults, layering, validation — the reference's
+EnvConfig.cpp per-field default+checker behavior."""
+
+import json
+
+import pytest
+
+from openembedding_tpu.utils.envconfig import (A2AConfig, EnvConfig,
+                                               OffloadConfig, ServingConfig)
+
+
+def test_defaults():
+    cfg = EnvConfig.load(env={})
+    assert cfg.serving.port == 8010          # reference controller.cc
+    assert cfg.serving.replica_num == 3      # reference c_api.cc:332-341
+    assert cfg.a2a.slack == 2.0
+    assert cfg.report.report_interval == 0.0
+
+
+def test_layering_file_env_dict(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"serving": {"port": 9000, "replica_num": 5},
+                             "a2a": {"slack": 3.0}}))
+    cfg = EnvConfig.load(
+        config={"serving": {"port": 9100}},
+        path=str(p),
+        env={"OE_SERVING_REPLICA_NUM": "7",
+             "OE_REPORT_EVALUATE_PERFORMANCE": "true"})
+    assert cfg.serving.port == 9100          # dict beats env beats file
+    assert cfg.serving.replica_num == 7      # env beats file
+    assert cfg.a2a.slack == 3.0              # file beats defaults
+    assert cfg.report.evaluate_performance is True  # bool coercion
+
+
+def test_unknown_keys_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown config section"):
+        EnvConfig.load(config={"rpc": {}}, env={})
+    with pytest.raises(ValueError, match="unknown serving options"):
+        EnvConfig.load(config={"serving": {"portt": 1}}, env={})
+
+
+def test_field_checkers():
+    with pytest.raises(ValueError, match="must be > 0"):
+        A2AConfig(slack=0.0)
+    with pytest.raises(ValueError, match=r"in \(0, 1\]"):
+        OffloadConfig(occupancy_threshold=1.5)
+    with pytest.raises(ValueError, match="port"):
+        ServingConfig(port=99999)
+    with pytest.raises(ValueError, match=">= 1"):
+        EnvConfig.load(config={"serving": {"replica_num": 0}}, env={})
+
+
+def test_round_trip():
+    cfg = EnvConfig.load(config={"offload": {"cache_capacity": 512}}, env={})
+    j = cfg.to_json()
+    assert j["offload"]["cache_capacity"] == 512
+    assert EnvConfig.load(config=j, env={}) == cfg

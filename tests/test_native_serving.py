@@ -105,3 +105,21 @@ def test_native_errors(native_lib, tmp_path, saved_model):
     with NativeModel(path, native_lib) as m:
         with pytest.raises(KeyError):
             m.lookup("missing_var", [0])
+
+
+def test_native_bfloat16_rows(native_lib, tmp_path, devices8):
+    """bf16 checkpoints serve real values (numpy stores them as '<V2')."""
+    from openembedding_tpu.serving.native import NativeModel
+    mesh = create_mesh(1, 1, jax.devices()[:1])
+    spec = EmbeddingSpec(name="b", input_dim=32, output_dim=DIM,
+                         dtype="bfloat16",
+                         initializer={"category": "constant", "value": 0.5})
+    coll = EmbeddingCollection(
+        (spec,), mesh, default_optimizer={"category": "default"})
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "bf16")
+    ckpt.save_checkpoint(path, coll, states, include_optimizer=False)
+    with NativeModel(path, native_lib) as m:
+        rows = m.lookup("b", [0, 31, 32])
+        np.testing.assert_allclose(rows[0], 0.5, rtol=1e-2)
+        np.testing.assert_allclose(rows[2], 0.0)

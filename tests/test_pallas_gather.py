@@ -1,0 +1,44 @@
+"""Pallas sparse-gather kernel: parity with the XLA pull contract.
+
+Runs in interpret mode on the CPU mesh (the kernel compiles to a Mosaic
+pipeline on real TPUs; bench records 546 GB/s vs XLA gather's 1331 GB/s on
+the round's chip — XLA remains the default pull path, the kernel is the
+native-op scaffold)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import pytest
+
+from openembedding_tpu.ops.pallas_gather import (ROWS_PER_STEP, gather_rows,
+                                                 pad_table)
+
+
+def test_gather_parity_and_invalid_ids(devices8):
+    rng = np.random.RandomState(0)
+    table = pad_table(jnp.asarray(rng.randn(100, 9).astype(np.float32)))
+    idx = jnp.asarray([0, 5, 99, -1, 100, 5, 42], jnp.int32)
+    got = np.asarray(gather_rows(table, idx, interpret=True))[:, :9]
+    want = np.zeros((7, 9), np.float32)
+    for i, v in enumerate([0, 5, 99, -1, -1, 5, 42]):
+        if v >= 0:
+            want[i] = np.asarray(table)[v, :9]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_gather_rejects_ragged_dim(devices8):
+    table = jnp.zeros((16, 9), jnp.float32)
+    with pytest.raises(ValueError, match="lane-aligned"):
+        gather_rows(table, jnp.zeros((4,), jnp.int32), interpret=True)
+
+
+def test_gather_lane_aligned_and_step_multiple(devices8):
+    """dim already lane-aligned + batch an exact multiple of the DMA depth."""
+    rng = np.random.RandomState(1)
+    table = jnp.asarray(rng.randn(64, 128).astype(np.float32))
+    idx = jnp.asarray(rng.randint(0, 64, 4 * ROWS_PER_STEP), jnp.int32)
+    got = np.asarray(gather_rows(table, idx, interpret=True))
+    np.testing.assert_allclose(got, np.asarray(table)[np.asarray(idx)],
+                               rtol=1e-6)
