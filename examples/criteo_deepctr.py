@@ -57,6 +57,9 @@ def parse_args(argv=None):
     p.add_argument("--save", default="", help="checkpoint dir to write")
     p.add_argument("--load", default="", help="checkpoint dir to read")
     p.add_argument("--log_every", type=int, default=20)
+    p.add_argument("--config", default="",
+                   help="EnvConfig JSON file (a2a bucket sizing, report "
+                        "interval/gate; OE_* env vars overlay it)")
     return p.parse_args(argv)
 
 
@@ -74,6 +77,11 @@ def main(argv=None):
     from openembedding_tpu.parallel.mesh import create_mesh
     from openembedding_tpu.utils.observability import StreamingAUC, vtimer, GLOBAL
 
+    from openembedding_tpu.utils.envconfig import EnvConfig
+    env_cfg = EnvConfig.load(path=args.config or None)
+    reporter = env_cfg.apply_report()
+    a2a_kw = env_cfg.a2a.spec_kwargs()
+
     n_dev = len(jax.devices())
     mesh = create_mesh(args.data_parallel, n_dev // args.data_parallel)
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
@@ -90,12 +98,12 @@ def main(argv=None):
                   "big table); ignoring")
         specs, mapper = make_fused_specs(
             features, vocab, args.embedding_dim, optimizer=opt_config,
-            hash_capacity=1 << 22, plane=args.plane)
+            hash_capacity=1 << 22, plane=args.plane, **a2a_kw)
         dense_specs = ()
     else:
         specs = deepctr.make_feature_specs(
             features, vocab, args.embedding_dim, optimizer=opt_config,
-            hash_capacity=1 << 22, plane=args.plane)
+            hash_capacity=1 << 22, plane=args.plane, **a2a_kw)
         mapper = None
         if args.sparse_as_dense:
             from openembedding_tpu import split_sparse_dense
@@ -207,6 +215,9 @@ def main(argv=None):
                              "step": state.step},
                 model_sign=trainer.model_sign(state))
         print(f"saved checkpoint to {args.save}")
+    if reporter is not None:
+        reporter.report()
+        reporter.stop()
     return 0
 
 

@@ -154,7 +154,8 @@ def save_checkpoint(path: str,
     }
     if hash_info:
         meta.extra["hash_variables"] = hash_info
-    with open(os.path.join(path, MODEL_META_FILE), "w") as f:
+    with open(os.path.join(path, MODEL_META_FILE), "w",
+              encoding="utf-8") as f:
         f.write(meta.dumps())
 
     for name, spec in collection.specs.items():
@@ -341,7 +342,8 @@ def _load_array_var(data, spec, sspec: st.ShardingSpec, optimizer,
 
 
 def _check_meta(path: str, collection: EmbeddingCollection) -> ModelMeta:
-    with open(os.path.join(path, MODEL_META_FILE)) as f:
+    with open(os.path.join(path, MODEL_META_FILE),
+              encoding="utf-8") as f:
         meta = ModelMeta.loads(f.read())
     want = collection.model_meta()
     got_vars = {v.name: v for v in meta.variables}

@@ -1,0 +1,127 @@
+"""Criteo preprocessing CLI: raw TSV -> preprocessed CSV.
+
+The reference ships this twice — a pandas/sklearn script
+(/root/reference/examples/criteo_preprocess.py: LabelEncoder on the 26
+categoricals, MinMaxScaler on the 13 counts) and a fast streaming C++ tool
+(/root/reference/test/criteo_preprocess.cpp: one pass, on-the-fly label
+dictionaries). This is the streaming form:
+
+    python -m openembedding_tpu.data.preprocess train.txt train.csv
+    python -m openembedding_tpu.data.preprocess train.txt train.csv --repeat 2
+
+* categoricals: first-seen label encoding per column (missing -> 0), the
+  encoder built in the same pass like the C++ tool;
+* counts: log1p squash (this framework's TSV convention) or min-max when
+  ``--minmax`` (two passes, the sklearn recipe);
+* ``--repeat N`` duplicates the output N times (the C++ tool's benchmark
+  amplification knob, criteo_preprocess.cpp usage "<in> <out> [repeat]").
+
+Output header: label,I1..I13,C1..C26 — the read_criteo_csv contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+from . import criteo
+
+
+def _open_out(path: str):
+    return sys.stdout if path == "-" else open(path, "w")
+
+
+def preprocess(in_path: str, out_path: str, *, repeat: int = 1,
+               minmax: bool = False, limit: int = 0) -> int:
+    """Returns number of data rows written (before repetition)."""
+    encoders = [dict() for _ in range(criteo.NUM_SPARSE)]
+    lo = [math.inf] * criteo.NUM_DENSE
+    hi = [-math.inf] * criteo.NUM_DENSE
+
+    def parse(line):
+        parts = line.rstrip("\n").split("\t")
+        parts += [""] * (1 + criteo.NUM_DENSE + criteo.NUM_SPARSE
+                         - len(parts))
+        label = parts[0] or "0"
+        dense = []
+        for j in range(criteo.NUM_DENSE):
+            v = parts[1 + j]
+            dense.append(float(v) if v else 0.0)
+        cats = []
+        for j in range(criteo.NUM_SPARSE):
+            raw = parts[1 + criteo.NUM_DENSE + j]
+            enc = encoders[j]
+            if raw not in enc:
+                enc[raw] = len(enc)
+            cats.append(enc[raw])
+        return label, dense, cats
+
+    if minmax:
+        # dense-only first pass: building the 26 label dictionaries here
+        # would churn memory only to be discarded
+        with open(in_path) as f:
+            for i, line in enumerate(f):
+                if limit and i >= limit:
+                    break
+                parts = line.rstrip("\n").split("\t")
+                for j in range(criteo.NUM_DENSE):
+                    v = parts[1 + j] if 1 + j < len(parts) else ""
+                    fv = float(v) if v else 0.0
+                    lo[j] = min(lo[j], fv)
+                    hi[j] = max(hi[j], fv)
+
+    rows = []  # buffered only when repetition needs a second walk
+    n = 0
+    header = "label," + ",".join(criteo.DENSE_NAMES) + "," + ",".join(
+        criteo.SPARSE_NAMES)
+    out = _open_out(out_path)
+    try:
+        out.write(header + "\n")
+        with open(in_path) as f:
+            for i, line in enumerate(f):
+                if limit and i >= limit:
+                    break
+                label, dense, cats = parse(line)
+                if minmax:
+                    scaled = [
+                        (v - lo[j]) / (hi[j] - lo[j])
+                        if hi[j] > lo[j] else 0.0
+                        for j, v in enumerate(dense)]
+                else:
+                    scaled = [math.log1p(max(v, 0.0)) for v in dense]
+                row = (label + ","
+                       + ",".join(f"{v:.6g}" for v in scaled) + ","
+                       + ",".join(str(c) for c in cats))
+                out.write(row + "\n")
+                if repeat > 1:
+                    rows.append(row)
+                n += 1
+        for _ in range(repeat - 1):
+            for row in rows:
+                out.write(row + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return n
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input", help="raw Criteo TSV (label \\t 13 ints \\t "
+                                 "26 categoricals)")
+    p.add_argument("output", help="csv path ('-' = stdout)")
+    p.add_argument("--repeat", type=int, default=1)
+    p.add_argument("--minmax", action="store_true",
+                   help="two-pass min-max scaling (sklearn recipe) instead "
+                        "of log1p")
+    p.add_argument("--limit", type=int, default=0, help="max input rows")
+    args = p.parse_args(argv)
+    n = preprocess(args.input, args.output, repeat=args.repeat,
+                   minmax=args.minmax, limit=args.limit)
+    print(f"wrote {n} rows x {args.repeat}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,7 +110,9 @@ def make_fused_specs(feature_names: Sequence[str],
                      hash_capacity: int = 2**20,
                      key_dtype: str = "int32",
                      num_shards: int = -1,
-                     plane: str = "a2a"
+                     plane: str = "a2a",
+                     a2a_capacity: int = 0,
+                     a2a_slack: float = 2.0
                      ) -> Tuple[Tuple[EmbeddingSpec, ...], FusedMapper]:
     """Specs + mapper for one fused table over ``feature_names``.
 
@@ -137,12 +139,14 @@ def make_fused_specs(feature_names: Sequence[str],
         name=name, input_dim=input_dim, output_dim=embedding_dim,
         dtype=dtype, optimizer=optimizer, initializer=emb_init,
         hash_capacity=hash_capacity, key_dtype=key_dtype,
-        num_shards=num_shards, plane=plane)]
+        num_shards=num_shards, plane=plane,
+        a2a_capacity=a2a_capacity, a2a_slack=a2a_slack)]
     if need_linear:
         specs.append(EmbeddingSpec(
             name=name + LINEAR_SUFFIX, input_dim=input_dim, output_dim=1,
             dtype=dtype, optimizer=optimizer,
             initializer={"category": "constant", "value": 0.0},
             hash_capacity=hash_capacity, key_dtype=key_dtype,
-            num_shards=num_shards, plane=plane))
+            num_shards=num_shards, plane=plane,
+            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack))
     return tuple(specs), mapper

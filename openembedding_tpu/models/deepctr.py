@@ -41,7 +41,9 @@ def make_feature_specs(feature_names: Sequence[str],
                        initializer: Any = None,
                        hash_capacity: int = 2**20,
                        num_shards: int = -1,
-                       plane: str = "a2a") -> Tuple[EmbeddingSpec, ...]:
+                       plane: str = "a2a",
+                       a2a_capacity: int = 0,
+                       a2a_slack: float = 2.0) -> Tuple[EmbeddingSpec, ...]:
     """Build the spec list for a set of categorical features.
 
     ``vocab_sizes``: int per feature, or a single int, or -1 for the hash
@@ -60,14 +62,16 @@ def make_feature_specs(feature_names: Sequence[str],
         specs.append(EmbeddingSpec(
             name=name, input_dim=vocab, output_dim=embedding_dim,
             dtype=dtype, optimizer=optimizer, initializer=emb_init,
-            hash_capacity=hash_capacity, num_shards=num_shards, plane=plane))
+            hash_capacity=hash_capacity, num_shards=num_shards, plane=plane,
+            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack))
         if need_linear:
             specs.append(EmbeddingSpec(
                 name=name + LINEAR_SUFFIX, input_dim=vocab, output_dim=1,
                 dtype=dtype, optimizer=optimizer,
                 initializer={"category": "constant", "value": 0.0},
                 hash_capacity=hash_capacity, num_shards=num_shards,
-                plane=plane))
+                plane=plane, a2a_capacity=a2a_capacity,
+                a2a_slack=a2a_slack))
     return tuple(specs)
 
 

@@ -87,7 +87,10 @@ def gather_rows(table: jnp.ndarray, indices: jnp.ndarray,
             "the whole table every call)")
     dpad = dim
     npad = -(-n // ROWS_PER_STEP) * ROWS_PER_STEP
-    idx = indices.astype(jnp.int32)
+    # bounds-check in the ORIGINAL dtype: an int64 id >= 2^32 must become an
+    # invalid (-1) row, not wrap onto a real one through the int32 cast
+    valid = (indices >= 0) & (indices < vocab)
+    idx = jnp.where(valid, indices, -1).astype(jnp.int32)
     if npad != n:
         idx = jnp.pad(idx, (0, npad - n), constant_values=-1)
     # the kernel needs the vocab bound; smuggle it as the last prefetch slot

@@ -197,7 +197,7 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                   record_drops: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if spec.plane == "a2a":
+    if spec.plane == "a2a" and spec.num_shards > 1:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
@@ -274,7 +274,7 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                    slot_names: tuple, record_drops: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if spec.plane == "a2a":
+    if spec.plane == "a2a" and spec.num_shards > 1:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 

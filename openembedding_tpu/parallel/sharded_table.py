@@ -185,7 +185,10 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
     otherwise rebuild + retrace the shard_map closure every call."""
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if spec.plane == "a2a":
+    # single shard => nothing to route; the masked-local body below (whose
+    # collectives are free over size-1 axes) skips the bucketing machinery
+    # (~25% faster on one chip for the headline config)
+    if spec.plane == "a2a" and spec.num_shards > 1:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
         sentinel = dedup.FILL
@@ -262,7 +265,7 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                    slot_names: tuple, record_drops: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if spec.plane == "a2a":
+    if spec.plane == "a2a" and spec.num_shards > 1:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 

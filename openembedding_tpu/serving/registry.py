@@ -104,7 +104,8 @@ class ModelRegistry:
         Async when ``block=False``: status is CREATING until the load thread
         finishes (reference ModelController.cpp:47-85 thread-group load).
         """
-        with open(f"{model_uri}/{ckpt_lib.MODEL_META_FILE}") as f:
+        with open(f"{model_uri}/{ckpt_lib.MODEL_META_FILE}",
+                  encoding="utf-8") as f:
             meta = ModelMeta.loads(f.read())
         sign = model_sign or meta.model_sign or model_uri
         with self._lock:

@@ -54,6 +54,10 @@ class A2AConfig:
     def __post_init__(self):
         _validate(self)
 
+    def spec_kwargs(self) -> Dict[str, Any]:
+        """kwargs for EmbeddingSpec / make_*_specs (a2a_capacity/a2a_slack)."""
+        return {"a2a_capacity": self.capacity, "a2a_slack": self.slack}
+
 
 _check(A2AConfig, "capacity", lambda v: v >= 0, "must be >= 0 (0 = auto)")
 _check(A2AConfig, "slack", lambda v: v > 0, "must be > 0")
@@ -71,6 +75,10 @@ class OffloadConfig:
 
     def __post_init__(self):
         _validate(self)
+
+    def table_kwargs(self) -> Dict[str, Any]:
+        """kwargs for ShardedOffloadedTable (budgets + persist window)."""
+        return dataclasses.asdict(self)
 
 
 _check(OffloadConfig, "cache_capacity", lambda v: v > 0, "must be > 0")
@@ -184,3 +192,16 @@ class EnvConfig:
     def to_json(self) -> Dict[str, Dict[str, Any]]:
         return {name: dataclasses.asdict(getattr(self, name))
                 for name in _SECTIONS}
+
+    def apply_report(self):
+        """Wire the report section into the observability plane: sets the
+        performance-evaluation gate and starts the rank-0 periodic reporter
+        when an interval is configured (WorkerContext.cpp:24-41). Returns
+        the started Reporter (stop() it on shutdown) or None."""
+        from . import observability
+        observability.set_evaluate_performance(
+            self.report.evaluate_performance)
+        if self.report.report_interval > 0:
+            return observability.Reporter(
+                self.report.report_interval).start()
+        return None

@@ -83,3 +83,40 @@ def test_csv_reader(tmp_path):
     batches = list(criteo.read_criteo_csv(str(p), 5))
     assert len(batches) == 1
     assert batches[0]["sparse"]["C26"][2] == 2 * 26 + 25
+
+
+def test_preprocess_cli(tmp_path):
+    """TSV -> CSV preprocessing: label encoding + scaling + repeat, and the
+    output round-trips through read_criteo_csv."""
+    from openembedding_tpu.data import criteo, preprocess
+    tsv = tmp_path / "raw.tsv"
+    rows = []
+    for i in range(6):
+        dense = "\t".join(str(i + j) for j in range(13))
+        cats = "\t".join(f"v{(i + j) % 3:x}" for j in range(26))
+        rows.append(f"{i % 2}\t{dense}\t{cats}")
+    # a ragged line (missing trailing fields) must not crash
+    rows.append("1\t5")
+    tsv.write_text("\n".join(rows) + "\n")
+
+    out = tmp_path / "out.csv"
+    n = preprocess.preprocess(str(tsv), str(out), repeat=2)
+    assert n == 7
+    lines = out.read_text().strip().split("\n")
+    assert lines[0].startswith("label,I1")
+    assert len(lines) == 1 + 2 * 7
+    batches = list(criteo.read_criteo_csv(str(out), 7))
+    assert len(batches) == 2
+    b = batches[0]
+    assert b["label"].shape == (7,)
+    assert b["dense"].shape == (7, 13)
+    assert all(b["sparse"][c].shape == (7,) for c in criteo.SPARSE_NAMES)
+    # label encoding: first-seen ids are dense and start at 0
+    assert b["sparse"]["C1"].min() == 0
+
+    # minmax variant stays within [0, 1]
+    out2 = tmp_path / "mm.csv"
+    preprocess.preprocess(str(tsv), str(out2), minmax=True)
+    b2 = next(iter(criteo.read_criteo_csv(str(out2), 7)))
+    assert float(b2["dense"].min()) >= 0.0
+    assert float(b2["dense"].max()) <= 1.0

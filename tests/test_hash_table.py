@@ -150,3 +150,21 @@ def test_sharded_hash_batch_replicated(devices8):
     r1 = sh.pull_sharded(t1, keys, INIT, mesh=mesh, spec=spec, batch_sharded=True)
     r2 = sh.pull_sharded(t2, keys, INIT, mesh=mesh, spec=spec, batch_sharded=False)
     np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), rtol=1e-6)
+
+
+def test_int64_keys_full_width(devices8):
+    """The reference's 2^62 key space: int64 keys end-to-end in a dedicated
+    x64 process (the global flag changes dtypes program-wide, so the
+    documented deployment shape is a dedicated interpreter)."""
+    import os
+    import subprocess
+    import sys
+    worker = os.path.join(os.path.dirname(__file__), "x64_worker.py")
+    root = os.path.dirname(os.path.dirname(worker))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, worker], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "x64 worker: ok" in out.stdout
