@@ -23,7 +23,7 @@ import numpy as np
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="deepfm",
-                   choices=["lr", "wdl", "deepfm", "xdeepfm"])
+                   choices=["lr", "wdl", "deepfm", "xdeepfm", "dcn"])
     p.add_argument("--data", default="", help="path to criteo csv/tsv; "
                    "empty = synthetic stream")
     p.add_argument("--format", default="csv", choices=["csv", "tsv"])

@@ -227,11 +227,54 @@ class XDeepFM(nn.Module):
                 + deep_logit.astype(linear.dtype) + bias[0])
 
 
+class CrossNet(nn.Module):
+    """DCN cross layers: x_{k+1} = x0 * (w_k . x_k) + b_k + x_k."""
+
+    num_layers: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x0):  # [B, d]
+        x = x0
+        d = x0.shape[-1]
+        for k in range(self.num_layers):
+            w = self.param(f"cross_w_{k}", nn.initializers.glorot_uniform(),
+                           (d, 1), self.dtype)
+            b = self.param(f"cross_b_{k}", nn.initializers.zeros, (d,),
+                           self.dtype)
+            xw = (x.astype(self.dtype) @ w).astype(x0.dtype)  # [B, 1]
+            x = x0 * xw + b.astype(x0.dtype) + x
+        return x
+
+
+class DCN(nn.Module):
+    """Deep & Cross Network: CrossNet + MLP over flattened fields + dense."""
+
+    feature_names: Tuple[str, ...]
+    cross_layers: int = 3
+    dnn_units: Tuple[int, ...] = (256, 128)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, dense, rows):
+        fields = _stack_fields(rows, self.feature_names)
+        x0 = fields.reshape(fields.shape[0], -1)
+        if dense is not None:
+            x0 = jnp.concatenate([x0, dense.astype(x0.dtype)], axis=-1)
+        cross = CrossNet(self.cross_layers, dtype=self.dtype)(x0)
+        deep = MLP(self.dnn_units, dtype=self.dtype)(x0)
+        out = jnp.concatenate([cross, deep.astype(cross.dtype)], axis=-1)
+        logit = nn.Dense(1, dtype=self.dtype)(out).reshape(-1)
+        bias = self.param("bias", nn.initializers.zeros, (1,))
+        return logit.astype(jnp.float32) + bias[0]
+
+
 MODELS = {
     "lr": LogisticRegression,
     "wdl": WideDeep,
     "deepfm": DeepFM,
     "xdeepfm": XDeepFM,
+    "dcn": DCN,
 }
 
 

@@ -50,7 +50,7 @@ def build_trainer(model_name, mesh, vocab=VOCAB, **spec_kw):
     return Trainer(model, coll, optax.adam(1e-2))
 
 
-@pytest.mark.parametrize("model_name", ["lr", "wdl", "deepfm", "xdeepfm"])
+@pytest.mark.parametrize("model_name", ["lr", "wdl", "deepfm", "xdeepfm", "dcn"])
 def test_model_zoo_trains(devices8, model_name):
     mesh = create_mesh(2, 4, devices8)
     trainer = build_trainer(model_name, mesh)
