@@ -324,9 +324,14 @@ struct oe_variable {
   int variable_id = 0;
   int dim = 0;
   int64_t vocab = 0;      // -1 => hash
-  std::unique_ptr<NpyArray> weights;
-  std::unique_ptr<NpyArray> keys;  // hash only
-  std::unordered_map<int64_t, int64_t> index;  // hash key -> row
+  // one entry per dump part (single-host dumps have one); multi-host
+  // bounded parts carry keyed (ids, rows) files like hash parts
+  std::vector<std::unique_ptr<NpyArray>> weights;
+  std::vector<std::unique_ptr<NpyArray>> keys;  // hash keys / bounded ids
+  bool direct = false;  // single dense part: row == id, no index
+  // key/id -> (part << 40 | row); parts < 2^24, rows < 2^40
+  std::unordered_map<int64_t, int64_t> index;
+  int64_t total_rows = 0;
 };
 
 struct oe_model {
@@ -390,33 +395,67 @@ oe_model* oe_model_load(const char* path) {
       safe.replace(pos, 1, "__");
     std::string vdir = root + "/var_" + std::to_string(var->variable_id)
         + "_" + safe + ".d";
-    var->weights = open_npy(vdir + "/weights.npy");
-    if (!var->weights) return nullptr;
-    if (var->weights->row_elems() != var->dim) {
-      set_error("weights dim mismatch for " + var->name);
+    // single-host dumps: weights.npy (+ keys.npy for hash). Multi-host
+    // dumps: part<k>_weights.npy with part<k>_{ids,keys}.npy — the
+    // reference's per-node dump files.
+    std::vector<std::string> prefixes;
+    {
+      struct stat st;
+      if (::stat((vdir + "/weights.npy").c_str(), &st) == 0) {
+        prefixes.push_back("");
+      } else {
+        for (int k = 0; k < (1 << 20); ++k) {
+          std::string p = "part" + std::to_string(k) + "_";
+          if (::stat((vdir + "/" + p + "weights.npy").c_str(), &st) != 0)
+            break;
+          prefixes.push_back(p);
+        }
+      }
+    }
+    if (prefixes.empty()) {
+      set_error("no weights files under " + vdir);
       return nullptr;
     }
-    if (!weights_dtype_supported(*var->weights)) {
-      set_error("unsupported weights dtype " + var->weights->dtype
-                + " for " + var->name);
-      return nullptr;
+    var->direct = !hash && prefixes.size() == 1 && prefixes[0].empty();
+    for (size_t k = 0; k < prefixes.size(); ++k) {
+      auto w = open_npy(vdir + "/" + prefixes[k] + "weights.npy");
+      if (!w) return nullptr;
+      if (w->row_elems() != var->dim) {
+        set_error("weights dim mismatch for " + var->name);
+        return nullptr;
+      }
+      if (!weights_dtype_supported(*w)) {
+        set_error("unsupported weights dtype " + w->dtype + " for "
+                  + var->name);
+        return nullptr;
+      }
+      var->total_rows += w->rows();
+      std::string key_file = vdir + "/" + prefixes[k]
+          + (hash ? "keys.npy" : "ids.npy");
+      if (!var->direct) {
+        auto kk = open_npy(key_file);
+        if (!kk) return nullptr;
+        if (kk->rows() != w->rows()) {
+          set_error("key/row count mismatch for " + var->name);
+          return nullptr;
+        }
+        int64_t n = kk->rows();
+        var->index.reserve(var->index.size() + static_cast<size_t>(n) * 2);
+        for (int64_t i = 0; i < n; ++i) {
+          var->index[load_elem_as_i64(*kk, i)] =
+              (static_cast<int64_t>(k) << 40) | i;
+        }
+        var->keys.push_back(std::move(kk));
+      }
+      var->weights.push_back(std::move(w));
     }
-    // a bounded table must hold exactly its vocabulary: a key bound-checked
-    // against the meta vocab must never index past the mapped rows
-    if (var->vocab >= 0 && var->weights->rows() != var->vocab) {
-      set_error("weights rows " + std::to_string(var->weights->rows())
+    // a single dense part must hold exactly its vocabulary: a key
+    // bound-checked against the meta vocab must never index past the rows
+    if (var->direct && var->weights[0]->rows() != var->vocab) {
+      set_error("weights rows " + std::to_string(var->weights[0]->rows())
                 + " != vocabulary " + std::to_string(var->vocab)
                 + " for " + var->name);
       return nullptr;
-    }
-    if (hash) {
-      var->keys = open_npy(vdir + "/keys.npy");
-      if (!var->keys) return nullptr;
-      int64_t n = var->keys->rows();
-      var->index.reserve(static_cast<size_t>(n) * 2);
-      for (int64_t i = 0; i < n; ++i) {
-        var->index.emplace(load_elem_as_i64(*var->keys, i), i);
-      }
     }
     model->by_name[var->name] = var.get();
     model->by_id[var->variable_id] = var.get();
@@ -460,27 +499,31 @@ int oe_variable_id(const oe_variable* var) { return var->variable_id; }
 int oe_variable_dim(const oe_variable* var) { return var->dim; }
 int64_t oe_variable_vocab(const oe_variable* var) { return var->vocab; }
 int64_t oe_variable_rows(const oe_variable* var) {
-  return var->weights->rows();
+  return var->total_rows;
 }
 
 int oe_pull_weights(const oe_variable* var, const int64_t* keys, int64_t n,
                     float* out) {
   g_error.clear();
-  const NpyArray& w = *var->weights;
   const int dim = var->dim;
-  const bool f32 = w.dtype[1] == 'f' && w.itemsize == 4;
   for (int64_t i = 0; i < n; ++i) {
-    int64_t row = -1;
-    if (var->vocab >= 0) {
+    int64_t part = 0, row = -1;
+    if (var->direct) {
       if (keys[i] >= 0 && keys[i] < var->vocab) row = keys[i];
-    } else {
+    } else if (var->vocab < 0 || (keys[i] >= 0 && keys[i] < var->vocab)) {
       auto it = var->index.find(keys[i]);
-      if (it != var->index.end()) row = it->second;
+      if (it != var->index.end()) {
+        part = it->second >> 40;
+        row = it->second & ((int64_t(1) << 40) - 1);
+      }
     }
     float* dst = out + i * dim;
     if (row < 0) {
       std::memset(dst, 0, sizeof(float) * dim);
-    } else if (f32) {
+      continue;
+    }
+    const NpyArray& w = *var->weights[part];
+    if (w.dtype[1] == 'f' && w.itemsize == 4) {
       std::memcpy(dst, w.data + row * dim * 4, sizeof(float) * dim);
     } else {
       for (int d = 0; d < dim; ++d) {

@@ -108,9 +108,9 @@ def _iter_shard_blocks(arr):
     reference's per-shard block streaming (EmbeddingDumpOperator.cpp:50-96).
     Replicated shards (psum plane: data-axis copies) are emitted once.
     """
-    for shard in arr.addressable_shards:
-        if shard.replica_id != 0:
-            continue  # psum-plane data-axis replica: identical copy
+    shards = sorted((s for s in arr.addressable_shards if s.replica_id == 0),
+                    key=lambda s: s.index[0].start or 0)
+    for shard in shards:
         data = shard.data
         rows = data.shape[0]
         if not rows:
@@ -123,12 +123,10 @@ def _iter_shard_blocks(arr):
             yield start + lo, np.asarray(jax.device_get(data[lo:hi]))
 
 
-def _require_single_process(what: str) -> None:
+def _sync(name: str) -> None:
     if jax.process_count() > 1:
-        raise NotImplementedError(
-            f"{what} currently runs on a single-controller process; on a "
-            "multi-host cluster write per-host part files (the reference's "
-            "model_<node>_<fileid> layout) — not implemented yet")
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
 
 
 def save_checkpoint(path: str,
@@ -138,11 +136,21 @@ def save_checkpoint(path: str,
                     dense_state: Any = None,
                     include_optimizer: bool = True,
                     model_sign: str = "") -> None:
-    """Dump all embedding variables (+ optional dense pytree) under ``path``."""
-    _require_single_process("save_checkpoint")  # before any writes
+    """Dump all embedding variables (+ optional dense pytree) under ``path``.
+
+    Works single- or multi-host: with N > 1 processes each host streams its
+    own shards into per-host part files (the reference's per-node
+    ``model_<node>_<fileid>`` dump layout, EmbeddingDumpOperator.cpp:28) —
+    ``path`` must be a shared filesystem. Rank 0 writes the meta; barriers
+    bracket the writes.
+    """
+    nproc = jax.process_count()
+    rank = jax.process_index()
     os.makedirs(path, exist_ok=True)
     meta = collection.model_meta(model_sign=model_sign, model_uri=path)
     meta.extra["include_optimizer"] = bool(include_optimizer)
+    if nproc > 1:
+        meta.extra["num_parts"] = nproc
     # persist hash-table geometry so a loader (e.g. the serving registry,
     # which rebuilds specs from this meta alone) allocates tables that can
     # hold every stored row — the reference's load path delivers every row
@@ -154,29 +162,40 @@ def save_checkpoint(path: str,
     }
     if hash_info:
         meta.extra["hash_variables"] = hash_info
-    with open(os.path.join(path, MODEL_META_FILE), "w",
-              encoding="utf-8") as f:
-        f.write(meta.dumps())
+    if rank == 0:
+        with open(os.path.join(path, MODEL_META_FILE), "w",
+                  encoding="utf-8") as f:
+            f.write(meta.dumps())
+        for name in collection.specs:
+            vdir = os.path.join(
+                path, _var_dir(collection.variable_id(name), name))
+            if os.path.isdir(vdir):
+                # a previous save under a different optimizer could leave
+                # stale slot files a later load would mistake for state
+                import shutil
+                shutil.rmtree(vdir)
+            os.makedirs(vdir)
+    _sync("ckpt_dirs_ready")
 
     for name, spec in collection.specs.items():
         state = states[name]
         vid = collection.variable_id(name)
         vdir = os.path.join(path, _var_dir(vid, name))
-        if os.path.isdir(vdir):
-            # a previous save under a different optimizer could leave stale
-            # slot files behind, which a later load would mistake for state
-            import shutil
-            shutil.rmtree(vdir)
-        os.makedirs(vdir)
+        part = f"part{rank}_" if nproc > 1 else ""
         if spec.use_hash:
-            _save_hash_var(vdir, state, include_optimizer)
+            _save_hash_var(vdir, state, include_optimizer, part=part)
+        elif nproc > 1:
+            _save_array_var_part(vdir, rank, state,
+                                 collection.sharding_spec(name),
+                                 spec.input_dim, include_optimizer)
         else:
             _save_array_var(vdir, state, collection.sharding_spec(name),
                             spec.input_dim, include_optimizer)
 
-    if dense_state is not None:
+    if dense_state is not None and rank == 0:
         with open(os.path.join(path, DENSE_FILE), "wb") as f:
             f.write(serialization.to_bytes(jax.device_get(dense_state)))
+    _sync("ckpt_done")
 
 
 def _save_array_var(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
@@ -205,23 +224,71 @@ def _save_array_var(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
         del mm
 
 
-def _save_hash_var(vdir: str, state, include_optimizer: bool) -> None:
-    """Stream one hash variable's live rows to ``<vdir>/*.npy``.
+def _save_array_var_part(vdir: str, rank: int, state,
+                         sspec: st.ShardingSpec, vocab: int,
+                         include_optimizer: bool) -> None:
+    """Multi-host dump of one bounded variable: this process streams ITS
+    addressable shards into keyed part files ``part<rank>_{ids,weights,
+    slot_*}.npy`` (logical ids + rows) — the per-node dump files of the
+    reference, re-shardable onto any mesh at load."""
+    targets = {"weights": state.weights}
+    if include_optimizer:
+        for sname, sval in state.slots.items():
+            targets[f"slot_{sname}"] = sval
+    # count this process's valid rows (shared across all targets)
+    nv_total = 0
+    shards = sorted(
+        (s for s in state.weights.addressable_shards if s.replica_id == 0),
+        key=lambda s: s.index[0].start or 0)
+    for s in shards:
+        _, nv = _logical_slice(sspec, vocab, s.index[0].start or 0,
+                               s.data.shape[0])
+        nv_total += nv
+    ids_mm = np.lib.format.open_memmap(
+        os.path.join(vdir, f"part{rank}_ids.npy"), mode="w+",
+        dtype=np.int64, shape=(nv_total,))
+    for i, (fname, arr) in enumerate(targets.items()):
+        mm = np.lib.format.open_memmap(
+            os.path.join(vdir, f"part{rank}_{fname}.npy"), mode="w+",
+            dtype=np.dtype(arr.dtype), shape=(nv_total,) + arr.shape[1:])
+        off = 0
+        for phys_start, block in _iter_shard_blocks(arr):
+            sl, nv = _logical_slice(sspec, vocab, phys_start, block.shape[0])
+            if not nv:
+                continue
+            mm[off:off + nv] = block[:nv]
+            if i == 0:
+                ids_mm[off:off + nv] = np.arange(
+                    sl.start, sl.stop, sl.step or 1, dtype=np.int64)
+            off += nv
+        assert off == nv_total, (fname, off, nv_total)
+        mm.flush()
+        del mm
+    ids_mm.flush()
 
-    Pass 1 counts live rows per shard on-device (a scalar per shard); pass 2
-    streams (keys, weights, states) blocks and writes the live subset — the
+
+def _save_hash_var(vdir: str, state, include_optimizer: bool,
+                   part: str = "") -> None:
+    """Stream one hash variable's live rows to ``<vdir>/<part>*.npy``.
+
+    Pass 1 counts live rows per addressable shard on-device; pass 2 streams
+    (keys, weights, states) blocks and writes the live subset — the
     reference's streamed (indices, weights, states) block dump with
-    re-globalized keys (EmbeddingShardFile.h:21-23).
+    re-globalized keys (EmbeddingShardFile.h:21-23). ``part`` prefixes the
+    files for multi-host dumps (each host writes only its shards).
     """
     empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
-    total = int(jax.device_get(state.num_used()))
+    total = sum(
+        int(jax.device_get(jnp.sum(s.data != np.asarray(
+            empty, dtype=np.dtype(state.keys.dtype)))))
+        for s in state.keys.addressable_shards if s.replica_id == 0)
     targets = {"keys": state.keys, "weights": state.weights}
     if include_optimizer:
         for sname, sval in state.slots.items():
             targets[f"slot_{sname}"] = sval
     mms = {
         fname: np.lib.format.open_memmap(
-            os.path.join(vdir, fname + ".npy"), mode="w+",
+            os.path.join(vdir, part + fname + ".npy"), mode="w+",
             dtype=np.dtype(arr.dtype), shape=(total,) + arr.shape[1:])
         for fname, arr in targets.items()
     }
@@ -268,9 +335,12 @@ class _NpyDirReader:
     legacy ``np.load`` npz handle, so one loader serves both formats.
     """
 
-    def __init__(self, vdir: str):
+    def __init__(self, vdir: str, prefix: str = ""):
         self._vdir = vdir
-        self._names = {f[:-4] for f in os.listdir(vdir) if f.endswith(".npy")}
+        self._prefix = prefix
+        self._names = {f[len(prefix):-4] for f in os.listdir(vdir)
+                       if f.endswith(".npy") and f.startswith(prefix)
+                       and (prefix or not f.startswith("part"))}
 
     def __contains__(self, name: str) -> bool:
         return name in self._names
@@ -278,65 +348,91 @@ class _NpyDirReader:
     def __getitem__(self, name: str):
         if name not in self._names:
             raise KeyError(name)
-        return np.load(os.path.join(self._vdir, name + ".npy"),
+        return np.load(os.path.join(self._vdir, self._prefix + name + ".npy"),
                        mmap_mode="r")
 
 
 def _open_var(path: str, vid: int, name: str):
+    """Readers for one variable: a list with one dict-like entry per dump
+    part (multi-host dumps have one per writing process; single-host and
+    legacy npz dumps have exactly one)."""
     vdir = os.path.join(path, _var_dir(vid, name))
     if os.path.isdir(vdir):
-        return _NpyDirReader(vdir)
-    return np.load(os.path.join(path, _var_file(vid, name)))  # legacy npz
+        prefixes = sorted({f.split("_", 1)[0] + "_"
+                           for f in os.listdir(vdir)
+                           if f.startswith("part")})
+        if prefixes:
+            return [_NpyDirReader(vdir, p) for p in prefixes]
+        return [_NpyDirReader(vdir)]
+    return [np.load(os.path.join(path, _var_file(vid, name)))]  # legacy npz
 
 
-def _load_array_var(data, spec, sspec: st.ShardingSpec, optimizer,
+def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
                     shardings, with_opt: bool):
-    """Assemble one bounded variable shard-by-shard from logical-order data.
+    """Assemble one bounded variable shard-by-shard from its dump.
 
-    For every addressable device, reads exactly its rows (a strided slice of
-    the logical file under the "mod" layout), pads rows beyond the stored
-    vocab with the fill value, and places them directly — host memory peaks
-    at one shard, and no full-table host array ever exists (the streaming
-    inverse of _save_array_var).
+    ``readers`` is the part list from ``_open_var``. A single-part dump is
+    read in logical order (each device's rows are a basic strided slice of
+    the file); keyed multi-host parts carry (ids, rows) and are scattered
+    into the owning device buffers part-at-a-time. Either way host memory
+    peaks at one shard and no full-table host array ever exists.
     """
     vocab = spec.input_dim
     dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
     pv = sspec.padded_vocab
+    keyed = len(readers) > 1 or "ids" in readers[0]
+    # one ids read + physical-position computation per part, shared across
+    # every (field, device) pair below
+    parts_phys = []
+    if keyed:
+        for r in readers:
+            ids = np.asarray(r["ids"])
+            shard, local_idx = sspec.shard_and_local(ids)
+            parts_phys.append(
+                (ids, shard * sspec.rows_per_shard + local_idx))
 
-    def build(source, fill, store_dtype, row_shape, sharding):
+    def build(fname, fill, store_dtype, row_shape, sharding):
         global_shape = (pv,) + row_shape
         locals_ = []
         devs = sorted(
             sharding.addressable_devices_indices_map(global_shape).items(),
             key=lambda kv: kv[1][0].start or 0)
-        stored = 0 if source is None else min(vocab, source.shape[0])
+        sources = [r[fname] if fname in r else None for r in readers]
         for dev, idx in devs:
             start = idx[0].start or 0
             stop = idx[0].stop if idx[0].stop is not None else pv
             local = np.full((stop - start,) + row_shape, fill,
                             dtype=store_dtype)
-            sl, nv = _logical_slice(sspec, stored, start, stop - start)
-            if nv:
-                # basic (strided/contiguous) memmap slice: streams this
-                # shard's rows without touching the rest of the file
-                local[:nv] = source[sl]
+            if keyed:
+                for (ids, phys), source in zip(parts_phys, sources):
+                    if source is None:
+                        continue
+                    sel = (phys >= start) & (phys < stop) & (ids < vocab)
+                    if sel.any():
+                        local[phys[sel] - start] = source[sel]
+            elif sources[0] is not None:
+                stored = min(vocab, sources[0].shape[0])
+                sl, nv = _logical_slice(sspec, stored, start, stop - start)
+                if nv:
+                    # basic (strided/contiguous) memmap slice: streams this
+                    # shard's rows without touching the rest of the file
+                    local[:nv] = sources[0][sl]
             locals_.append(jax.device_put(local, dev))
         return jax.make_array_from_single_device_arrays(
             global_shape, sharding, locals_)
 
-    w = data["weights"]  # bind once: npz access decompresses per access
-    weights = build(w, 0.0, dtype, w.shape[1:], shardings.weights)
+    dim0 = readers[0]["weights"].shape[1:]
+    weights = build("weights", 0.0, dtype, dim0, shardings.weights)
     new_slots = {}
     dim = spec.output_dim
     for sname, sshape in optimizer.slot_shapes(dim).items():
         sdtype = np.dtype(optimizer.slot_dtype(sname, dtype))
         fill = optimizer.slot_init(sname)
-        fname = f"slot_{sname}"
-        source = data[fname] if (with_opt and fname in data) else None
+        fname = f"slot_{sname}" if with_opt else "__absent__"
         # absent from the dump (saved without optimizer state, or under a
         # different optimizer category): fresh slot init, weights kept —
         # copy_from hot-swap semantics (EmbeddingVariable.cpp:29-60)
-        new_slots[sname] = build(source, fill, sdtype, tuple(sshape),
+        new_slots[sname] = build(fname, fill, sdtype, tuple(sshape),
                                  shardings.slots[sname])
     return table_lib.TableState(weights=weights, slots=new_slots)
 
@@ -384,39 +480,16 @@ def load_checkpoint(path: str,
         optimizer = collection.optimizer(name)
         if spec.use_hash:
             state = states[name]
-            keys = data["keys"]
-            weights = data["weights"]
-            # slots present in both the checkpoint and the current optimizer
-            # are restored; others keep their fresh init — loading into a
-            # different optimizer category keeps weights and re-initializes
-            # slots, the reference's copy_from hot-swap semantics
-            # (EmbeddingVariable.cpp:29-60)
-            slot_data = ({s: data[f"slot_{s}"] for s in state.slots
-                          if f"slot_{s}" in data}
-                         if with_opt else {})
-            # stream fixed-size chunks (padded with EMPTY) to keep shapes static
-            empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
-            n = keys.shape[0]
-            for lo in range(0, max(n, 1), _LOAD_CHUNK):
-                hi = min(lo + _LOAD_CHUNK, n)
-                size = min(_LOAD_CHUNK, max(n, 1))
-                ck = np.full((size,), empty, dtype=keys.dtype)
-                cw = np.zeros((size,) + weights.shape[1:], weights.dtype)
-                ck[:hi - lo] = keys[lo:hi]
-                cw[:hi - lo] = weights[lo:hi]
-                srows = {}
-                for sname, full in slot_data.items():
-                    cs = np.zeros((size,) + full.shape[1:], full.dtype)
-                    cs[:hi - lo] = full[lo:hi]
-                    srows[sname] = jnp.asarray(cs)
-                state = sh.insert_rows_sharded(
-                    state, jnp.asarray(ck), jnp.asarray(cw), srows,
-                    mesh=collection.mesh, spec=sspec)
+            total_rows = 0
+            for data_part in data:
+                state, n_part = _insert_hash_rows(
+                    state, data_part, collection, sspec, with_opt)
+                total_rows += n_part
             failed = int(jax.device_get(state.insert_failures))
             if failed > 0:
                 raise RuntimeError(
-                    f"hash variable {name!r}: {failed} of {n} checkpoint "
-                    f"rows did not fit (hash_capacity="
+                    f"hash variable {name!r}: {failed} of {total_rows} "
+                    f"checkpoint rows did not fit (hash_capacity="
                     f"{spec.hash_capacity}); increase hash_capacity — a "
                     "load must deliver every row or fail")
             out[name] = state
@@ -429,6 +502,38 @@ def load_checkpoint(path: str,
             dense = serialization.from_bytes(dense_state_template, f.read())
         return out, dense
     return out
+
+
+def _insert_hash_rows(state, data, collection, sspec, with_opt):
+    """Stream one reader's (keys, weights, states) rows into the table."""
+    keys = data["keys"]
+    weights = data["weights"]
+    # slots present in both the checkpoint and the current optimizer are
+    # restored; others keep their fresh init — loading into a different
+    # optimizer category keeps weights and re-initializes slots, the
+    # reference's copy_from hot-swap semantics (EmbeddingVariable.cpp:29-60)
+    slot_data = ({s: data[f"slot_{s}"] for s in state.slots
+                  if f"slot_{s}" in data}
+                 if with_opt else {})
+    # stream fixed-size chunks (padded with EMPTY) to keep shapes static
+    empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+    n = keys.shape[0]
+    for lo in range(0, max(n, 1), _LOAD_CHUNK):
+        hi = min(lo + _LOAD_CHUNK, n)
+        size = min(_LOAD_CHUNK, max(n, 1))
+        ck = np.full((size,), empty, dtype=keys.dtype)
+        cw = np.zeros((size,) + weights.shape[1:], weights.dtype)
+        ck[:hi - lo] = keys[lo:hi]
+        cw[:hi - lo] = weights[lo:hi]
+        srows = {}
+        for sname, full in slot_data.items():
+            cs = np.zeros((size,) + full.shape[1:], full.dtype)
+            cs[:hi - lo] = full[lo:hi]
+            srows[sname] = jnp.asarray(cs)
+        state = sh.insert_rows_sharded(
+            state, jnp.asarray(ck), jnp.asarray(cw), srows,
+            mesh=collection.mesh, spec=sspec)
+    return state, n
 
 
 def export_dense(collection: EmbeddingCollection,

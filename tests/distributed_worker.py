@@ -63,6 +63,40 @@ def main() -> int:
     np.testing.assert_allclose(full[:, 0], [-8.0, 0.0, -8.0, 0.0],
                                rtol=1e-6, atol=1e-6)
 
+    # multi-host checkpoint: each process writes its part files; reload on
+    # the same cluster reproduces the table (per-node dump layout)
+    if len(sys.argv) > 3:
+        from openembedding_tpu import checkpoint as ckpt
+        ckpt_dir = sys.argv[3]
+        hspec = EmbeddingSpec(name="h", input_dim=-1, output_dim=4,
+                              hash_capacity=256,
+                              initializer={"category": "constant",
+                                           "value": 0.25},
+                              optimizer={"category": "sgd",
+                                         "learning_rate": 1.0})
+        coll2 = EmbeddingCollection((spec, hspec), mesh)
+        st2 = coll2.init(jax.random.PRNGKey(0))
+        st2["t"] = states["t"]  # the trained table from above
+        hkeys = distributed.local_batch_to_global(
+            {"h": np.asarray([1001, 1002], np.int32) if rank == 0
+             else np.asarray([1003, 1004], np.int32)}, mesh)
+        st2 = coll2.apply_gradients(
+            st2, hkeys, {"h": jnp.ones((4, 4), jnp.float32)})
+        ckpt.save_checkpoint(ckpt_dir, coll2, st2, model_sign="mh-1")
+        loaded = ckpt.load_checkpoint(ckpt_dir, coll2)
+        got = coll2.pull(loaded, probe)["t"]
+        lfull = np.asarray(multihost_utils.process_allgather(
+            got, tiled=True))
+        np.testing.assert_allclose(lfull, full, rtol=1e-6, atol=1e-6)
+        hprobe = distributed.local_batch_to_global(
+            {"h": np.asarray([1001, 1003], np.int32) if rank == 0
+             else np.asarray([1004, 9999], np.int32)}, mesh)
+        hrows = np.asarray(multihost_utils.process_allgather(
+            coll2.pull(loaded, hprobe, read_only=True)["h"], tiled=True))
+        np.testing.assert_allclose(hrows[:3], 0.25 - 1.0, rtol=1e-6)
+        np.testing.assert_allclose(hrows[3], 0.0)  # unseen key
+        print(f"worker {rank}: multihost checkpoint ok", flush=True)
+
     distributed.barrier("done")
     print(f"worker {rank}: ok", flush=True)
     return 0
