@@ -112,7 +112,6 @@ def test_bfloat16_table_trains_sharded(devices8):
     """bf16 storage with f32 optimizer math, on the a2a plane end-to-end
     (the README-advertised bfloat16 path; reference stores f32/f64 only —
     bf16 halves HBM, a TPU-native win)."""
-    import jax.numpy as jnp_
     mesh = create_mesh(2, 4, devices8)
     meta = EmbeddingVariableMeta(embedding_dim=8, vocabulary_size=128,
                                  datatype="bfloat16")
@@ -121,13 +120,17 @@ def test_bfloat16_table_trains_sharded(devices8):
     state = st.create_sharded_table(
         meta, opt, {"category": "constant", "value": 0.25},
         mesh=mesh, spec=spec)
-    assert state.weights.dtype == jnp_.bfloat16
+    assert state.weights.dtype == jnp.bfloat16
+    # optimizer slots must stay >= f32 even for bf16 tables (the documented
+    # precision guarantee in optim/optimizers.py)
+    assert all(s.dtype == jnp.float32
+               for s in jax.tree.leaves(state.slots))
     idx = jnp.asarray(np.arange(16, dtype=np.int32))
     for _ in range(3):
         rows = st.pull_sharded(state, idx, mesh=mesh, spec=spec,
                                batch_sharded=False)
-        assert rows.dtype == jnp_.bfloat16
-        g = jnp.ones((16, 8), jnp_.bfloat16) * 0.5
+        assert rows.dtype == jnp.bfloat16
+        g = jnp.ones((16, 8), jnp.bfloat16) * 0.5
         state = st.apply_gradients_sharded(state, opt, idx, g, mesh=mesh,
                                            spec=spec, batch_sharded=False)
     rows = np.asarray(st.pull_sharded(state, idx, mesh=mesh, spec=spec,
