@@ -88,7 +88,21 @@ def emb_bytes_per_step(config, batch):
     return gather + update
 
 
-def run_config(name, config, *, steps, warmup):
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def run_config(name, config, *, steps, warmup, repeats=5):
+    """Train-throughput config: median-of-N timed blocks + stage breakdown.
+
+    The tunneled bench chip fluctuates ±20-45% between single blocks
+    (round-2 headline scored 2.39M and 1.33M on consecutive runs), so the
+    headline is the MEDIAN of ``repeats`` timed blocks with the spread
+    reported. ``pull_ms``/``update_ms`` time the sparse halves standalone
+    (same compiled programs, run in isolation) so regressions localize;
+    they overlap inside the fused step, so their sum exceeds ``step_ms``.
+    """
     import jax
     from openembedding_tpu.parallel.mesh import create_mesh
 
@@ -106,22 +120,58 @@ def run_config(name, config, *, steps, warmup):
         state, m = trainer.train_step(state, batches[i % len(batches)])
     jax.block_until_ready(m["loss"])
 
-    t0 = time.perf_counter()
-    for i in range(steps):
-        state, m = trainer.train_step(state, batches[i % len(batches)])
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+    block_eps = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, m = trainer.train_step(state, batches[i % len(batches)])
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+        block_eps.append(steps * batch / dt)
+    eps = _median(block_eps)
+    dt_step = batch / eps
 
-    eps = steps * batch / dt
+    # stage isolation: sparse pull / sparse update on the trained state
+    stage = {}
+    try:
+        sb = trainer.shard_batch(batches[0])
+        inputs = sb["sparse"] if isinstance(sb, dict) and "sparse" in sb \
+            else sb
+        if isinstance(inputs, dict):
+            inputs = {k: v for k, v in inputs.items() if k in coll.specs}
+        if inputs:
+            rows = coll.pull(state.emb, inputs)
+            jax.block_until_ready(jax.tree.leaves(rows))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                rows = coll.pull(state.emb, inputs)
+            jax.block_until_ready(jax.tree.leaves(rows))
+            stage["pull_ms"] = round(1000 * (time.perf_counter() - t0)
+                                     / steps, 3)
+            grads = {k: v for k, v in rows.items()}
+            emb = coll.apply_gradients(state.emb, inputs, grads)
+            jax.block_until_ready(jax.tree.leaves(emb))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                emb = coll.apply_gradients(state.emb, inputs, grads)
+            jax.block_until_ready(jax.tree.leaves(emb))
+            stage["update_ms"] = round(1000 * (time.perf_counter() - t0)
+                                       / steps, 3)
+    except Exception as e:  # noqa: BLE001 — breakdown is best-effort
+        stage["stage_error"] = f"{type(e).__name__}: {e}"
+
     result = {
         "metric": f"{name}_examples_per_sec_{platform}{n_dev}",
         "value": round(eps, 1),
         "unit": "examples/s",
         "vs_baseline": round(eps / n_dev / REF_PER_CHIP, 3),
         "per_chip": round(eps / n_dev, 1),
-        "step_ms": round(1000 * dt / steps, 3),
-        "emb_gbps": round(emb_bytes_per_step(config, batch) * steps
-                          / dt / 1e9, 2),
+        "step_ms": round(1000 * dt_step, 3),
+        "eps_min": round(min(block_eps), 1),
+        "eps_max": round(max(block_eps), 1),
+        "emb_gbps": round(emb_bytes_per_step(config, batch)
+                          / dt_step / 1e9, 2),
+        **stage,
         "config": dict(config),
     }
     if config.get("checkpoint"):
@@ -158,6 +208,312 @@ def run_checkpoint(coll, state):
     }
 
 
+def run_offload(name, config, *, steps, warmup):
+    """North-star-scale offload config: host store >> HBM through the
+    Trainer (the reference's PMem bar: DRAM-like throughput on a 500 GB
+    model, documents/en/pmem.md:1-7). Reports examples/s, cache-hit rate,
+    eviction and persist cost. The host store is a disk memmap
+    (``backing_dir``) so the bench is bounded by neither HBM nor host RAM.
+    """
+    import shutil
+    import tempfile
+    import jax
+    import optax
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec, Trainer
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    mesh = create_mesh(1, n_dev)
+    batch = config["batch"]
+    dim = config["dim"]
+    vocab = config["vocab"]
+    cache = config["cache"]
+    backing = tempfile.mkdtemp(prefix="bench_offload_")
+    try:
+        t0 = time.perf_counter()
+        table = ShardedOffloadedTable(
+            "uid", __import__("openembedding_tpu").EmbeddingVariableMeta(
+                embedding_dim=dim, vocabulary_size=vocab),
+            {"category": "adagrad", "learning_rate": 0.01},
+            {"category": "constant", "value": 0.01},
+            vocab=vocab, cache_capacity=cache, mesh=mesh,
+            backing_dir=backing)
+        alloc_s = time.perf_counter() - t0
+        specs = (table.embedding_spec(),
+                 EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                               optimizer={"category": "adagrad",
+                                          "learning_rate": 0.01}),)
+        coll = EmbeddingCollection(specs, mesh)
+        trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                          coll, optax.adagrad(0.01), offload={"uid": table})
+
+        rng = np.random.RandomState(0)
+        def make_batch():
+            # zipf-skewed ids over the full store: hot head caches, long
+            # tail streams through host
+            z = rng.zipf(config.get("zipf_a", 1.08), size=batch)
+            uid = ((z * 2654435761) % vocab).astype(np.int32)
+            return {"label": (rng.rand(batch) > 0.75).astype(np.float32),
+                    "dense": rng.randn(batch, 13).astype(np.float32),
+                    "sparse": {"uid": uid,
+                               "ctx": rng.randint(0, 100_000, batch)
+                               .astype(np.int32)}}
+        state = trainer.init(jax.random.PRNGKey(0),
+                             trainer.shard_batch(make_batch()))
+        hits = misses = 0
+        for i in range(warmup):
+            state, m = trainer.train_step(state, make_batch())
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        # fresh zipf batches every step: the long tail keeps missing, the
+        # hot head keeps hitting — the steady-state cache economics
+        for i in range(steps):
+            b = make_batch()
+            uniq = np.unique(b["sparse"]["uid"])
+            was_resident = int(table._resident[uniq].sum())
+            hits += was_resident
+            misses += uniq.size - was_resident
+            state, m = trainer.train_step(state, b)
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        pdir = tempfile.mkdtemp(prefix="bench_offpersist_")
+        try:
+            info = table.persist(state.emb["uid"], pdir)
+            persist_s = time.perf_counter() - t0
+            persist_rows = info["rows"]
+        finally:
+            shutil.rmtree(pdir, ignore_errors=True)
+        eps = steps * batch / dt
+        store_gb = (table.host_weights.nbytes + sum(
+            v.nbytes for v in table.host_slots.values())) / 1e9
+        return {
+            "metric": f"{name}_examples_per_sec_{platform}{n_dev}",
+            "value": round(eps, 1),
+            "unit": "examples/s",
+            "vs_baseline": round(eps / n_dev / REF_PER_CHIP, 3),
+            "per_chip": round(eps / n_dev, 1),
+            "step_ms": round(1000 * dt / steps, 3),
+            "host_store_gb": round(store_gb, 2),
+            "cache_rows": cache,
+            "cache_hit_rate": round(hits / max(hits + misses, 1), 4),
+            "alloc_s": round(alloc_s, 1),
+            "persist_s": round(persist_s, 2),
+            "persist_rows": persist_rows,
+            "config": dict(config),
+        }
+    finally:
+        shutil.rmtree(backing, ignore_errors=True)
+
+
+def run_hash_probe(name, config, *, steps, warmup):
+    """Hash pull path microbench: bucket-row XLA probe (default) vs the
+    fused Pallas probe+gather kernel vs the raw array row-gather roofline.
+    All three run K lookups inside one jitted loop (per-iteration query
+    batches derived on device) so the tunneled dispatch cost cancels."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from openembedding_tpu import EmbeddingVariableMeta, hash_table as hl
+    from openembedding_tpu import make_optimizer
+    from openembedding_tpu.ops import pallas_hash as ph
+
+    platform = jax.devices()[0].platform
+    cap, dim, B = config["capacity"], config["dim"], config["batch"]
+    K = config.get("loops", 20)
+    rng = np.random.RandomState(0)
+    n_ins = cap // 2
+    nk = jnp.asarray((rng.permutation(max(n_ins * 4, 1 << 20))[:n_ins])
+                     .astype(np.int32) + 1)
+    meta = EmbeddingVariableMeta(embedding_dim=dim, vocabulary_size=2**63)
+    opt = make_optimizer({"category": "default"})
+    table = hl.create_hash_table(meta, opt, capacity=cap)
+    ins = jax.jit(hl.find_or_insert)
+    tk = table.keys
+    for lo in range(0, n_ins, 1 << 18):
+        c = nk[lo:lo + (1 << 18)]
+        tk, _s, _i, _f = ins(tk, c, c != hl.empty_key(jnp.int32))
+    weights = jnp.asarray(rng.randn(cap, dim).astype(np.float32))
+    bsz, _nb, chain = hl.table_layout(cap, hl.DEFAULT_MAX_PROBES)
+    EMPTY = hl.empty_key(jnp.int32)
+
+    @functools.partial(jax.jit, static_argnames=("mode",))
+    def many(tk, weights, nk, seed, mode):
+        def body(i, acc):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+            q = jnp.take(nk, jax.random.randint(key, (B,), 0, n_ins), axis=0)
+            if mode == "pallas":
+                starts = hl.probe_starts(q, cap, hl.DEFAULT_MAX_PROBES)
+                rows, _hit = ph.probe_gather(
+                    tk, weights, starts, q, chain=chain, bucket=bsz,
+                    empty=EMPTY)
+            elif mode == "xla_probe":
+                slots = hl.find_rows(tk, q)
+                hit = slots >= 0
+                rows = jnp.take(weights, jnp.where(hit, slots, 0), axis=0,
+                                mode="clip")
+                rows = jnp.where(hit[:, None], rows, 0.0)
+            else:  # array_gather roofline
+                rows = jnp.take(weights, q % cap, axis=0, mode="clip")
+            return acc + rows.sum()
+        return lax.fori_loop(0, K, body, jnp.float32(0))
+
+    def timed(mode):
+        float(many(tk, weights, nk, 1, mode))        # compile + warm
+        t0 = time.perf_counter()
+        float(many(tk, weights, nk, 2, mode))
+        return (time.perf_counter() - t0) / K
+
+    out = {}
+    gb = B * dim * 4 / 1e9
+    modes = ["xla_probe", "array_gather"]
+    if dim % 128 == 0:
+        modes.append("pallas")
+    for mode in modes:
+        per = timed(mode)
+        out[f"{mode}_us"] = round(per * 1e6, 1)
+        out[f"{mode}_gbps"] = round(gb / per, 1)
+    return {
+        "metric": f"{name}_{platform}",
+        "value": out.get("xla_probe_us", 0.0),
+        "unit": "us/lookup_batch",
+        "vs_baseline": round(out.get("array_gather_us", 0.0)
+                             / max(out.get("xla_probe_us", 1e-9), 1e-9), 3),
+        **out,
+        "config": dict(config),
+    }
+
+
+def run_auc_criteo(name, config, *, steps, warmup):
+    """AUC on REAL Criteo rows (the reference's own example fixture) —
+    proves the data path + optimizer semantics end-to-end, not just on
+    synthetic zipf. Reference flow: test/benchmark/criteo_deepctr.py AUC.
+    Uses ``CRITEO_DATA`` when set (a preprocess-CLI sample); falls back to
+    the reference's checked-in 100-row train100.csv."""
+    import os
+    import jax
+    import optax
+    from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.data import criteo
+    from openembedding_tpu.fused import make_fused_specs
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.utils.observability import StreamingAUC
+
+    path = os.environ.get("CRITEO_DATA",
+                          "/root/reference/examples/train100.csv")
+    batch = config["batch"]
+    rows = list(criteo.read_criteo_csv(path, batch_size=batch))
+    features = tuple(criteo.SPARSE_NAMES)
+    specs, mapper = make_fused_specs(
+        features, -1, config["dim"],
+        optimizer={"category": "adagrad", "learning_rate": 0.05},
+        hash_capacity=1 << 18)
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    mesh = create_mesh(1, n_dev)
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", features), coll,
+                      optax.adagrad(0.05))
+    batches = [mapper.fuse_batch(b) for b in rows]
+    state = trainer.init(jax.random.PRNGKey(0),
+                         trainer.shard_batch(batches[0]))
+    n_seen = 0
+    t0 = time.perf_counter()
+    for epoch in range(config.get("epochs", 30)):
+        for b in batches:
+            state, m = trainer.train_step(state, b)
+            n_seen += int(np.asarray(b["label"]).shape[0])
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    # in-sample AUC over the fixture (the reference example reports
+    # training AUC the same way on this file)
+    auc = StreamingAUC()
+    for b in batches:
+        scores = trainer.eval_step(state, b)
+        auc.update(b["label"], np.asarray(scores))
+    a = float(auc.result())
+    return {
+        "metric": f"{name}_{platform}{n_dev}",
+        "value": round(a, 4),
+        "unit": "auc",
+        "vs_baseline": round(a / 0.5, 3),
+        "examples_per_sec": round(n_seen / dt, 1),
+        "rows": int(sum(np.asarray(b["label"]).shape[0] for b in batches)),
+        "data": path,
+        "config": dict(config),
+    }
+
+
+def run_ckpt_local(name, config, *, steps, warmup):
+    """Checkpoint throughput measured where the disk is: a CPU-backend
+    subprocess on THIS host writes/reads a local dump, so the tunneled
+    device->host link (≈10 MB/s, which made round-2's number meaningless)
+    is out of the loop. Substantiates the reference bar of 78 GB / 869 s =
+    0.09 GB/s (documents/en/benchmark.md:52-55)."""
+    import os
+    import subprocess
+    import sys as _sys
+    import tempfile
+    root = os.path.dirname(os.path.abspath(__file__))
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", {config.get("devices", 4)})
+import json, shutil, tempfile, time
+import numpy as np
+import sys
+sys.path.insert(0, {root!r})
+from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+from openembedding_tpu import checkpoint as ckpt
+from openembedding_tpu.parallel.mesh import create_mesh
+mesh = create_mesh(1, {config.get("devices", 4)})
+specs = (EmbeddingSpec(name="big", input_dim={config["vocab"]},
+                       output_dim={config["dim"]},
+                       optimizer={{"category": "adagrad",
+                                   "learning_rate": 0.01}}),)
+coll = EmbeddingCollection(specs, mesh)
+states = coll.init(jax.random.PRNGKey(0))
+nbytes = sum(x.nbytes for x in jax.tree.leaves(states))
+d = tempfile.mkdtemp(prefix="bench_ckpt_local_")
+try:
+    t0 = time.perf_counter()
+    ckpt.save_checkpoint(d, coll, states)
+    save_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loaded = ckpt.load_checkpoint(d, coll)
+    jax.block_until_ready(jax.tree.leaves(loaded))
+    load_s = time.perf_counter() - t0
+finally:
+    shutil.rmtree(d, ignore_errors=True)
+print(json.dumps({{"gb": nbytes / 1e9, "save_s": save_s,
+                   "load_s": load_s}}))
+"""
+    env = {**os.environ}
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([_sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=1200)
+    if out.returncode != 0:
+        raise RuntimeError(out.stdout[-500:] + out.stderr[-500:])
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    gbps = r["gb"] / max(r["save_s"], 1e-9)
+    return {
+        "metric": f"{name}_local_disk",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / REF_CKPT_GBPS, 2),
+        "ckpt_gb": round(r["gb"], 3),
+        "ckpt_save_s": round(r["save_s"], 2),
+        "ckpt_load_s": round(r["load_s"], 2),
+        "config": dict(config),
+    }
+
+
 # The matrix: the reference benchmarks WDL/DeepFM/xDeepFM at dims 9 and 64
 # over hashed Criteo ids (benchmark.md). "vocab" is PER FEATURE (26 features
 # -> total rows = 26 * vocab): bigvocab lands at 26 * 2^22 ~= 2^26.7 total
@@ -186,8 +542,25 @@ CONFIGS = {
                   "batch": 4096, "zipf": True},
     "xdeepfm_dim16": {"model": "xdeepfm", "dim": 16, "vocab": 1 << 20,
                       "batch": 2048, "zipf": True},
+    # north-star scale: 4x10^8-row host store (~29 GB incl. slot, >> the
+    # 16 GB HBM) on disk memmap, HBM cache 2^22 rows, zipf stream
+    "offload_bigvocab": {"kind": "offload", "dim": 8, "vocab": 400_000_000,
+                         "cache": 1 << 22, "batch": 4096, "zipf_a": 1.08},
+    # hash pull path: bucket-row XLA probe vs fused Pallas kernel vs the
+    # array row-gather roofline (dim 128 so the kernel's lane constraint
+    # holds); value = XLA probe us, vs_baseline = roofline ratio
+    "hash_probe_dim128": {"kind": "hash_probe", "capacity": 1 << 22,
+                          "dim": 128, "batch": 32768},
+    # AUC on real Criteo rows (reference fixture or $CRITEO_DATA)
+    "auc_criteo": {"kind": "auc", "dim": 9, "batch": 50, "epochs": 20},
+    # checkpoint IO measured on local disk via a CPU subprocess (the
+    # tunneled device->host link is not the thing being measured)
+    "ckpt_local_2gb": {"kind": "ckpt_local", "vocab": 1 << 25, "dim": 8,
+                       "devices": 4},
 }
 HEADLINE = "deepfm_dim9"
+RUNNERS = {"offload": run_offload, "hash_probe": run_hash_probe,
+           "auc": run_auc_criteo, "ckpt_local": run_ckpt_local}
 
 
 def main(argv=None):
@@ -215,7 +588,9 @@ def main(argv=None):
     results = []
     for name in names:
         try:
-            r = run_config(name, CONFIGS[name], steps=steps, warmup=warmup)
+            cfg = CONFIGS[name]
+            runner = RUNNERS.get(cfg.get("kind"), run_config)
+            r = runner(name, cfg, steps=steps, warmup=warmup)
         except Exception as e:  # noqa: BLE001 — a config too big for this
             # chip (OOM) must not kill the rest of the suite
             r = {"metric": name, "error": f"{type(e).__name__}: {e}"}

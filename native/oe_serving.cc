@@ -261,9 +261,18 @@ std::unique_ptr<NpyArray> open_npy(const std::string& path) {
   arr->itemsize = std::strtoul(arr->dtype.c_str() + 2, nullptr, 10);
   arr->data = reinterpret_cast<const char*>(b + header_off + header_len);
   // a truncated file (disk-full / killed writer) must fail the LOAD, not
-  // SIGSEGV the serving process at the first past-the-end lookup
+  // SIGSEGV the serving process at the first past-the-end lookup; the
+  // element count is computed with overflow-checked multiplication so a
+  // corrupt header with huge dims cannot wrap `need` past the check
   size_t need = arr->itemsize;
-  for (int64_t d : arr->shape) need *= static_cast<size_t>(d);
+  for (int64_t d : arr->shape) {
+    if (d < 0 ||
+        __builtin_mul_overflow(need, static_cast<size_t>(d), &need) ||
+        need > arr->map_size) {
+      set_error("corrupt .npy shape in " + path);
+      return nullptr;
+    }
+  }
   if (header_off + header_len + need > arr->map_size) {
     set_error("truncated .npy data in " + path);
     return nullptr;

@@ -49,6 +49,7 @@ from . import hash_table as hash_lib
 from . import table as table_lib
 from .parallel import sharded_hash as sh
 from .parallel import sharded_table as st
+from .utils import fs
 
 MODEL_META_FILE = "model_meta"
 DENSE_FILE = "dense_state.msgpack"
@@ -143,10 +144,17 @@ def save_checkpoint(path: str,
     ``model_<node>_<fileid>`` dump layout, EmbeddingDumpOperator.cpp:28) —
     ``path`` must be a shared filesystem. Rank 0 writes the meta; barriers
     bracket the writes.
+
+    ``path`` may be an fsspec URI (``gs://``, ``s3://``, ``hdfs://``,
+    ``memory://``): remote dumps always use the keyed part format, whose
+    writes are purely SEQUENTIAL streams — the reference's piped
+    hadoop shard files (EmbeddingShardFile.h:57-63). Local paths keep the
+    memmapped logical-order format.
     """
     nproc = jax.process_count()
     rank = jax.process_index()
-    os.makedirs(path, exist_ok=True)
+    remote = fs.is_remote(path)
+    fs.makedirs(path)
     meta = collection.model_meta(model_sign=model_sign, model_uri=path)
     meta.extra["include_optimizer"] = bool(include_optimizer)
     if nproc > 1:
@@ -163,28 +171,26 @@ def save_checkpoint(path: str,
     if hash_info:
         meta.extra["hash_variables"] = hash_info
     if rank == 0:
-        with open(os.path.join(path, MODEL_META_FILE), "w",
-                  encoding="utf-8") as f:
-            f.write(meta.dumps())
+        with fs.open_file(fs.join(path, MODEL_META_FILE), "wb") as f:
+            f.write(meta.dumps().encode("utf-8"))
         for name in collection.specs:
-            vdir = os.path.join(
+            vdir = fs.join(
                 path, _var_dir(collection.variable_id(name), name))
-            if os.path.isdir(vdir):
+            if fs.isdir(vdir):
                 # a previous save under a different optimizer could leave
                 # stale slot files a later load would mistake for state
-                import shutil
-                shutil.rmtree(vdir)
-            os.makedirs(vdir)
+                fs.rmtree(vdir)
+            fs.makedirs(vdir)
     _sync("ckpt_dirs_ready")
 
     for name, spec in collection.specs.items():
         state = states[name]
         vid = collection.variable_id(name)
-        vdir = os.path.join(path, _var_dir(vid, name))
-        part = f"part{rank}_" if nproc > 1 else ""
+        vdir = fs.join(path, _var_dir(vid, name))
+        part = f"part{rank}_" if (nproc > 1 or remote) else ""
         if spec.use_hash:
             _save_hash_var(vdir, state, include_optimizer, part=part)
-        elif nproc > 1:
+        elif nproc > 1 or remote:
             _save_array_var_part(vdir, rank, state,
                                  collection.sharding_spec(name),
                                  spec.input_dim, include_optimizer)
@@ -193,7 +199,7 @@ def save_checkpoint(path: str,
                             spec.input_dim, include_optimizer)
 
     if dense_state is not None and rank == 0:
-        with open(os.path.join(path, DENSE_FILE), "wb") as f:
+        with fs.open_file(fs.join(path, DENSE_FILE), "wb") as f:
             f.write(serialization.to_bytes(jax.device_get(dense_state)))
     _sync("ckpt_done")
 
@@ -227,10 +233,12 @@ def _save_array_var(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
 def _save_array_var_part(vdir: str, rank: int, state,
                          sspec: st.ShardingSpec, vocab: int,
                          include_optimizer: bool) -> None:
-    """Multi-host dump of one bounded variable: this process streams ITS
-    addressable shards into keyed part files ``part<rank>_{ids,weights,
-    slot_*}.npy`` (logical ids + rows) — the per-node dump files of the
-    reference, re-shardable onto any mesh at load."""
+    """Multi-host / remote dump of one bounded variable: this process
+    streams ITS addressable shards into keyed part files
+    ``part<rank>_{ids,weights,slot_*}.npy`` (logical ids + rows) — the
+    per-node dump files of the reference, re-shardable onto any mesh at
+    load. Writes are purely sequential (``fs.NpyWriter``), so the same
+    code path serves shared local filesystems and object stores."""
     targets = {"weights": state.weights}
     if include_optimizer:
         for sname, sval in state.slots.items():
@@ -244,27 +252,25 @@ def _save_array_var_part(vdir: str, rank: int, state,
         _, nv = _logical_slice(sspec, vocab, s.index[0].start or 0,
                                s.data.shape[0])
         nv_total += nv
-    ids_mm = np.lib.format.open_memmap(
-        os.path.join(vdir, f"part{rank}_ids.npy"), mode="w+",
-        dtype=np.int64, shape=(nv_total,))
-    for i, (fname, arr) in enumerate(targets.items()):
-        mm = np.lib.format.open_memmap(
-            os.path.join(vdir, f"part{rank}_{fname}.npy"), mode="w+",
-            dtype=np.dtype(arr.dtype), shape=(nv_total,) + arr.shape[1:])
-        off = 0
-        for phys_start, block in _iter_shard_blocks(arr):
-            sl, nv = _logical_slice(sspec, vocab, phys_start, block.shape[0])
-            if not nv:
-                continue
-            mm[off:off + nv] = block[:nv]
-            if i == 0:
-                ids_mm[off:off + nv] = np.arange(
-                    sl.start, sl.stop, sl.step or 1, dtype=np.int64)
-            off += nv
-        assert off == nv_total, (fname, off, nv_total)
-        mm.flush()
-        del mm
-    ids_mm.flush()
+    with fs.NpyWriter(fs.join(vdir, f"part{rank}_ids.npy"),
+                      np.int64, (nv_total,)) as ids_w:
+        for i, (fname, arr) in enumerate(targets.items()):
+            with fs.NpyWriter(
+                    fs.join(vdir, f"part{rank}_{fname}.npy"),
+                    np.dtype(arr.dtype),
+                    (nv_total,) + arr.shape[1:]) as w:
+                off = 0
+                for phys_start, block in _iter_shard_blocks(arr):
+                    sl, nv = _logical_slice(sspec, vocab, phys_start,
+                                            block.shape[0])
+                    if not nv:
+                        continue
+                    w.write(block[:nv])
+                    if i == 0:
+                        ids_w.write(np.arange(sl.start, sl.stop,
+                                              sl.step or 1, dtype=np.int64))
+                    off += nv
+                assert off == nv_total, (fname, off, nv_total)
 
 
 def _save_hash_var(vdir: str, state, include_optimizer: bool,
@@ -286,23 +292,23 @@ def _save_hash_var(vdir: str, state, include_optimizer: bool,
     if include_optimizer:
         for sname, sval in state.slots.items():
             targets[f"slot_{sname}"] = sval
-    mms = {
-        fname: np.lib.format.open_memmap(
-            os.path.join(vdir, part + fname + ".npy"), mode="w+",
-            dtype=np.dtype(arr.dtype), shape=(total,) + arr.shape[1:])
-        for fname, arr in targets.items()
-    }
-    offset = 0
-    for blocks in _aligned_shard_blocks(targets):
-        live = blocks["keys"] != empty
-        n = int(live.sum())
-        if n:
-            for fname, block in blocks.items():
-                mms[fname][offset:offset + n] = block[live]
-        offset += n
-    assert offset == total, (offset, total)
-    for mm in mms.values():
-        mm.flush()
+    from contextlib import ExitStack
+    with ExitStack() as stack:
+        writers = {
+            fname: stack.enter_context(
+                fs.NpyWriter(fs.join(vdir, part + fname + ".npy"),
+                             np.dtype(arr.dtype), (total,) + arr.shape[1:]))
+            for fname, arr in targets.items()
+        }
+        offset = 0
+        for blocks in _aligned_shard_blocks(targets):
+            live = blocks["keys"] != empty
+            n = int(live.sum())
+            if n:
+                for fname, block in blocks.items():
+                    writers[fname].write(block[live])
+            offset += n
+        assert offset == total, (offset, total)
 
 
 def _aligned_shard_blocks(arrays: Dict[str, Any]):
@@ -330,36 +336,82 @@ def _aligned_shard_blocks(arrays: Dict[str, Any]):
 class _NpyDirReader:
     """dict-like lazy reader over a ``var_*.d`` directory of .npy files.
 
-    Files are opened memmapped so the loader streams from disk instead of
-    materializing whole tables host-side; the same mapping interface as a
-    legacy ``np.load`` npz handle, so one loader serves both formats.
+    Local directories open files memmapped (``__getitem__`` random access —
+    the fast strided-slice load path); remote URIs expose only sequential
+    ``rows``/``chunks`` streaming — the access pattern object stores (and
+    the reference's piped hadoop reads, EmbeddingShardFile.h:57-63) are
+    built for. One class, fs-dispatched, so the part-file format can never
+    drift between local and remote loads.
     """
 
     def __init__(self, vdir: str, prefix: str = ""):
         self._vdir = vdir
         self._prefix = prefix
-        self._names = {f[len(prefix):-4] for f in os.listdir(vdir)
+        self._remote = fs.is_remote(vdir)
+        self._names = {f[len(prefix):-4] for f in fs.listdir(vdir)
                        if f.endswith(".npy") and f.startswith(prefix)
                        and (prefix or not f.startswith("part"))}
 
     def __contains__(self, name: str) -> bool:
         return name in self._names
 
-    def __getitem__(self, name: str):
+    def _path(self, name: str) -> str:
         if name not in self._names:
             raise KeyError(name)
-        return np.load(os.path.join(self._vdir, self._prefix + name + ".npy"),
-                       mmap_mode="r")
+        return fs.join(self._vdir, self._prefix + name + ".npy")
+
+    def __getitem__(self, name: str):
+        if self._remote:
+            raise TypeError("remote readers stream; use rows()/chunks()")
+        return np.load(self._path(name), mmap_mode="r")
+
+    def rows(self, name: str) -> int:
+        if self._remote:
+            return fs.npy_shape(self._path(name))[1][0]
+        return self[name].shape[0]
+
+    def chunks(self, name: str, size: int):
+        if self._remote:
+            return fs.iter_npy_chunks(self._path(name), size)
+        arr = self[name]
+        return (np.asarray(arr[lo:lo + size])
+                for lo in range(0, arr.shape[0], size))
+
+
+def _aligned_reader_chunks(reader, names, size: int):
+    """Yield dicts of row-aligned chunks for several fields of one reader.
+
+    Readers with ``.chunks`` stream (memmap or remote); legacy npz handles
+    are sliced in place.
+    """
+    if hasattr(reader, "chunks"):
+        iters = {n: iter(reader.chunks(n, size)) for n in names}
+        while True:
+            out = {}
+            for n in names:
+                try:
+                    out[n] = next(iters[n])
+                except StopIteration:
+                    assert not out, f"field {n} shorter than {names[0]}"
+                    return
+            yield out
+    else:
+        # legacy npz: materialize each member ONCE (NpzFile.__getitem__
+        # decompresses the whole member on every access)
+        arrs = {m: reader[m] for m in names}
+        n_rows = arrs[names[0]].shape[0]
+        for lo in range(0, n_rows, size):
+            yield {m: np.asarray(a[lo:lo + size]) for m, a in arrs.items()}
 
 
 def _open_var(path: str, vid: int, name: str):
     """Readers for one variable: a list with one dict-like entry per dump
     part (multi-host dumps have one per writing process; single-host and
     legacy npz dumps have exactly one)."""
-    vdir = os.path.join(path, _var_dir(vid, name))
-    if os.path.isdir(vdir):
+    vdir = fs.join(path, _var_dir(vid, name))
+    if fs.isdir(vdir):
         prefixes = sorted({f.split("_", 1)[0] + "_"
-                           for f in os.listdir(vdir)
+                           for f in fs.listdir(vdir)
                            if f.startswith("part")})
         if prefixes:
             return [_NpyDirReader(vdir, p) for p in prefixes]
@@ -437,10 +489,72 @@ def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
     return table_lib.TableState(weights=weights, slots=new_slots)
 
 
+def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
+                           mesh, with_opt: bool):
+    """Streamed (remote) twin of ``_load_array_var``: blank sharded arrays
+    + sequential keyed chunk delivery (``deliver_rows_sharded``), so a
+    gs://-scale table loads with bounded host memory and purely sequential
+    reads — the reference's piped hadoop load
+    (EmbeddingLoadOperator.cpp:58-111)."""
+    vocab = spec.input_dim
+    dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
+    dim = spec.output_dim
+    weights = st.filled_sharded(mesh, sspec, (dim,), 0.0, dtype)
+    slots = {}
+    slot_dtypes = {}
+    for sname, sshape in optimizer.slot_shapes(dim).items():
+        sdtype = np.dtype(optimizer.slot_dtype(sname, dtype))
+        slot_dtypes[sname] = sdtype
+        slots[sname] = st.filled_sharded(mesh, sspec, tuple(sshape),
+                                         optimizer.slot_init(sname), sdtype)
+    for r in readers:
+        keyed = "ids" in r
+        names = (["ids"] if keyed else []) + ["weights"] + [
+            f"slot_{s}" for s in slots
+            if with_opt and f"slot_{s}" in r]
+        size = min(_LOAD_CHUNK, max(r.rows("ids" if keyed else "weights"),
+                                    1))
+        offset = 0
+        for chunk in _aligned_reader_chunks(r, names, size):
+            if keyed:
+                ids = chunk["ids"].astype(np.int64)
+            else:
+                # logical-order dump (no ids file): row i IS logical id i,
+                # so a local-format dump copied to object storage streams
+                # back with synthesized ids
+                got = chunk["weights"].shape[0]
+                ids = np.arange(offset, offset + got, dtype=np.int64)
+                offset += got
+            shard, local = sspec.shard_and_local(ids)
+            phys = np.where(ids < vocab,
+                            shard * sspec.rows_per_shard + local, -1)
+            n = phys.shape[0]
+            phys_p = np.full((size,), -1, np.int64)
+            phys_p[:n] = phys
+            jphys = jnp.asarray(phys_p)
+
+            def pad_rows(rows):
+                out = np.zeros((size,) + rows.shape[1:], rows.dtype)
+                out[:n] = rows
+                return jnp.asarray(out)
+
+            weights = st.deliver_rows_sharded(
+                weights, jphys, pad_rows(fs.view_as(chunk["weights"],
+                                                    dtype)),
+                mesh=mesh, spec=sspec)
+            for sname in slots:
+                f = f"slot_{sname}"
+                if f in chunk:
+                    slots[sname] = st.deliver_rows_sharded(
+                        slots[sname], jphys,
+                        pad_rows(fs.view_as(chunk[f], slot_dtypes[sname])),
+                        mesh=mesh, spec=sspec)
+    return table_lib.TableState(weights=weights, slots=slots)
+
+
 def _check_meta(path: str, collection: EmbeddingCollection) -> ModelMeta:
-    with open(os.path.join(path, MODEL_META_FILE),
-              encoding="utf-8") as f:
-        meta = ModelMeta.loads(f.read())
+    with fs.open_file(fs.join(path, MODEL_META_FILE), "rb") as f:
+        meta = ModelMeta.loads(f.read().decode("utf-8"))
     want = collection.model_meta()
     got_vars = {v.name: v for v in meta.variables}
     for v in want.variables:
@@ -493,42 +607,53 @@ def load_checkpoint(path: str,
                     f"{spec.hash_capacity}); increase hash_capacity — a "
                     "load must deliver every row or fail")
             out[name] = state
+        elif fs.is_remote(path):
+            out[name] = _load_array_var_stream(
+                data, spec, sspec, optimizer, collection.mesh, with_opt)
         else:
             out[name] = _load_array_var(
                 data, spec, sspec, optimizer,
                 collection.state_shardings()[name], with_opt)
     if dense_state_template is not None:
-        with open(os.path.join(path, DENSE_FILE), "rb") as f:
+        with fs.open_file(fs.join(path, DENSE_FILE), "rb") as f:
             dense = serialization.from_bytes(dense_state_template, f.read())
         return out, dense
     return out
 
 
 def _insert_hash_rows(state, data, collection, sspec, with_opt):
-    """Stream one reader's (keys, weights, states) rows into the table."""
-    keys = data["keys"]
-    weights = data["weights"]
+    """Stream one reader's (keys, weights, states) rows into the table.
+
+    Consumes row-aligned chunks so the same code path serves memmapped
+    local dumps, legacy npz handles, and remote sequential streams.
+    """
     # slots present in both the checkpoint and the current optimizer are
     # restored; others keep their fresh init — loading into a different
     # optimizer category keeps weights and re-initializes slots, the
     # reference's copy_from hot-swap semantics (EmbeddingVariable.cpp:29-60)
-    slot_data = ({s: data[f"slot_{s}"] for s in state.slots
-                  if f"slot_{s}" in data}
-                 if with_opt else {})
+    names = ["keys", "weights"] + ([f"slot_{s}" for s in state.slots
+                                    if f"slot_{s}" in data]
+                                   if with_opt else [])
     # stream fixed-size chunks (padded with EMPTY) to keep shapes static
     empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
-    n = keys.shape[0]
-    for lo in range(0, max(n, 1), _LOAD_CHUNK):
-        hi = min(lo + _LOAD_CHUNK, n)
-        size = min(_LOAD_CHUNK, max(n, 1))
-        ck = np.full((size,), empty, dtype=keys.dtype)
-        cw = np.zeros((size,) + weights.shape[1:], weights.dtype)
-        ck[:hi - lo] = keys[lo:hi]
-        cw[:hi - lo] = weights[lo:hi]
+    n = data.rows("keys") if hasattr(data, "rows") \
+        else data["keys"].shape[0]
+    size = min(_LOAD_CHUNK, max(n, 1))
+    for chunk in _aligned_reader_chunks(data, names, size):
+        got = chunk["keys"].shape[0]
+        # keys keep the FILE dtype: insert_rows' check_key_dtype must see a
+        # wider dump dtype and refuse truncation, not a silent astype
+        ck = np.full((size,), empty, dtype=chunk["keys"].dtype)
+        ck[:got] = chunk["keys"]
+        wdtype = np.dtype(state.weights.dtype)
+        cw = np.zeros((size,) + chunk["weights"].shape[1:], wdtype)
+        cw[:got] = fs.view_as(chunk["weights"], wdtype)
         srows = {}
-        for sname, full in slot_data.items():
-            cs = np.zeros((size,) + full.shape[1:], full.dtype)
-            cs[:hi - lo] = full[lo:hi]
+        for fname in names[2:]:
+            sname = fname[len("slot_"):]
+            sdtype = np.dtype(state.slots[sname].dtype)
+            cs = np.zeros((size,) + chunk[fname].shape[1:], sdtype)
+            cs[:got] = fs.view_as(chunk[fname], sdtype)
             srows[sname] = jnp.asarray(cs)
         state = sh.insert_rows_sharded(
             state, jnp.asarray(ck), jnp.asarray(cw), srows,

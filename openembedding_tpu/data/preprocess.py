@@ -71,35 +71,43 @@ def preprocess(in_path: str, out_path: str, *, repeat: int = 1,
                     lo[j] = min(lo[j], fv)
                     hi[j] = max(hi[j], fv)
 
-    rows = []  # buffered only when repetition needs a second walk
     n = 0
     header = "label," + ",".join(criteo.DENSE_NAMES) + "," + ",".join(
         criteo.SPARSE_NAMES)
     out = _open_out(out_path)
     try:
         out.write(header + "\n")
-        with open(in_path) as f:
-            for i, line in enumerate(f):
-                if limit and i >= limit:
-                    break
-                label, dense, cats = parse(line)
-                if minmax:
-                    scaled = [
-                        (v - lo[j]) / (hi[j] - lo[j])
-                        if hi[j] > lo[j] else 0.0
-                        for j, v in enumerate(dense)]
-                else:
-                    scaled = [math.log1p(max(v, 0.0)) for v in dense]
-                row = (label + ","
-                       + ",".join(f"{v:.6g}" for v in scaled) + ","
-                       + ",".join(str(c) for c in cats))
-                out.write(row + "\n")
-                if repeat > 1:
-                    rows.append(row)
-                n += 1
-        for _ in range(repeat - 1):
-            for row in rows:
-                out.write(row + "\n")
+        # repetition re-walks the input instead of buffering rows: repeating
+        # a Criteo-scale file must stay O(1) in host memory (the tool's
+        # whole reason to exist is streaming through files >> RAM)
+        for rep in range(repeat):
+            rows_this_rep = 0
+            with open(in_path) as f:
+                for i, line in enumerate(f):
+                    if limit and i >= limit:
+                        break
+                    label, dense, cats = parse(line)
+                    if minmax:
+                        scaled = [
+                            (v - lo[j]) / (hi[j] - lo[j])
+                            if hi[j] > lo[j] else 0.0
+                            for j, v in enumerate(dense)]
+                    else:
+                        scaled = [math.log1p(max(v, 0.0)) for v in dense]
+                    row = (label + ","
+                           + ",".join(f"{v:.6g}" for v in scaled) + ","
+                           + ",".join(str(c) for c in cats))
+                    out.write(row + "\n")
+                    rows_this_rep += 1
+            if rep == 0:
+                n = rows_this_rep
+            elif rows_this_rep != n:
+                # a pipe / process substitution drains on the first walk —
+                # fail loudly instead of silently writing fewer copies
+                raise IOError(
+                    f"--repeat re-reads the input, but pass {rep + 1} saw "
+                    f"{rows_this_rep} rows vs {n} on the first pass; input "
+                    "must be a re-readable regular file (not a pipe)")
     finally:
         if out is not sys.stdout:
             out.close()

@@ -33,7 +33,6 @@ Protocol mapping:
 
 from __future__ import annotations
 
-import json
 import os
 import threading
 from typing import Any, Dict, Optional
@@ -46,10 +45,12 @@ from .embedding import EmbeddingSpec
 from .meta import EmbeddingVariableMeta
 from .optim.initializers import make_initializer
 from .optim.optimizers import make_optimizer
+from .utils import fs
 from . import hash_table as hash_lib
 from . import table as table_lib
 
 OFFLOAD_META_FILE = "offload_meta"
+COMPACT_CHAIN_LEN = 8   # rebase the incremental chain past this many entries
 
 
 def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
@@ -61,33 +62,45 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
 
     First call writes a base file with every row; later calls write only
     rows whose watermark moved past ``persisted_work`` — the reference's
-    incremental-commit protocol (PmemEmbeddingTable.h:297-328).
+    incremental-commit protocol (PmemEmbeddingTable.h:297-328). Like the
+    reference's periodic rebase, the chain is COMPACTED once it exceeds
+    ``COMPACT_CHAIN_LEN`` entries: a fresh base replaces the whole chain and
+    superseded files are deleted, bounding file count, meta size, and
+    restore replay time over arbitrarily long runs.
     """
-    os.makedirs(path, exist_ok=True)
-    meta_path = os.path.join(path, OFFLOAD_META_FILE)
+    fs.makedirs(path)
+    meta_path = fs.join(path, OFFLOAD_META_FILE)
     chain = []
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            chain = json.load(f)["checkpoints"]
+    if fs.exists(meta_path):
+        chain = fs.read_json(meta_path)["checkpoints"]
+    if len(chain) >= COMPACT_CHAIN_LEN:
+        stale = [e["file"] for e in chain]
+        chain = []
+    else:
+        stale = []
     if not chain:
         fname = f"base_{work_id}.npz"
-        np.savez(os.path.join(path, fname),
-                 ids=np.arange(vocab, dtype=np.int64),
-                 weights=host_weights, work_id=host_work_id,
-                 **{f"slot_{k}": v for k, v in host_slots.items()})
+        with fs.open_file(fs.join(path, fname), "wb") as f:
+            np.savez(f, ids=np.arange(vocab, dtype=np.int64),
+                     weights=host_weights, work_id=host_work_id,
+                     **{f"slot_{k}": v for k, v in host_slots.items()})
         changed = vocab
     else:
         ids = np.nonzero(host_work_id > persisted_work)[0].astype(np.int64)
         fname = f"inc_{work_id}.npz"
-        np.savez(os.path.join(path, fname),
-                 ids=ids, weights=host_weights[ids],
-                 work_id=host_work_id[ids],
-                 **{f"slot_{k}": v[ids] for k, v in host_slots.items()})
+        with fs.open_file(fs.join(path, fname), "wb") as f:
+            np.savez(f, ids=ids, weights=host_weights[ids],
+                     work_id=host_work_id[ids],
+                     **{f"slot_{k}": v[ids] for k, v in host_slots.items()})
         changed = int(ids.size)
     chain.append({"file": fname, "work_id": work_id})
-    with open(meta_path, "w") as f:
-        json.dump({"checkpoints": chain, "vocab": vocab,
-                   "meta": meta.to_json()}, f)
+    fs.write_json(meta_path, {"checkpoints": chain, "vocab": vocab,
+                              "meta": meta.to_json()})
+    for old in stale:
+        try:
+            fs.remove(fs.join(path, old))
+        except OSError:
+            pass
     return {"file": fname, "rows": changed}
 
 
@@ -96,14 +109,13 @@ def _replay_store(path: str, *, vocab: int, host_weights: np.ndarray,
                   host_work_id: np.ndarray) -> int:
     """Shared restore: replay base + increments (newest wins by order).
     Returns the highest persisted work id."""
-    with open(os.path.join(path, OFFLOAD_META_FILE)) as f:
-        meta = json.load(f)
+    meta = fs.read_json(fs.join(path, OFFLOAD_META_FILE))
     if int(meta["vocab"]) != vocab:
         raise ValueError(f"offload checkpoint vocab {meta['vocab']} != "
                          f"table vocab {vocab}")
     max_work = 0
     for entry in meta["checkpoints"]:
-        data = np.load(os.path.join(path, entry["file"]))
+        data = np.load(fs.open_file(fs.join(path, entry["file"]), "rb"))
         ids = data["ids"]
         host_weights[ids] = data["weights"]
         for sname in host_slots:

@@ -31,11 +31,19 @@ O(global_batch * dim) — the gap to the reference's per-owner exchange closed.
 Static shapes: the per-destination bucket capacity must be fixed at trace
 time. Keys are uniform across owners by construction ("mod" layout spreads
 sequential ids; hash keys are avalanche-mixed), so the default capacity
-``max(32, 2 * mean_bucket)`` overflows with vanishing probability; overflowed
-entries are dropped (zero rows on pull, skipped updates on push) — measure
-with :func:`routing_overflow` (the reference ships the same measurement
-methodology, laboratory/benchmark/analyze.py) and raise
-``a2a_capacity``/``a2a_slack`` if your key distribution defeats the layout.
+``max(32, 2 * mean_bucket)`` fits everything in the first round with
+overwhelming probability. The exchange is nevertheless EXACT for any key
+distribution — like the reference's variable-size RPC exchange
+(EmbeddingPullOperator.cpp:60-112): entries past a bucket's capacity stay
+pending and a residue loop (``lax.while_loop``) re-routes them in further
+fixed-capacity rounds until a globally psum'd pending count reaches zero.
+Adversarial skew (e.g. every id congruent modulo the shard count) costs
+extra rounds, never correctness. :func:`routing_overflow` remains as a
+sizing diagnostic — it now predicts *extra rounds*, not data loss — and the
+gated ``a2a_extra_entries_*`` accumulators count residue-routed entries
+(the reference ships the same measurement methodology,
+laboratory/benchmark/analyze.py). Raise ``a2a_capacity``/``a2a_slack`` if
+your key distribution routinely needs more than one round.
 """
 
 from __future__ import annotations
@@ -51,9 +59,9 @@ from ..ops import dedup
 from ..utils import observability
 
 
-def _record_drops(counter: str, local_dropped: jnp.ndarray,
-                  record: bool) -> None:
-    """Gated host accumulation of routed-exchange drops.
+def _record_stat(counter: str, local_value: jnp.ndarray,
+                 record: bool) -> None:
+    """Gated host accumulation of routed-exchange statistics.
 
     ``record`` is the trace-time gate (callers thread
     ``observability.evaluate_performance()`` through their program-cache key
@@ -68,7 +76,7 @@ def _record_drops(counter: str, local_dropped: jnp.ndarray,
         def _cb(d):
             if observability.evaluate_performance():
                 observability.GLOBAL.add(counter, int(d))
-        jax.debug.callback(_cb, local_dropped)
+        jax.debug.callback(_cb, local_value)
 
 
 def linear_shard_id(axes: Sequence[str], sizes: Sequence[int]) -> jnp.ndarray:
@@ -88,13 +96,15 @@ def bucket_capacity(slice_size: int, num_shards: int,
     """Per-destination bucket size: explicit, or mean*slack with a floor.
 
     Slices of <= 32 entries (tests, serving probes) get ``capacity ==
-    slice_size`` and are exact regardless of key skew. Larger slices rely on
-    owner uniformity: binomial concentration makes ``2 * mean`` overflow-free
-    for uniform owners (hashed keys, or sequential ids under the "mod"
-    layout), but *structured* skew — e.g. ids all congruent modulo the shard
-    count — can overflow. Monitor with :func:`routing_overflow` or the gated
-    ``a2a_dropped_*`` accumulators, and raise ``a2a_capacity``/``a2a_slack``
-    (up to ``slice_size`` = always exact) if your keys defeat the layout.
+    slice_size`` and finish in one round regardless of key skew. Larger
+    slices rely on owner uniformity: binomial concentration makes ``2 *
+    mean`` single-round for uniform owners (hashed keys, or sequential ids
+    under the "mod" layout). *Structured* skew — e.g. ids all congruent
+    modulo the shard count — overflows the first round, which only costs
+    extra residue rounds (the exchange is exact either way). Monitor with
+    :func:`routing_overflow` or the gated ``a2a_extra_entries_*``
+    accumulators, and raise ``a2a_capacity``/``a2a_slack`` (up to
+    ``slice_size`` = always one round) if your keys defeat the layout.
     """
     if capacity:
         return min(capacity, slice_size)
@@ -110,11 +120,12 @@ def bucketize(owner: jnp.ndarray, num_shards: int, capacity: int
 
     ``owner`` is [m] with values in [0, num_shards) or >= num_shards for
     entries that must not be sent. Returns ``(dest [m], ok [m])``: ``dest``
-    is the flat slot (== num_shards * capacity, i.e. out of range, when
-    dropped), ``ok`` marks entries that made it into a bucket. Equivalent of
-    the reference's per-shard request assembly (EmbeddingPullOperator.cpp:
-    73-112) under XLA's static shapes: stable sort by owner, rank within
-    group, drop past-capacity ranks.
+    is the flat slot (== num_shards * capacity, i.e. out of range, when not
+    sent this round), ``ok`` marks entries that made it into a bucket.
+    Equivalent of the reference's per-shard request assembly
+    (EmbeddingPullOperator.cpp:73-112) under XLA's static shapes: stable
+    sort by owner, rank within group; past-capacity ranks stay pending for
+    the caller's residue loop.
     """
     m = owner.shape[0]
     owner = owner.astype(jnp.int32)
@@ -212,14 +223,20 @@ def exchange_pull(flat_idx: jnp.ndarray,
                   split_sizes: Sequence[int],
                   capacity: int = 0,
                   slack: float = 2.0,
-                  record_drops: bool = False) -> jnp.ndarray:
-    """Owner-routed lookup of ``flat_idx`` [n] -> rows [n, dim].
+                  record_stats: bool = False) -> jnp.ndarray:
+    """Owner-routed lookup of ``flat_idx`` [n] -> rows [n, dim]. EXACT.
 
     ``flat_idx`` must be identical on all ``split_axes`` peers (they divide
     the work); ``resolve_fn(keys [K]) -> [K, dim]`` runs on the owner and
     must return zero rows for keys it does not own (sentinel included).
     ``owner_fn(keys)`` maps keys to shard ordinals (>= num_shards = do not
     send). The result is replicated over ``split_axes`` again (all_gather).
+
+    Round 1 routes everything that fits the fixed-capacity buckets; any
+    residue (structured key skew) loops through further rounds until the
+    globally psum'd pending count is zero, so no key distribution can drop
+    entries — parity with the reference's variable-size exchange
+    (EmbeddingPullOperator.cpp:60-112).
     """
     my_part = linear_shard_id(split_axes, split_sizes)
     n = flat_idx.shape[0]
@@ -227,18 +244,37 @@ def exchange_pull(flat_idx: jnp.ndarray,
     uniq, inverse, _valid = dedup.unique_indices(sl, m, fill_value=sentinel)
     cap = bucket_capacity(m, num_shards, capacity, slack)
     owners = owner_fn(uniq)
-    dest, ok = bucketize(owners, num_shards, cap)
-    _record_drops("a2a_dropped_pull",
-                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32),
-                  record_drops)
-    send = fill_buckets(uniq, dest, num_shards, cap, sentinel)
-    req = grid_all_to_all(send, grid_axes, grid_sizes)
-    rows = resolve_fn(req.ravel())
-    resp = grid_all_to_all(rows.reshape((num_shards, cap, dim)),
-                           grid_axes, grid_sizes)
-    flat_resp = resp.reshape((num_shards * cap, dim))
-    uniq_rows = jnp.take(flat_resp, jnp.where(ok, dest, 0), axis=0)
-    uniq_rows = jnp.where(ok[:, None], uniq_rows, jnp.zeros_like(uniq_rows))
+
+    def one_round(pending, acc):
+        dest, ok = bucketize(pending, num_shards, cap)
+        send = fill_buckets(uniq, dest, num_shards, cap, sentinel)
+        req = grid_all_to_all(send, grid_axes, grid_sizes)
+        rows = resolve_fn(req.ravel())
+        resp = grid_all_to_all(rows.reshape((num_shards, cap, dim)),
+                               grid_axes, grid_sizes)
+        flat_resp = resp.reshape((num_shards * cap, dim))
+        got = jnp.take(flat_resp, jnp.where(ok, dest, 0), axis=0)
+        acc = acc + jnp.where(ok[:, None], got, jnp.zeros_like(got))
+        pending = jnp.where(ok, jnp.int32(num_shards), pending)
+        left = lax.psum(jnp.sum(pending < num_shards).astype(jnp.int32),
+                        tuple(grid_axes))
+        return pending, acc, left
+
+    pending0 = owners.astype(jnp.int32)
+    acc0 = jnp.zeros((m, dim),
+                     dtype=jax.eval_shape(resolve_fn, uniq).dtype)
+    pending, uniq_rows, left = one_round(pending0, acc0)
+    # record the per-device residue: the callback fires on every device
+    # shard, so the host accumulator sums locals into the global total
+    _record_stat("a2a_extra_entries_pull",
+                 jnp.sum(pending < num_shards).astype(jnp.int32),
+                 record_stats)
+    if cap < m:
+        # residue loop: only reachable when round 1 could overflow
+        pending, uniq_rows, _ = lax.while_loop(
+            lambda c: c[2] > 0,
+            lambda c: one_round(c[0], c[1]),
+            (pending, uniq_rows, left))
     slice_rows = jnp.take(uniq_rows, inverse, axis=0)
     out = lax.all_gather(slice_rows, tuple(split_axes), tiled=True)
     return out[:n]
@@ -246,8 +282,8 @@ def exchange_pull(flat_idx: jnp.ndarray,
 
 def exchange_push(flat_idx: jnp.ndarray,
                   grads: jnp.ndarray,
-                  apply_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray],
-                                     None],
+                  state,
+                  apply_fn: Callable,
                   owner_fn: Callable[[jnp.ndarray], jnp.ndarray],
                   *,
                   sentinel,
@@ -258,17 +294,37 @@ def exchange_push(flat_idx: jnp.ndarray,
                   split_sizes: Sequence[int],
                   capacity: int = 0,
                   slack: float = 2.0,
-                  record_drops: bool = False):
+                  record_stats: bool = False):
     """Owner-routed push: pre-reduce, route (key, grad sum, count) to owners.
+    EXACT for any key distribution.
 
-    ``apply_fn(keys [K], grads [K, dim], counts [K])`` runs on the owner with
-    the merged per-peer pre-reduces and returns its updated local state
-    (whatever pytree it likes). Entries with a sentinel key are padding and
-    must be ignored by ``apply_fn`` (both built-in appliers drop them via the
-    invalid-key contract; their count values are garbage by design).
+    ``apply_fn(state, keys [K], grads [K, dim], counts [K]) -> state`` runs
+    on the owner with the merged per-peer pre-reduces and returns the updated
+    local state (a pytree with stable structure/shapes/dtypes — it is
+    threaded through ``lax.cond``). Entries with a sentinel key are padding
+    and must be ignored by ``apply_fn`` (both built-in appliers drop them via
+    the invalid-key contract; their count values are garbage by design).
 
-    Keys and counts share one integer exchange buffer ([.., 2] channels) so
-    a push costs two collectives per mesh axis, not three.
+    Unlike the pull (idempotent reads, residue rounds compose), a push must
+    apply each key's optimizer update EXACTLY ONCE per step with all peer
+    contributions merged — splitting a key across two apply calls is wrong
+    for nonlinear optimizers (adam's moments would see two half-batches).
+    So overflow is detected globally *before* anything is applied, and the
+    program conditions on it:
+
+    * no overflow (the overwhelmingly common case — capacity heuristics are
+      sized for it): one routed fixed-capacity exchange, owner merges the
+      per-peer (sum, count) pre-reduces via ``in_counts``;
+    * overflow (structured key skew): fall back to an all_gather of every
+      peer's pre-reduced slice over the grid — the psum-plane push, paid
+      only when the routed plane can't hold the batch — so the owner still
+      sees each key exactly once with all contributions.
+
+    Both branches are exact; the reference gets the same guarantee from
+    variable-size RPCs + server-side MpscGradientReducer
+    (EmbeddingPushOperator.cpp:29-104). Keys and counts share one integer
+    exchange buffer ([.., 2] channels) so a routed push costs two
+    collectives per mesh axis, not three.
     """
     dim = grads.shape[-1]
     my_part = linear_shard_id(split_axes, split_sizes)
@@ -280,28 +336,45 @@ def exchange_push(flat_idx: jnp.ndarray,
     cap = bucket_capacity(m, num_shards, capacity, slack)
     owners = owner_fn(uniq)
     dest, ok = bucketize(owners, num_shards, cap)
-    _record_drops("a2a_dropped_push",
-                  jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32),
-                  record_drops)
-    kc = jnp.stack([uniq, counts.astype(uniq.dtype)], axis=1)  # [m, 2]
-    send_kc = fill_buckets(kc, dest, num_shards, cap, sentinel)
-    send_g = fill_buckets(summed, dest, num_shards, cap, 0)
-    rkc = grid_all_to_all(send_kc, grid_axes, grid_sizes)
-    rg = grid_all_to_all(send_g, grid_axes, grid_sizes)
-    k = rkc[..., 0].ravel()
-    rc = rkc[..., 1].ravel().astype(jnp.int32)
-    return apply_fn(k, rg.reshape((k.shape[0], dim)), rc)
+
+    def routed(st):
+        kc = jnp.stack([uniq, counts.astype(uniq.dtype)], axis=1)  # [m, 2]
+        send_kc = fill_buckets(kc, dest, num_shards, cap, sentinel)
+        send_g = fill_buckets(summed, dest, num_shards, cap, 0)
+        rkc = grid_all_to_all(send_kc, grid_axes, grid_sizes)
+        rg = grid_all_to_all(send_g, grid_axes, grid_sizes)
+        k = rkc[..., 0].ravel()
+        rc = rkc[..., 1].ravel().astype(jnp.int32)
+        return apply_fn(st, k, rg.reshape((k.shape[0], dim)), rc)
+
+    def gathered(st):
+        ga = tuple(grid_axes)
+        k = lax.all_gather(uniq, ga, tiled=True)
+        g = lax.all_gather(summed, ga, tiled=True)
+        c = lax.all_gather(counts, ga, tiled=True)
+        return apply_fn(st, k, g, c)
+
+    if cap >= m:
+        # buckets can hold the whole slice: bucketize cannot overflow
+        return routed(state)
+    local_spill = jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32)
+    spilled = lax.psum(local_spill, tuple(grid_axes))
+    # per-device residue: the callback fires on every device shard, so the
+    # host accumulator sums locals into the global total
+    _record_stat("a2a_extra_entries_push", local_spill, record_stats)
+    return lax.cond(spilled == 0, routed, gathered, state)
 
 
 def routing_overflow(indices, num_shards: int, slice_parts: int,
                      owner_of, capacity: int = 0, slack: float = 2.0) -> int:
-    """Host-side diagnostic: how many batch entries would the a2a plane drop?
+    """Host-side diagnostic: how many uniques spill past round 1's buckets?
 
     Sizes the bucket capacity for a sample batch the way the exchange does
     (dedup per slice, bucket by owner) and counts past-capacity uniques —
     the reference measures batch key-overlap the same way before sizing its
-    dedup structures (laboratory/benchmark/analyze.py). 0 means the default
-    capacity is exact for this batch shape + key distribution.
+    dedup structures (laboratory/benchmark/analyze.py). 0 means the exchange
+    finishes in one round for this batch shape + key distribution; a nonzero
+    count is re-routed by the residue loop (extra rounds, never data loss).
     """
     import numpy as np
     flat = np.asarray(indices).ravel()

@@ -80,7 +80,7 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
         raise ValueError(
             f"num_shards={num_shards} must equal the {plane}-plane shard "
             f"count {want} for this mesh (or pass -1)")
-    cap = -(-total_capacity // num_shards)
+    cap = hash_lib.round_capacity(-(-total_capacity // num_shards))
     return HashShardingSpec(num_shards=num_shards, capacity_per_shard=cap,
                             max_probes=max_probes, plane=plane,
                             a2a_capacity=a2a_capacity, a2a_slack=a2a_slack)
@@ -194,7 +194,7 @@ def insert_rows_sharded(state: hash_lib.HashTableState,
 @functools.lru_cache(maxsize=None)
 def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                   dim: int, batch_sharded: bool,
-                  record_drops: bool = False):
+                  record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
     if spec.plane == "a2a" and spec.num_shards > 1:
@@ -224,7 +224,7 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                 num_shards=spec.num_shards, grid_axes=grid_axes,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
-                slack=spec.a2a_slack, record_drops=record_drops)
+                slack=spec.a2a_slack, record_stats=record_stats)
             return rows.reshape(idx.shape + (dim,))
     else:
         def _pull(keys, weights, init_rng, idx):
@@ -271,7 +271,7 @@ def pull_sharded(state: hash_lib.HashTableState,
 def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                    optimizer: SparseOptimizer, initializer: Any, dim: int,
                    batch_sharded: bool, dedup_capacity: Optional[int],
-                   slot_names: tuple, record_drops: bool = False):
+                   slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
     if spec.plane == "a2a" and spec.num_shards > 1:
@@ -280,9 +280,6 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
 
         def _apply(keys, weights, slots, init_rng, idx, g):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
-            local = hash_lib.HashTableState(
-                keys=keys, weights=weights, slots=slots, init_rng=init_rng,
-                insert_failures=jnp.zeros((), jnp.int32))
             flat = idx.ravel()
             sentinel = hash_lib.empty_key(flat.dtype)
 
@@ -291,22 +288,32 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                 return jnp.where(valid, spec.owner_shard(q),
                                  spec.num_shards).astype(jnp.int32)
 
-            def apply_fn(q, grads, counts):
+            def apply_fn(st, q, grads, counts):
+                tkeys, tweights, tslots, fails = st
+                cur = hash_lib.HashTableState(
+                    keys=tkeys, weights=tweights, slots=tslots,
+                    init_rng=init_rng,
+                    insert_failures=jnp.zeros((), jnp.int32))
                 masked = _mask_non_owned(spec, q, me)
                 new = hash_lib.apply_gradients(
-                    local, optimizer, initializer, masked, grads,
+                    cur, optimizer, initializer, masked, grads,
                     dedup_capacity=dedup_capacity,
                     max_probes=spec.max_probes, in_counts=counts)
-                failed = lax.psum(new.insert_failures, spec.shard_axes)
-                return new.keys, new.weights, new.slots, failed
+                return (new.keys, new.weights, new.slots,
+                        fails + new.insert_failures)
 
-            return a2a.exchange_push(
-                flat, g.reshape(-1, dim), apply_fn, owner,
+            st = a2a.exchange_push(
+                flat, g.reshape(-1, dim),
+                (keys, weights, slots, jnp.zeros((), jnp.int32)),
+                apply_fn, owner,
                 sentinel=sentinel, num_shards=spec.num_shards,
                 grid_axes=grid_axes, grid_sizes=grid_sizes,
                 split_axes=split_axes, split_sizes=split_sizes,
                 capacity=spec.a2a_capacity, slack=spec.a2a_slack,
-                record_drops=record_drops)
+                record_stats=record_stats)
+            tkeys, tweights, tslots, fails = st
+            return (tkeys, tweights, tslots,
+                    lax.psum(fails, spec.shard_axes))
     else:
         def _apply(keys, weights, slots, init_rng, idx, g):
             flat = idx.ravel()

@@ -46,6 +46,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
@@ -179,8 +180,63 @@ def state_shardings(state_specs, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=None)
+def _filled_program(mesh: Mesh, spec: ShardingSpec, tail: tuple,
+                    fill: float, dtype):
+    row = spec.row_spec()
+    shape = (spec.padded_vocab,) + tail
+    return jax.jit(
+        lambda: jnp.full(shape, fill, dtype=dtype),
+        out_shardings=NamedSharding(mesh, row))
+
+
+def filled_sharded(mesh: Mesh, spec: ShardingSpec, tail: tuple,
+                   fill, dtype) -> jnp.ndarray:
+    """A constant-filled [padded_vocab, *tail] array sharded per ``spec`` —
+    the blank canvas the streaming checkpoint loader delivers rows onto."""
+    return _filled_program(mesh, spec, tuple(tail), float(fill),
+                           np.dtype(dtype).name)()
+
+
+@functools.lru_cache(maxsize=None)
+def _deliver_program(mesh: Mesh, spec: ShardingSpec, tail: tuple, dtype):
+    """Cached scatter program: place replicated (phys_row, value) chunks
+    onto the owning device shards — the array-table twin of the hash
+    loader's ``insert_rows_sharded`` chunk delivery, so a REMOTE checkpoint
+    (sequential chunk stream, no memmap) loads with bounded host memory."""
+    rps = spec.rows_per_shard
+    axes = spec.shard_axes
+    sizes = tuple(mesh.shape[a] for a in axes)
+
+    def _deliver(arr, phys, rows):
+        me = a2a.linear_shard_id(axes, sizes)
+        loc = phys - me * rps
+        ok = (phys >= 0) & (loc >= 0) & (loc < rps)
+        idx = jnp.where(ok, loc, rps).astype(jnp.int32)
+        return arr.at[idx].set(rows.astype(arr.dtype), mode="drop")
+
+    row = spec.row_spec()
+    fn = shard_map(_deliver, mesh=mesh, in_specs=(row, P(), P()),
+                   out_specs=row, check_vma=False)
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def deliver_rows_sharded(arr: jnp.ndarray, phys: jnp.ndarray,
+                         rows: jnp.ndarray, *, mesh: Mesh,
+                         spec: ShardingSpec) -> jnp.ndarray:
+    """Scatter rows at PHYSICAL positions into a sharded array.
+
+    ``phys``/``rows`` are replicated host chunks (phys = shard *
+    rows_per_shard + local; -1 = padding). Chunks of one size reuse one
+    compiled program.
+    """
+    fn = _deliver_program(mesh, spec, tuple(rows.shape[1:]),
+                          np.dtype(arr.dtype).name)
+    return fn(arr, phys, rows)
+
+
+@functools.lru_cache(maxsize=None)
 def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
-                  batch_sharded: bool, record_drops: bool = False):
+                  batch_sharded: bool, record_stats: bool = False):
     """Cached jitted pull: eager callers (serving lookups, tests) would
     otherwise rebuild + retrace the shard_map closure every call."""
     batch_spec = P(spec.data_axis) if batch_sharded else P()
@@ -215,7 +271,7 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
                 num_shards=spec.num_shards, grid_axes=grid_axes,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
-                slack=spec.a2a_slack, record_drops=record_drops)
+                slack=spec.a2a_slack, record_stats=record_stats)
             return rows.reshape(idx.shape + (dim,))
     else:
         def _pull(weights, idx):
@@ -262,7 +318,7 @@ def pull_sharded(state: table_lib.TableState,
 def _apply_program(mesh: Mesh, spec: ShardingSpec,
                    optimizer: SparseOptimizer, dim: int,
                    batch_sharded: bool, dedup_capacity: Optional[int],
-                   slot_names: tuple, record_drops: bool = False):
+                   slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
     if spec.plane == "a2a" and spec.num_shards > 1:
@@ -279,23 +335,25 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                 return jnp.where(valid, shard, spec.num_shards).astype(
                     jnp.int32)
 
-            def apply_fn(keys, grads, counts):
+            def apply_fn(st, keys, grads, counts):
                 shard, local = spec.shard_and_local(keys)
                 mine = ((keys >= 0) & (keys < spec.padded_vocab)
                         & (shard == me))
                 masked = jnp.where(mine, local, -1)
                 new = table_lib.apply_gradients(
-                    local_state, optimizer, masked, grads,
+                    table_lib.TableState(weights=st[0], slots=st[1]),
+                    optimizer, masked, grads,
                     dedup_capacity=dedup_capacity, in_counts=counts)
                 return new.weights, new.slots
 
             return a2a.exchange_push(
-                idx.ravel(), g.reshape(-1, dim), apply_fn, owner,
+                idx.ravel(), g.reshape(-1, dim),
+                (local_state.weights, local_state.slots), apply_fn, owner,
                 sentinel=dedup.FILL, num_shards=spec.num_shards,
                 grid_axes=grid_axes, grid_sizes=grid_sizes,
                 split_axes=split_axes, split_sizes=split_sizes,
                 capacity=spec.a2a_capacity, slack=spec.a2a_slack,
-                record_drops=record_drops)
+                record_stats=record_stats)
     else:
         def _apply(weights, slots, idx, g):
             s = lax.axis_index(spec.model_axis)

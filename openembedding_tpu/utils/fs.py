@@ -1,0 +1,210 @@
+"""Filesystem abstraction: local paths + fsspec URIs (gs://, s3://, hdfs://).
+
+The reference streams its dumps straight to remote storage — per-node shard
+files piped through hadoop IO
+(/root/reference/openembedding/server/EmbeddingShardFile.h:57-63, prefixed
+URIs core/include/FileSystem.h) — because a Criteo-scale checkpoint (78 GB,
+BASELINE.md) cannot detour through local disk on every node. The TPU-native
+twin routes every checkpoint/persist byte stream through this module:
+
+* plain paths keep the fast local path (memmap writers/readers);
+* ``scheme://`` URIs dispatch to fsspec (gs/s3/hdfs/memory/...) with purely
+  SEQUENTIAL streams — the only access pattern object stores do well, and
+  exactly the access pattern of the reference's shard files.
+
+``NpyWriter``/``iter_npy_chunks`` implement the .npy container (header +
+raw C-order data) over any stream so remote arrays never materialize whole:
+the writer appends blocks, the reader yields bounded row chunks.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import posixpath
+import shutil
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+try:  # fsspec ships in the image; gate anyway so local paths never depend on it
+    import fsspec
+except ImportError:  # pragma: no cover
+    fsspec = None
+
+
+def is_remote(path: str) -> bool:
+    """True for fsspec URIs (``scheme://...``), False for local paths."""
+    return "://" in str(path)
+
+
+def _fs(path: str):
+    if fsspec is None:  # pragma: no cover
+        raise RuntimeError(
+            f"remote path {path!r} needs fsspec, which is unavailable")
+    fs, _ = fsspec.core.url_to_fs(path)
+    return fs
+
+
+def join(path: str, *parts: str) -> str:
+    if is_remote(path):
+        return posixpath.join(path, *parts)
+    return os.path.join(path, *parts)
+
+
+def open_file(path: str, mode: str = "rb"):
+    if is_remote(path):
+        return _fs(path).open(path, mode)
+    return open(path, mode)
+
+
+def exists(path: str) -> bool:
+    if is_remote(path):
+        return _fs(path).exists(path)
+    return os.path.exists(path)
+
+
+def isdir(path: str) -> bool:
+    if is_remote(path):
+        return _fs(path).isdir(path)
+    return os.path.isdir(path)
+
+
+def makedirs(path: str) -> None:
+    if is_remote(path):
+        _fs(path).makedirs(path, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def listdir(path: str):
+    if is_remote(path):
+        return [posixpath.basename(p.rstrip("/"))
+                for p in _fs(path).ls(path, detail=False)]
+    return os.listdir(path)
+
+
+def remove(path: str) -> None:
+    if is_remote(path):
+        _fs(path).rm(path)
+    else:
+        os.remove(path)
+
+
+def rmtree(path: str) -> None:
+    if is_remote(path):
+        _fs(path).rm(path, recursive=True)
+    else:
+        shutil.rmtree(path)
+
+
+# --- sequential .npy streaming ----------------------------------------------
+
+def _npy_header(dtype: np.dtype, shape: Tuple[int, ...]) -> bytes:
+    d = {"descr": np.lib.format.dtype_to_descr(dtype),
+         "fortran_order": False, "shape": tuple(shape)}
+    bio = io.BytesIO()
+    np.lib.format.write_array_header_1_0(bio, d)
+    return bio.getvalue()
+
+
+class NpyWriter:
+    """Append-only .npy writer over any byte stream (local or fsspec).
+
+    The row count must be known up front (both dump passes already count
+    rows first); blocks are appended in C order. This is the remote twin of
+    ``np.lib.format.open_memmap`` for writers that can only append —
+    the reference's piped hadoop writes (EmbeddingShardFile.h:57-63).
+    """
+
+    def __init__(self, path: str, dtype, shape: Tuple[int, ...]):
+        self._dtype = np.dtype(dtype)
+        self._shape = tuple(shape)
+        self._written = 0
+        self._f = open_file(path, "wb")
+        self._f.write(_npy_header(self._dtype, self._shape))
+
+    def write(self, block: np.ndarray) -> None:
+        block = np.ascontiguousarray(block, dtype=self._dtype)
+        self._written += block.shape[0] if block.ndim else 1
+        self._f.write(block.tobytes())
+
+    def close(self) -> None:
+        if self._written != (self._shape[0] if self._shape else 1):
+            # a short file must fail the SAVE, not the eventual load
+            self._f.close()
+            raise IOError(
+                f"NpyWriter: wrote {self._written} rows, header promised "
+                f"{self._shape[0]}")
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is None:
+            self.close()
+        else:  # pragma: no cover - propagate original error
+            self._f.close()
+
+
+def read_npy_header(f) -> Tuple[np.dtype, Tuple[int, ...]]:
+    version = np.lib.format.read_magic(f)
+    if version == (1, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+    elif version == (2, 0):
+        shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+    else:
+        raise ValueError(f"unsupported .npy format version {version}")
+    if fortran:
+        raise ValueError("fortran-order .npy not supported")
+    return np.dtype(dtype), shape
+
+
+def view_as(arr: np.ndarray, want) -> np.ndarray:
+    """Reinterpret a raw chunk under its true dtype.
+
+    numpy serializes non-native dtypes (ml_dtypes bfloat16) as opaque void
+    descrs ('<V2'); the loader knows the real dtype from the model meta and
+    must view the bytes back before handing them to jax.
+    """
+    want = np.dtype(want)
+    if arr.dtype == want:
+        return arr
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == want.itemsize:
+        return arr.view(want)
+    return arr.astype(want)
+
+
+def iter_npy_chunks(path: str, chunk_rows: int
+                    ) -> Iterator[np.ndarray]:
+    """Yield C-order row chunks of a (possibly remote) .npy sequentially."""
+    with open_file(path, "rb") as f:
+        dtype, shape = read_npy_header(f)
+        row_items = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) \
+            else 1
+        row_bytes = row_items * dtype.itemsize
+        n = shape[0] if shape else 1
+        for lo in range(0, n, chunk_rows):
+            hi = min(n, lo + chunk_rows)
+            buf = f.read((hi - lo) * row_bytes)
+            if len(buf) != (hi - lo) * row_bytes:
+                raise IOError(f"truncated .npy data in {path}")
+            yield np.frombuffer(buf, dtype=dtype).reshape(
+                (hi - lo,) + tuple(shape[1:]))
+
+
+def npy_shape(path: str) -> Tuple[np.dtype, Tuple[int, ...]]:
+    with open_file(path, "rb") as f:
+        return read_npy_header(f)
+
+
+def write_json(path: str, obj: Any) -> None:
+    with open_file(path, "wb") as f:
+        f.write(json.dumps(obj).encode("utf-8"))
+
+
+def read_json(path: str) -> Any:
+    with open_file(path, "rb") as f:
+        return json.loads(f.read().decode("utf-8"))

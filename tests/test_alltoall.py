@@ -49,26 +49,29 @@ def test_bucket_capacity_floors_and_exact():
     assert a2a.bucket_capacity(4096, 8, capacity=128) == 128
 
 
-def test_dropped_accumulators_gated(devices8):
-    """Structured-skew overflow is observable via the gated counters."""
+def test_residue_accumulators_gated(devices8):
+    """Structured-skew overflow is exact AND observable via gated counters."""
     from openembedding_tpu.utils import observability as obs
     mesh = create_mesh(1, 8, devices8)
     meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=8 * 512)
     opt = make_optimizer({"category": "sgd", "learning_rate": 0.1})
-    # capacity 4 per destination + 64 keys all owned by shard 0 => drops
+    # capacity 4 per destination + 64 keys all owned by shard 0 => the
+    # residue loop must run extra rounds (and the counters must see them)
     spec = st.make_sharding_spec(meta, mesh, plane="a2a", a2a_capacity=4)
     state = st.create_sharded_table(
-        meta, opt, {"category": "constant", "value": 0.0}, mesh=mesh,
+        meta, opt, {"category": "constant", "value": 0.5}, mesh=mesh,
         spec=spec)
     idx = jnp.asarray(np.arange(0, 8 * 64, 8, dtype=np.int32))  # all ≡ 0 mod 8
     obs.GLOBAL.reset()
     obs.set_evaluate_performance(True)
     try:
-        st.pull_sharded(state, idx, mesh=mesh, spec=spec,
-                        batch_sharded=False).block_until_ready()
+        rows = st.pull_sharded(state, idx, mesh=mesh, spec=spec,
+                               batch_sharded=False)
+        # exactness despite 16x overflow of the per-round capacity
+        np.testing.assert_allclose(np.asarray(rows), 0.5, rtol=1e-6)
         jax.effects_barrier()
         snap = obs.GLOBAL.snapshot()
-        assert snap.get("a2a_dropped_pull", {}).get("count", 0) > 0
+        assert snap.get("a2a_extra_entries_pull", {}).get("count", 0) > 0
     finally:
         obs.set_evaluate_performance(False)
         obs.GLOBAL.reset()
@@ -195,6 +198,93 @@ def test_a2a_hash_matches_single(devices8, data, model):
     want = hash_lib.pull(single, jk, None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+# --- adversarial skew: the exchange must be exact for ANY distribution ------
+
+@pytest.mark.parametrize("skew", ["congruent", "hotkey", "one_owner_hash"])
+def test_a2a_exact_under_adversarial_skew(devices8, skew):
+    """Bit-exact a2a/psum parity at DEFAULT settings under structured skew.
+
+    The reference's exchange is exact for any key distribution
+    (variable-size RPCs, EmbeddingPullOperator.cpp:60-112); the residue loop
+    must make the fixed-capacity TPU exchange match: ids all congruent mod
+    the shard count (every unique routed to ONE owner), hot-key floods, and
+    a batch >> capacity heuristics were tuned for.
+    """
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=4096)
+    opt = make_optimizer({"category": "adam", "learning_rate": 0.05})
+    init = {"category": "constant", "value": 0.5}
+    spec = st.make_sharding_spec(meta, mesh, plane="a2a")
+    pspec = st.make_sharding_spec(meta, mesh, plane="psum")
+    sharded = st.create_sharded_table(meta, opt, init, mesh=mesh, spec=spec)
+    psharded = st.create_sharded_table(meta, opt, init, mesh=mesh, spec=pspec)
+
+    rng = np.random.RandomState(13)
+    B = 512
+    for step in range(2):
+        if skew == "congruent":
+            # every id ≡ 0 mod num_shards: all uniques owned by shard 0
+            idx = (rng.randint(0, 4096 // spec.num_shards, size=B)
+                   * spec.num_shards).astype(np.int32)
+        elif skew == "hotkey":
+            idx = np.where(rng.rand(B) < 0.9, 8,
+                           rng.randint(0, 4096, size=B)).astype(np.int32)
+        else:
+            # after dedup, >capacity uniques all map to one owner via the
+            # div-free mod layout: stride by num_shards from a random base
+            idx = (np.arange(B) * spec.num_shards % 4096).astype(np.int32)
+        grads = rng.randn(B, DIM).astype(np.float32)
+        jidx, jg = jnp.asarray(idx), jnp.asarray(grads)
+
+        got = st.pull_sharded(sharded, jidx, mesh=mesh, spec=spec)
+        want = st.pull_sharded(psharded, jidx, mesh=mesh, spec=pspec)
+        # planes reduce in different shard orders -> ULP-level float
+        # reassociation; routing exactness (no dropped entries) is asserted
+        # bit-exactly in the constant-init tests below/above
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-7)
+
+        sharded = st.apply_gradients_sharded(sharded, opt, jidx, jg,
+                                             mesh=mesh, spec=spec)
+        psharded = st.apply_gradients_sharded(psharded, opt, jidx, jg,
+                                              mesh=mesh, spec=pspec)
+
+    # final weights identical (a2a shards over 8 devices, psum over 4 —
+    # compare through a full pull of the whole vocab)
+    allv = jnp.arange(4096, dtype=jnp.int32)
+    wa = st.pull_sharded(sharded, allv, mesh=mesh, spec=spec,
+                         batch_sharded=False)
+    wp = st.pull_sharded(psharded, allv, mesh=mesh, spec=pspec,
+                         batch_sharded=False)
+    np.testing.assert_allclose(np.asarray(wa), np.asarray(wp),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_a2a_hash_exact_under_skew(devices8):
+    """Hash plane: keys all congruent mod num_shards still train exactly."""
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer({"category": "sgd", "learning_rate": 1.0})
+    init = {"category": "constant", "value": 0.0}
+    spec = sh.make_hash_sharding_spec(mesh, total_capacity=4096, plane="a2a")
+    state = sh.create_sharded_hash_table(meta, opt, mesh=mesh, spec=spec)
+    single = hash_lib.create_hash_table(meta, opt, capacity=4096,
+                                        rng=jax.random.PRNGKey(0))
+    B = 256
+    # all keys owned by shard 3: key % 8 == 3, far more uniques than the
+    # default bucket capacity for a 256-entry slice over 8 shards
+    keys = (np.arange(B, dtype=np.int32) * spec.num_shards + 3)
+    g = np.ones((B, DIM), np.float32)
+    jk, jg = jnp.asarray(keys), jnp.asarray(g)
+    state = sh.apply_gradients_sharded(state, opt, init, jk, jg,
+                                       mesh=mesh, spec=spec)
+    single = hash_lib.apply_gradients(single, opt, init, jk, jg)
+    got = sh.pull_sharded(state, jk, None, mesh=mesh, spec=spec)
+    want = hash_lib.pull(single, jk, None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_allclose(np.asarray(got), -1.0, rtol=1e-6)
 
 
 # --- end-to-end through the collection ---------------------------------------

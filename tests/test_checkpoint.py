@@ -256,3 +256,109 @@ def test_resave_clears_stale_slot_files(devices8, tmp_path):
         default_optimizer={"category": "sgd", "learning_rate": 0.1})
     ckpt.save_checkpoint(path, sgd, sgd.init(jax.random.PRNGKey(1)))
     assert not (vdir / "slot_m.npy").exists()
+
+
+def test_remote_fsspec_roundtrip(devices8):
+    """Checkpoints stream to/from fsspec URIs (memory:// stands in for
+    gs://, s3://, hdfs:// — the reference dumps straight to HDFS via piped
+    hadoop IO, EmbeddingShardFile.h:57-63). Remote dumps use the keyed
+    sequential part format; loads stream chunks and deliver rows to the
+    owning devices — no memmaps, no local spool."""
+    import uuid
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states, idx = train_a_bit(coll, coll.init(jax.random.PRNGKey(0)))
+    before = coll.pull(states, idx, batch_sharded=False)
+
+    uri = f"memory://ckpt-{uuid.uuid4().hex}/m"
+    ckpt.save_checkpoint(uri, coll, states, model_sign="s-1")
+    loaded = ckpt.load_checkpoint(uri, coll)
+    after = coll.pull(loaded, idx, batch_sharded=False)
+    for k in before:
+        np.testing.assert_allclose(np.asarray(before[k]),
+                                   np.asarray(after[k]),
+                                   rtol=1e-6, atol=1e-7)
+    # optimizer state survives the remote round trip bit-for-bit
+    s1, _ = train_a_bit(coll, states, steps=1, seed=9)
+    s2, _ = train_a_bit(coll, loaded, steps=1, seed=9)
+    np.testing.assert_allclose(np.asarray(s1["arr"].weights),
+                               np.asarray(s2["arr"].weights), rtol=1e-6)
+    for sname in s1["arr"].slots:
+        np.testing.assert_allclose(np.asarray(s1["arr"].slots[sname]),
+                                   np.asarray(s2["arr"].slots[sname]),
+                                   rtol=1e-6)
+
+
+def test_remote_load_onto_different_mesh(devices8):
+    """A remote dump re-shards at load like the local keyed format."""
+    import uuid
+    mesh8 = create_mesh(2, 4, devices8)
+    coll8 = make_coll(mesh8)
+    states, idx = train_a_bit(coll8, coll8.init(jax.random.PRNGKey(0)))
+    before = coll8.pull(states, idx, batch_sharded=False)
+    uri = f"memory://ckpt-{uuid.uuid4().hex}/m"
+    ckpt.save_checkpoint(uri, coll8, states)
+
+    mesh2 = create_mesh(1, 2, devices8[:2])
+    coll2 = make_coll(mesh2)
+    loaded = ckpt.load_checkpoint(uri, coll2)
+    after = coll2.pull(loaded, idx, batch_sharded=False)
+    for k in before:
+        np.testing.assert_allclose(np.asarray(before[k]),
+                                   np.asarray(after[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_remote_bfloat16_roundtrip(devices8):
+    """bf16 tables survive the remote stream path: numpy serializes
+    ml_dtypes bfloat16 as an opaque '<V2' descr, and the streaming loader
+    must view the raw chunks back under the model meta's true dtype."""
+    import uuid
+    mesh = create_mesh(2, 4, devices8)
+    specs = (EmbeddingSpec(name="arr", input_dim=VOCAB, output_dim=DIM,
+                           dtype="bfloat16"),
+             EmbeddingSpec(name="hsh", input_dim=-1, output_dim=DIM,
+                           dtype="bfloat16", hash_capacity=512),)
+    coll = EmbeddingCollection(
+        specs, mesh,
+        default_optimizer={"category": "adagrad", "learning_rate": 0.1})
+    states, idx = train_a_bit(coll, coll.init(jax.random.PRNGKey(0)))
+    before = coll.pull(states, idx, batch_sharded=False)
+    uri = f"memory://ckpt-{uuid.uuid4().hex}/m"
+    ckpt.save_checkpoint(uri, coll, states)
+    loaded = ckpt.load_checkpoint(uri, coll)
+    assert loaded["arr"].weights.dtype == jnp.bfloat16
+    after = coll.pull(loaded, idx, batch_sharded=False)
+    for k in before:
+        np.testing.assert_array_equal(np.asarray(before[k], np.float32),
+                                      np.asarray(after[k], np.float32))
+
+
+def test_local_dump_copied_to_remote_loads(devices8, tmp_path):
+    """A single-host (logical-order, no ids files) dump copied to object
+    storage still loads: the streaming loader synthesizes ids from row
+    positions instead of demanding the keyed part format."""
+    import uuid
+    import fsspec
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states, idx = train_a_bit(coll, coll.init(jax.random.PRNGKey(0)))
+    before = coll.pull(states, idx, batch_sharded=False)
+    local = str(tmp_path / "m")
+    ckpt.save_checkpoint(local, coll, states)
+    # copy the dump byte-for-byte into the memory filesystem
+    uri = f"memory://copied-{uuid.uuid4().hex}/m"
+    fsmem, _ = fsspec.core.url_to_fs(uri)
+    for dirpath, _dirs, files in os.walk(local):
+        rel = os.path.relpath(dirpath, local)
+        for fn in files:
+            dst = uri + ("/" if rel == "." else f"/{rel}/") + fn
+            with open(os.path.join(dirpath, fn), "rb") as fsrc, \
+                    fsmem.open(dst, "wb") as fdst:
+                fdst.write(fsrc.read())
+    loaded = ckpt.load_checkpoint(uri, coll)
+    after = coll.pull(loaded, idx, batch_sharded=False)
+    for k in before:
+        np.testing.assert_allclose(np.asarray(before[k]),
+                                   np.asarray(after[k]),
+                                   rtol=1e-6, atol=1e-7)

@@ -168,3 +168,50 @@ def test_int64_keys_full_width(devices8):
                          capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "x64 worker: ok" in out.stdout
+
+
+def test_bucket_layout_and_rounding():
+    """Capacity rounds to bucket granularity; layout covers small tables."""
+    assert ht.round_capacity(1000) == 1024
+    assert ht.round_capacity(8) == 8
+    b, nb, chain = ht.table_layout(4096, ht.DEFAULT_MAX_PROBES)
+    assert (b, nb, chain) == (128, 32, 2)
+    b, nb, chain = ht.table_layout(8, ht.DEFAULT_MAX_PROBES)
+    assert (b, nb, chain) == (8, 1, 1)
+    with pytest.raises(ValueError):
+        ht.table_layout(1000, ht.DEFAULT_MAX_PROBES)
+
+
+def test_pallas_probe_gather_parity():
+    """Fused Pallas probe+gather (interpret mode) matches find_rows+take.
+
+    Covers hits, misses, and the EMPTY sentinel. The kernel is the native
+    form of the reference's probe-and-copy pull loop
+    (EmbeddingPullOperator.cpp:149-252); on current v5e it is DMA-issue-rate
+    bound and the bucket-row XLA probe is the default — the kernel stays as
+    the measured alternative (see bench_suite.json pallas_probe note).
+    """
+    from openembedding_tpu.ops import pallas_hash as ph
+    cap, dim = 2048, 128
+    rng = np.random.RandomState(3)
+    empty = ht.empty_key(jnp.int32)
+    tk = jnp.full((cap,), empty, jnp.int32)
+    nk = jnp.asarray(rng.randint(1, 1 << 30, size=700).astype(np.int32))
+    tk, slot, ins, failed = ht.find_or_insert(tk, nk, nk != empty)
+    assert int(failed.sum()) == 0
+    weights = jnp.asarray(rng.randn(cap, dim).astype(np.float32))
+    q = jnp.concatenate([
+        nk[:300],
+        jnp.asarray(rng.randint(1 << 30, 1 << 31, size=60, dtype=np.int32)),
+        jnp.asarray([empty], jnp.int32)])
+    bsz, nb, chain = ht.table_layout(cap, ht.DEFAULT_MAX_PROBES)
+    starts = ht.probe_starts(q, cap, ht.DEFAULT_MAX_PROBES)
+    rows, hit = ph.probe_gather(tk, weights, starts, q, chain=chain,
+                                bucket=bsz, empty=empty, interpret=True)
+    slots = ht.find_rows(tk, q)
+    want_hit = np.asarray(slots) >= 0
+    np.testing.assert_array_equal(np.asarray(hit), want_hit)
+    want = np.where(want_hit[:, None],
+                    np.asarray(weights)[np.maximum(np.asarray(slots), 0)],
+                    0.0)
+    np.testing.assert_array_equal(np.asarray(rows), want)

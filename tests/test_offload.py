@@ -96,6 +96,37 @@ def test_incremental_persist_restore(tmp_path):
     assert t2.work_id > t2.persisted_work
 
 
+def test_persist_chain_compaction(tmp_path):
+    """A long run's incremental chain rebases instead of growing forever.
+
+    The reference's incremental-commit protocol periodically rebases
+    (PmemEmbeddingTable.h:297-328); without it the file count, meta size,
+    and restore replay time grow unboundedly.
+    """
+    import os
+    from openembedding_tpu import offload as off
+    t = make_table()
+    p = str(tmp_path / "off")
+    for step in range(off.COMPACT_CHAIN_LEN + 3):
+        ids = np.array([step % 16, 16 + step % 7], np.int32)
+        t.prepare(ids)
+        t.apply_gradients(jnp.asarray(ids),
+                          jnp.ones((2, DIM), jnp.float32) * (step + 1))
+        t.persist(p)
+    import json
+    with open(os.path.join(p, off.OFFLOAD_META_FILE)) as f:
+        chain = json.load(f)["checkpoints"]
+    assert len(chain) <= off.COMPACT_CHAIN_LEN
+    # superseded files are deleted, listed files exist
+    files = {e["file"] for e in chain}
+    on_disk = {f for f in os.listdir(p) if f.endswith(".npz")}
+    assert on_disk == files
+    # restore parity with the uncompacted writer's state
+    t2 = make_table()
+    t2.restore(p)
+    np.testing.assert_allclose(t2.host_weights, t.host_weights, rtol=1e-6)
+
+
 def test_should_persist_window():
     t = make_table(persist_pending_window=3)
     ids = np.array([1], np.int32)
@@ -254,3 +285,24 @@ class TestShardedOffload:
         state = trainer.prepare_offload(state, b)
         scores = trainer.eval_step(state, b)
         assert scores.shape == (32,)
+
+
+def test_persist_restore_remote_uri(tmp_path):
+    """Offload persistence streams to fsspec URIs like the checkpoint dump
+    (memory:// stands in for gs://; the reference persists its PMem pool
+    through the same remote-capable file layer)."""
+    import uuid
+    uri = f"memory://off-{uuid.uuid4().hex}"
+    t = make_table()
+    ids = np.array([1, 2, 3], np.int32)
+    t.prepare(ids)
+    t.apply_gradients(jnp.asarray(ids), jnp.ones((3, DIM), jnp.float32))
+    t.persist(uri)
+    ids2 = np.array([7], np.int32)
+    t.prepare(ids2)
+    t.apply_gradients(jnp.asarray(ids2), jnp.ones((1, DIM), jnp.float32))
+    info = t.persist(uri)
+    assert info["file"].startswith("inc_")
+    t2 = make_table()
+    t2.restore(uri)
+    np.testing.assert_allclose(t2.host_weights, t.host_weights, rtol=1e-6)
