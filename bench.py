@@ -233,22 +233,32 @@ def run_offload(name, config, *, steps, warmup):
     cache = config["cache"]
     backing = tempfile.mkdtemp(prefix="bench_offload_")
     try:
+        from openembedding_tpu import EmbeddingVariableMeta
         t0 = time.perf_counter()
+        opt = {"category": "adagrad", "learning_rate": 0.01}
+        init = {"category": "constant", "value": 0.01}
         table = ShardedOffloadedTable(
-            "uid", __import__("openembedding_tpu").EmbeddingVariableMeta(
-                embedding_dim=dim, vocabulary_size=vocab),
-            {"category": "adagrad", "learning_rate": 0.01},
-            {"category": "constant", "value": 0.01},
-            vocab=vocab, cache_capacity=cache, mesh=mesh,
+            "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                         vocabulary_size=vocab),
+            opt, init, vocab=vocab, cache_capacity=cache, mesh=mesh,
+            backing_dir=backing)
+        # the model's first-order term: a dim-1 companion, offloaded too
+        # (the reference keeps linear weights on the PS as well)
+        lin = ShardedOffloadedTable(
+            "uid:linear", EmbeddingVariableMeta(embedding_dim=1,
+                                                vocabulary_size=vocab),
+            opt, init, vocab=vocab, cache_capacity=cache, mesh=mesh,
             backing_dir=backing)
         alloc_s = time.perf_counter() - t0
-        specs = (table.embedding_spec(),
+        specs = (table.embedding_spec(), lin.embedding_spec(),
                  EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
-                               optimizer={"category": "adagrad",
-                                          "learning_rate": 0.01}),)
+                               optimizer=opt),
+                 EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                               output_dim=1, optimizer=opt))
         coll = EmbeddingCollection(specs, mesh)
         trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
-                          coll, optax.adagrad(0.01), offload={"uid": table})
+                          coll, optax.adagrad(0.01),
+                          offload={"uid": table, "uid:linear": lin})
 
         rng = np.random.RandomState(0)
         def make_batch():
@@ -256,11 +266,11 @@ def run_offload(name, config, *, steps, warmup):
             # tail streams through host
             z = rng.zipf(config.get("zipf_a", 1.08), size=batch)
             uid = ((z * 2654435761) % vocab).astype(np.int32)
+            ctx = rng.randint(0, 100_000, batch).astype(np.int32)
             return {"label": (rng.rand(batch) > 0.75).astype(np.float32),
                     "dense": rng.randn(batch, 13).astype(np.float32),
-                    "sparse": {"uid": uid,
-                               "ctx": rng.randint(0, 100_000, batch)
-                               .astype(np.int32)}}
+                    "sparse": {"uid": uid, "uid:linear": uid,
+                               "ctx": ctx, "ctx:linear": ctx}}
         state = trainer.init(jax.random.PRNGKey(0),
                              trainer.shard_batch(make_batch()))
         hits = misses = 0
@@ -288,8 +298,10 @@ def run_offload(name, config, *, steps, warmup):
         finally:
             shutil.rmtree(pdir, ignore_errors=True)
         eps = steps * batch / dt
-        store_gb = (table.host_weights.nbytes + sum(
-            v.nbytes for v in table.host_slots.values())) / 1e9
+        store_gb = sum(
+            t.host_weights.nbytes + sum(v.nbytes
+                                        for v in t.host_slots.values())
+            for t in (table, lin)) / 1e9
         return {
             "metric": f"{name}_examples_per_sec_{platform}{n_dev}",
             "value": round(eps, 1),

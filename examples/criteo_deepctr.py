@@ -26,7 +26,8 @@ def parse_args(argv=None):
                    choices=["lr", "wdl", "deepfm", "xdeepfm", "dcn"])
     p.add_argument("--data", default="", help="path to criteo csv/tsv; "
                    "empty = synthetic stream")
-    p.add_argument("--format", default="csv", choices=["csv", "tsv"])
+    p.add_argument("--format", default="csv",
+                   choices=["csv", "tsv", "tfrecord"])
     p.add_argument("--batch_size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--eval_steps", type=int, default=0)
@@ -133,12 +134,22 @@ def main(argv=None):
 
     def batches(limit):
         if args.data:
-            reader = (criteo.read_criteo_tsv(args.data, args.batch_size,
-                                             num_buckets=args.num_buckets,
-                                             max_batches=limit)
-                      if args.format == "tsv" else
-                      criteo.read_criteo_csv(args.data, args.batch_size,
-                                             max_batches=limit))
+            if args.format == "tsv":
+                reader = criteo.read_criteo_tsv(
+                    args.data, args.batch_size,
+                    num_buckets=args.num_buckets, max_batches=limit)
+            elif args.format == "tfrecord":
+                # the reference's TFRecord benchmark layout
+                # (test/benchmark/criteo_tfrecord.py), read without TF
+                import itertools
+                from openembedding_tpu.data import tfrecord
+                reader = tfrecord.read_criteo_tfrecord(
+                    args.data, args.batch_size)
+                if limit:
+                    reader = itertools.islice(reader, limit)
+            else:
+                reader = criteo.read_criteo_csv(args.data, args.batch_size,
+                                                max_batches=limit)
         else:
             reader = criteo.synthetic_criteo(args.batch_size,
                                              num_buckets=args.num_buckets,

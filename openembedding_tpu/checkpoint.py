@@ -490,12 +490,22 @@ def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
 
 
 def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
-                           mesh, with_opt: bool):
-    """Streamed (remote) twin of ``_load_array_var``: blank sharded arrays
-    + sequential keyed chunk delivery (``deliver_rows_sharded``), so a
+                           mesh, with_opt: bool, from_hash: bool = False,
+                           shard_slice: Optional[tuple] = None):
+    """Streamed twin of ``_load_array_var``: blank sharded arrays +
+    sequential keyed chunk delivery (``deliver_rows_sharded``), so a
     gs://-scale table loads with bounded host memory and purely sequential
     reads — the reference's piped hadoop load
-    (EmbeddingLoadOperator.cpp:58-111)."""
+    (EmbeddingLoadOperator.cpp:58-111).
+
+    ``from_hash`` converts a HASH dump into this bounded variable (the
+    reference's copy_from hot-swap, EmbeddingVariable.cpp:29-60): stored
+    keys become logical row ids, and any key outside the bounded vocab
+    fails the load — a conversion must deliver every row or fail.
+    """
+    if from_hash and shard_slice is not None:
+        raise ValueError("hash->array conversion cannot be combined with a "
+                         "serving shard slice (serve hash dumps as hash)")
     vocab = spec.input_dim
     dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
     dim = spec.output_dim
@@ -508,16 +518,25 @@ def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
         slots[sname] = st.filled_sharded(mesh, sspec, tuple(sshape),
                                          optimizer.slot_init(sname), sdtype)
     for r in readers:
-        keyed = "ids" in r
-        names = (["ids"] if keyed else []) + ["weights"] + [
+        id_field = "keys" if from_hash else ("ids" if "ids" in r else None)
+        names = ([id_field] if id_field else []) + ["weights"] + [
             f"slot_{s}" for s in slots
             if with_opt and f"slot_{s}" in r]
-        size = min(_LOAD_CHUNK, max(r.rows("ids" if keyed else "weights"),
-                                    1))
+        # legacy npz handles have no .rows (they are plain NpzFile mappings)
+        n_rows = r.rows(id_field or "weights") if hasattr(r, "rows") \
+            else r[id_field or "weights"].shape[0]
+        size = min(_LOAD_CHUNK, max(n_rows, 1))
         offset = 0
         for chunk in _aligned_reader_chunks(r, names, size):
-            if keyed:
-                ids = chunk["ids"].astype(np.int64)
+            if id_field:
+                ids = chunk[id_field].astype(np.int64)
+                if from_hash and ids.size and (
+                        ids.min() < 0 or ids.max() >= vocab):
+                    bad = ids[(ids < 0) | (ids >= vocab)][0]
+                    raise ValueError(
+                        f"hash->array conversion: stored key {bad} is "
+                        f"outside the bounded vocab {vocab}; a load must "
+                        "deliver every row or fail")
             else:
                 # logical-order dump (no ids file): row i IS logical id i,
                 # so a local-format dump copied to object storage streams
@@ -525,6 +544,14 @@ def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
                 got = chunk["weights"].shape[0]
                 ids = np.arange(offset, offset + got, dtype=np.int64)
                 offset += got
+            if shard_slice is not None:
+                # serving shard group: keep only owned global ids and map
+                # them to the local row space (local l holds id l*G + k)
+                k, G = shard_slice
+                sel = (ids % G) == k
+                ids = ids[sel] // G
+            else:
+                sel = None
             shard, local = sspec.shard_and_local(ids)
             phys = np.where(ids < vocab,
                             shard * sspec.rows_per_shard + local, -1)
@@ -534,6 +561,8 @@ def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
             jphys = jnp.asarray(phys_p)
 
             def pad_rows(rows):
+                if sel is not None:
+                    rows = rows[sel]
                 out = np.zeros((size,) + rows.shape[1:], rows.dtype)
                 out[:n] = rows
                 return jnp.asarray(out)
@@ -552,7 +581,24 @@ def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
     return table_lib.TableState(weights=weights, slots=slots)
 
 
-def _check_meta(path: str, collection: EmbeddingCollection) -> ModelMeta:
+def _is_hash_meta(m) -> bool:
+    from .meta import UNBOUNDED_VOCAB
+    return m.vocabulary_size >= UNBOUNDED_VOCAB
+
+
+def _check_meta(path: str, collection: EmbeddingCollection,
+                shard_slice: Optional[tuple] = None) -> ModelMeta:
+    """Validate the dump's variable metas against the model's.
+
+    dim and dtype must match exactly. The vocabulary may differ when the
+    TABLE CATEGORY differs (array dump -> hash variable, or hash dump ->
+    array variable): the loader converts by streaming rows through the
+    target's delivery path — the reference's ``copy_from`` hot-swap
+    (/root/reference/openembedding/variable/EmbeddingVariable.cpp:29-60),
+    which loads any dump into any table/optimizer implementation. A
+    bounded->bounded vocabulary mismatch still fails (resizing a bounded
+    table is a model change, not a storage conversion; grow via hash).
+    """
     with fs.open_file(fs.join(path, MODEL_META_FILE), "rb") as f:
         meta = ModelMeta.loads(f.read().decode("utf-8"))
     want = collection.model_meta()
@@ -563,25 +609,51 @@ def _check_meta(path: str, collection: EmbeddingCollection) -> ModelMeta:
                              f"{v.name!r}")
         g = got_vars[v.name]
         if g.meta != v.meta:
-            raise ValueError(
-                f"variable {v.name!r} meta mismatch: checkpoint "
-                f"{g.meta} vs model {v.meta}")
+            same_shape = (
+                g.meta.embedding_dim == v.meta.embedding_dim
+                and g.meta.datatype == v.meta.datatype)
+            category_swap = _is_hash_meta(g.meta) != _is_hash_meta(v.meta)
+            slice_ok = (
+                shard_slice is not None and same_shape
+                and not _is_hash_meta(g.meta) and not _is_hash_meta(v.meta)
+                and v.meta.vocabulary_size == shard_slice_vocab(
+                    g.meta.vocabulary_size, *shard_slice))
+            if not ((same_shape and category_swap) or slice_ok):
+                raise ValueError(
+                    f"variable {v.name!r} meta mismatch: checkpoint "
+                    f"{g.meta} vs model {v.meta}")
     return meta
+
+
+def shard_slice_vocab(full_vocab: int, shard_index: int,
+                      shard_count: int) -> int:
+    """Rows owned by serving-process shard k of G: ids ≡ k (mod G)."""
+    return max(0, -(-(full_vocab - shard_index) // shard_count))
 
 
 def load_checkpoint(path: str,
                     collection: EmbeddingCollection,
                     *,
                     dense_state_template: Any = None,
-                    rng: Optional[jax.Array] = None):
+                    rng: Optional[jax.Array] = None,
+                    shard_slice: Optional[tuple] = None):
     """Rebuild all embedding states from ``path`` (any source mesh shape).
 
     Returns ``states`` or ``(states, dense_state)`` when a template pytree is
     given. Equivalent of Model::load_model: meta check -> clear weights ->
     re-deliver rows to owning shards (Model.cpp:110-134).
+
+    ``shard_slice=(k, G)`` loads only the rows this SERVING PROCESS owns —
+    bounded ids / hash keys with ``id % G == k`` — so a model larger than
+    one process serves from a G-process shard group (the reference places
+    shard x replica over PS nodes the same way, client/Model.cpp:153-186).
+    Bounded variables' local vocab must be ``shard_slice_vocab(V, k, G)``
+    (local row ``l`` holds global id ``l * G + k``); hash variables keep
+    their keys verbatim and simply skip non-owned ones.
     """
-    meta = _check_meta(path, collection)
+    meta = _check_meta(path, collection, shard_slice=shard_slice)
     with_opt = bool(meta.extra.get("include_optimizer", True))
+    dump_meta = {v.name: v.meta for v in meta.variables}
     hash_names = [n for n, s in collection.specs.items() if s.use_hash]
     # only hash variables need fresh (empty) device tables; bounded tables are
     # assembled host-side below and never pay the random-init program
@@ -592,12 +664,14 @@ def load_checkpoint(path: str,
         data = _open_var(path, vid, name)
         sspec = collection.sharding_spec(name)
         optimizer = collection.optimizer(name)
+        dump_hash = _is_hash_meta(dump_meta[name])
         if spec.use_hash:
             state = states[name]
             total_rows = 0
             for data_part in data:
                 state, n_part = _insert_hash_rows(
-                    state, data_part, collection, sspec, with_opt)
+                    state, data_part, collection, sspec, with_opt,
+                    from_array=not dump_hash, shard_slice=shard_slice)
                 total_rows += n_part
             failed = int(jax.device_get(state.insert_failures))
             if failed > 0:
@@ -607,9 +681,15 @@ def load_checkpoint(path: str,
                     f"{spec.hash_capacity}); increase hash_capacity — a "
                     "load must deliver every row or fail")
             out[name] = state
-        elif fs.is_remote(path):
+        elif dump_hash:
+            # hash dump -> bounded variable: copy_from conversion
             out[name] = _load_array_var_stream(
-                data, spec, sspec, optimizer, collection.mesh, with_opt)
+                data, spec, sspec, optimizer, collection.mesh, with_opt,
+                from_hash=True, shard_slice=shard_slice)
+        elif fs.is_remote(path) or shard_slice is not None:
+            out[name] = _load_array_var_stream(
+                data, spec, sspec, optimizer, collection.mesh, with_opt,
+                shard_slice=shard_slice)
         else:
             out[name] = _load_array_var(
                 data, spec, sspec, optimizer,
@@ -621,35 +701,63 @@ def load_checkpoint(path: str,
     return out
 
 
-def _insert_hash_rows(state, data, collection, sspec, with_opt):
+def _insert_hash_rows(state, data, collection, sspec, with_opt,
+                      from_array: bool = False,
+                      shard_slice: Optional[tuple] = None):
     """Stream one reader's (keys, weights, states) rows into the table.
 
     Consumes row-aligned chunks so the same code path serves memmapped
     local dumps, legacy npz handles, and remote sequential streams.
+    ``from_array`` converts a BOUNDED dump into this hash variable —
+    logical row ids become keys (the reference's copy_from hot-swap for
+    bounded-vocab growth, EmbeddingVariable.cpp:29-60).
     """
     # slots present in both the checkpoint and the current optimizer are
     # restored; others keep their fresh init — loading into a different
     # optimizer category keeps weights and re-initializes slots, the
     # reference's copy_from hot-swap semantics (EmbeddingVariable.cpp:29-60)
-    names = ["keys", "weights"] + ([f"slot_{s}" for s in state.slots
-                                    if f"slot_{s}" in data]
-                                   if with_opt else [])
+    if from_array:
+        id_field = "ids" if "ids" in data else None
+    else:
+        id_field = "keys"
+    names = ([id_field] if id_field else []) + ["weights"] + (
+        [f"slot_{s}" for s in state.slots if f"slot_{s}" in data]
+        if with_opt else [])
     # stream fixed-size chunks (padded with EMPTY) to keep shapes static
-    empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
-    n = data.rows("keys") if hasattr(data, "rows") \
-        else data["keys"].shape[0]
+    key_dtype = np.dtype(state.keys.dtype)
+    empty = hash_lib.empty_key(key_dtype)
+    n = data.rows(id_field or "weights") if hasattr(data, "rows") \
+        else data[id_field or "weights"].shape[0]
     size = min(_LOAD_CHUNK, max(n, 1))
+    offset = 0
     for chunk in _aligned_reader_chunks(data, names, size):
-        got = chunk["keys"].shape[0]
-        # keys keep the FILE dtype: insert_rows' check_key_dtype must see a
-        # wider dump dtype and refuse truncation, not a silent astype
-        ck = np.full((size,), empty, dtype=chunk["keys"].dtype)
-        ck[:got] = chunk["keys"]
+        got = chunk["weights"].shape[0]
+        if id_field:
+            raw_keys = chunk[id_field]
+        else:
+            raw_keys = np.arange(offset, offset + got, dtype=np.int64)
+            offset += got
+        if from_array:
+            # logical ids are bounded by the dump vocab; refuse ids the
+            # table's key dtype cannot hold rather than alias mod 2^32
+            if raw_keys.size and int(raw_keys.max()) > np.iinfo(
+                    key_dtype).max:
+                raise ValueError(
+                    f"array->hash conversion: logical id {raw_keys.max()} "
+                    f"does not fit key dtype {key_dtype}")
+            raw_keys = raw_keys.astype(key_dtype)
+        ck = np.full((size,), empty, dtype=raw_keys.dtype)
+        ck[:got] = raw_keys
+        if shard_slice is not None:
+            # serving shard group: non-owned keys become EMPTY (skipped by
+            # the insert path); owner rule matches the router's key % G
+            k, G = shard_slice
+            ck[:got][(raw_keys % G) != k] = empty
         wdtype = np.dtype(state.weights.dtype)
         cw = np.zeros((size,) + chunk["weights"].shape[1:], wdtype)
         cw[:got] = fs.view_as(chunk["weights"], wdtype)
         srows = {}
-        for fname in names[2:]:
+        for fname in (m for m in names if m.startswith("slot_")):
             sname = fname[len("slot_"):]
             sdtype = np.dtype(state.slots[sname].dtype)
             cs = np.zeros((size,) + chunk[fname].shape[1:], sdtype)

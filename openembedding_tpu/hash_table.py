@@ -33,10 +33,14 @@ program (no host round trips, no dynamic allocation):
   table growth being observable via item pool stats. Size the capacity for a
   load factor <= ~0.7 and the default 32-probe window is effectively exact.
 
-Key dtype follows the incoming indices (int32 by default; enable
-``jax_enable_x64`` for the reference's full 2^62 hashed key space). The
-``EMPTY`` sentinel is ``iinfo(dtype).min`` — the same value dedup uses as its
-padding fill, so padding slots are naturally invalid keys here.
+Key dtype follows the incoming indices (int32 by default). The reference's
+full 2^62 hashed key space is available two ways: ``key_width=64`` stores
+keys as [capacity, 2] int32 (lo, hi) pairs and takes [n, 2] pair queries —
+NO global flag needed (cf. ``split64``/``join64``); or
+``key_dtype=jnp.int64`` under ``jax_enable_x64``. The ``EMPTY`` sentinel is
+``iinfo(dtype).min`` — the same value dedup uses as its padding fill, so
+padding slots are naturally invalid keys here (wide slots are free iff the
+HI word is EMPTY).
 """
 
 from __future__ import annotations
@@ -45,6 +49,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from flax import struct
 
@@ -60,6 +65,47 @@ DEFAULT_MAX_PROBES = 256  # probed slots per lookup (2-bucket chain)
 
 def empty_key(dtype) -> int:
     return int(jnp.iinfo(dtype).min)
+
+
+# --- wide (64-bit) keys without jax_enable_x64 -------------------------------
+#
+# A process without the global x64 flag cannot hold jnp int64 arrays, but the
+# reference's key space is 2^62 (hashed ids, criteo_deepctr.py
+# to_hash_bucket_fast(2**62)). Wide keys are therefore carried as [n, 2]
+# int32 (lo, hi) pairs end-to-end on device; a slot is free iff its hi word
+# equals the EMPTY sentinel (keys with hi == INT32_MIN are excluded — the
+# top 2^32 of a 2^64 space, matching the reference's own 2^62 bound).
+
+def is_wide(keys: jnp.ndarray) -> bool:
+    """[n, 2] (lo, hi) pair keys vs plain [n] keys."""
+    return keys.ndim == 2
+
+
+def split64(keys64: np.ndarray) -> np.ndarray:
+    """Host helper: int64 numpy keys -> [n, 2] int32 (lo, hi) pairs."""
+    k = np.asarray(keys64, np.int64)
+    return np.stack([(k & 0xFFFFFFFF).astype(np.uint32).view(np.int32),
+                     (k >> 32).astype(np.int32)], axis=-1)
+
+
+def join64(pairs: np.ndarray) -> np.ndarray:
+    """Host helper: [n, 2] int32 pairs -> int64 numpy keys."""
+    p = np.asarray(pairs)
+    lo = p[..., 0].view(np.uint32).astype(np.uint64)
+    hi = p[..., 1].astype(np.int64)
+    return (hi << np.int64(32)) | lo.astype(np.int64)
+
+
+def _mix_pair(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """32-bit-only avalanche over a key pair (x64-off safe)."""
+    a = lo.astype(jnp.uint32)
+    b = hi.astype(jnp.uint32)
+    h = a ^ (b * jnp.uint32(0x9E3779B9))
+    h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+    h = h ^ b
+    h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
 
 
 def table_layout(capacity: int, max_probes: int) -> Tuple[int, int, int]:
@@ -104,7 +150,10 @@ def probe_starts(keys: jnp.ndarray, capacity: int,
     O(chain/num_buckets), negligible at real sizes.
     """
     b, nb, chain = table_layout(capacity, max_probes)
-    mixed = _mix(keys)
+    if is_wide(keys):
+        mixed = _mix_pair(keys[:, 0], keys[:, 1])
+    else:
+        mixed = _mix(keys)
     span = jnp.asarray(nb - chain + 1, mixed.dtype)
     return ((mixed % span).astype(jnp.int32)) * b
 
@@ -146,8 +195,15 @@ class HashTableState:
     def dim(self) -> int:
         return self.weights.shape[1]
 
+    @property
+    def wide(self) -> bool:
+        return self.keys.ndim == 2
+
     def num_used(self) -> jnp.ndarray:
-        return jnp.sum(self.keys != empty_key(self.keys.dtype)).astype(jnp.int32)
+        empty = empty_key(self.keys.dtype)
+        live = (self.keys[:, 1] != empty) if self.wide \
+            else (self.keys != empty)
+        return jnp.sum(live).astype(jnp.int32)
 
 
 def create_hash_table(meta: EmbeddingVariableMeta,
@@ -155,12 +211,16 @@ def create_hash_table(meta: EmbeddingVariableMeta,
                       *,
                       capacity: int,
                       rng: Optional[jax.Array] = None,
-                      key_dtype=jnp.int32) -> HashTableState:
+                      key_dtype=jnp.int32,
+                      key_width: int = 32) -> HashTableState:
     """Allocate an empty hash table shard.
 
     ``capacity`` plays the reference's ``reserve_items`` role
     (EmbeddingInitOperator.cpp:138-168) — hash vocabularies are unbounded so
     the caller must budget rows. Rounded up to the bucket granularity.
+    ``key_width=64`` stores keys as [capacity, 2] int32 (lo, hi) pairs —
+    the reference's 2^62 key space WITHOUT the global jax_enable_x64 flag
+    (queries then come as [n, 2] pairs, cf. :func:`split64`).
     """
     optimizer = make_optimizer(optimizer)
     if rng is None:
@@ -168,7 +228,11 @@ def create_hash_table(meta: EmbeddingVariableMeta,
     capacity = round_capacity(capacity)
     dtype = table_lib.resolve_dtype(meta)
     dim = meta.embedding_dim
-    keys = jnp.full((capacity,), empty_key(key_dtype), dtype=key_dtype)
+    if key_width == 64:
+        keys = jnp.full((capacity, 2), empty_key(jnp.int32),
+                        dtype=jnp.int32)
+    else:
+        keys = jnp.full((capacity,), empty_key(key_dtype), dtype=key_dtype)
     # weights hold placeholder zeros; live rows are written on insert with the
     # deterministic per-key init, so this buffer's initial content never leaks.
     weights = jnp.zeros((capacity, dim), dtype=dtype)
@@ -178,11 +242,28 @@ def create_hash_table(meta: EmbeddingVariableMeta,
                           insert_failures=jnp.zeros((), jnp.int32))
 
 
+def _wide_query(keys: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """Validate + flatten a wide-table query to [n, 2] pairs."""
+    if indices.ndim < 2 or indices.shape[-1] != 2:
+        raise ValueError(
+            f"key-shape mismatch: wide (64-bit pair) tables take [..., 2] "
+            f"int32 queries (hash_table.split64), got {indices.shape}")
+    return check_key_dtype(keys, indices.reshape(-1, 2))
+
+
 def init_rows(initializer: Initializer, base_rng: jax.Array,
               keys: jnp.ndarray, dim: int, dtype) -> jnp.ndarray:
-    """Deterministic initializer row per key: fold key into the base PRNG."""
-    def one(k):
-        return initializer.init(jax.random.fold_in(base_rng, k), (dim,), dtype)
+    """Deterministic initializer row per key: fold key into the base PRNG.
+    Wide keys fold both words, so rows depend on the full 64-bit key."""
+    if is_wide(keys):
+        def one(k):
+            r = jax.random.fold_in(base_rng, k[0])
+            return initializer.init(jax.random.fold_in(r, k[1]),
+                                    (dim,), dtype)
+    else:
+        def one(k):
+            return initializer.init(jax.random.fold_in(base_rng, k),
+                                    (dim,), dtype)
     return jax.vmap(one)(keys)
 
 
@@ -190,14 +271,21 @@ def check_key_dtype(table_keys: jnp.ndarray, query: jnp.ndarray) -> jnp.ndarray:
     """Cast query keys to the table's key dtype, refusing silent truncation.
 
     A table created with int32 keys cannot address an int64 id space — that
-    would alias ids modulo 2^32. Create the table with ``key_dtype=jnp.int64``
-    (requires jax_enable_x64) for the reference's full 2^62 hashed key space.
+    would alias ids modulo 2^32. Use ``key_width=64`` (pair keys, works
+    with x64 off) or ``key_dtype=jnp.int64`` (requires jax_enable_x64) for
+    the reference's full 2^62 hashed key space.
     """
+    if is_wide(table_keys) != is_wide(query):
+        raise ValueError(
+            f"key-shape mismatch: table keys {table_keys.shape} vs query "
+            f"{query.shape} — wide (64-bit pair) tables take [n, 2] int32 "
+            "queries (hash_table.split64)")
     if query.dtype.itemsize > table_keys.dtype.itemsize:
         raise ValueError(
             f"query keys are {query.dtype} but the table stores "
             f"{table_keys.dtype} keys; create the table with "
-            f"key_dtype={query.dtype} (int64 needs jax_enable_x64)")
+            f"key_dtype={query.dtype} (int64 needs jax_enable_x64) or "
+            "key_width=64 (pair keys, x64-off)")
     return query.astype(table_keys.dtype)
 
 
@@ -215,16 +303,25 @@ def find_rows(table_keys: jnp.ndarray, query: jnp.ndarray,
     """
     query = check_key_dtype(table_keys, query)
     capacity = table_keys.shape[0]
+    n = query.shape[0]
     bsz, nb, chain = table_layout(capacity, max_probes)
     h = probe_starts(query, capacity, max_probes)
     b0 = h // bsz
     bkts = b0[:, None] + jnp.arange(chain, dtype=jnp.int32)[None, :]
-    probed = jnp.take(table_keys.reshape(nb, bsz), bkts, axis=0)
-    match = probed.reshape(query.shape[0], chain * bsz) == query[:, None]
+    empty = empty_key(table_keys.dtype)
+    if is_wide(table_keys):
+        probed = jnp.take(table_keys.reshape(nb, bsz, 2), bkts, axis=0)
+        probed = probed.reshape(n, chain * bsz, 2)
+        match = ((probed[..., 0] == query[:, None, 0])
+                 & (probed[..., 1] == query[:, None, 1]))
+        valid = query[:, 1] != empty
+    else:
+        probed = jnp.take(table_keys.reshape(nb, bsz), bkts, axis=0)
+        match = probed.reshape(n, chain * bsz) == query[:, None]
+        valid = query != empty
     hit = jnp.any(match, axis=1)
     first = jnp.argmax(match, axis=1).astype(jnp.int32)
     slot = h + first
-    valid = query != empty_key(table_keys.dtype)
     return jnp.where(hit & valid, slot, -1)
 
 
@@ -255,6 +352,7 @@ def find_or_insert(table_keys: jnp.ndarray, new_keys: jnp.ndarray,
     capacity = table_keys.shape[0]
     n = new_keys.shape[0]
     empty = empty_key(table_keys.dtype)
+    wide = is_wide(table_keys)
     bsz, nb, chain = table_layout(capacity, max_probes)
     h = probe_starts(new_keys, capacity, max_probes)
     b0 = h // bsz
@@ -265,10 +363,17 @@ def find_or_insert(table_keys: jnp.ndarray, new_keys: jnp.ndarray,
         keys_arr, slot, done, inserted = carry
         bj = b0 + j
         start = bj * bsz
-        rows = jnp.take(keys_arr.reshape(nb, bsz), bj, axis=0)  # [n, bsz]
+        if wide:
+            rows = jnp.take(keys_arr.reshape(nb, bsz, 2), bj, axis=0)
+            match = ((rows[..., 0] == new_keys[:, None, 0])
+                     & (rows[..., 1] == new_keys[:, None, 1]))
+            emptym = rows[..., 1] == empty
+        else:
+            rows = jnp.take(keys_arr.reshape(nb, bsz), bj, axis=0)
+            match = rows == new_keys[:, None]
+            emptym = rows == empty
         active = valid & ~done
         # already present (keys are unique; at most one slot matches)
-        match = rows == new_keys[:, None]
         hitm = active & jnp.any(match, axis=1)
         moff = jnp.argmax(match, axis=1).astype(jnp.int32)
         slot = jnp.where(hitm, start + moff, slot)
@@ -284,7 +389,6 @@ def find_or_insert(table_keys: jnp.ndarray, new_keys: jnp.ndarray,
         group_start = lax.cummax(jnp.where(seg, ids, 0))
         rank = jnp.zeros((n,), jnp.int32).at[order].set(ids - group_start)
         # rank r takes the (r+1)-th free slot of the bucket
-        emptym = rows == empty
         cum = jnp.cumsum(emptym, axis=1).astype(jnp.int32)
         nfree = cum[:, -1]
         place = active & (rank < nfree)
@@ -319,8 +423,13 @@ def insert_rows(state: HashTableState,
     weights/states verbatim — no optimizer math. ``keys`` must be unique;
     EMPTY-sentinel keys are skipped.
     """
-    keys = check_key_dtype(state.keys, keys.ravel())
-    valid = keys != empty_key(state.keys.dtype)
+    empty = empty_key(state.keys.dtype)
+    if state.wide:
+        keys = _wide_query(state.keys, keys)
+        valid = keys[:, 1] != empty
+    else:
+        keys = check_key_dtype(state.keys, keys.ravel())
+        valid = keys != empty
     keys_arr, slot, _inserted, failed = find_or_insert(
         state.keys, keys, valid, max_probes)
     ok = valid & (slot >= 0)
@@ -353,7 +462,14 @@ def pull(state: HashTableState, indices: jnp.ndarray,
     missing keys return zero rows with no init math — the reference's
     read_only get_weights path (EmbeddingPullOperator.cpp:179-181).
     """
-    flat = check_key_dtype(state.keys, indices.ravel())
+    if state.wide:
+        flat = _wide_query(state.keys, indices)
+        invalid = flat[:, 1] == empty_key(state.keys.dtype)
+        out_shape = indices.shape[:-1] + (state.dim,)
+    else:
+        flat = check_key_dtype(state.keys, indices.ravel())
+        invalid = flat == empty_key(state.keys.dtype)
+        out_shape = indices.shape + (state.dim,)
     slot = find_rows(state.keys, flat, max_probes)
     hit = slot >= 0
     rows = jnp.take(state.weights, jnp.where(hit, slot, 0), axis=0, mode="clip")
@@ -364,9 +480,8 @@ def pull(state: HashTableState, indices: jnp.ndarray,
         fresh = init_rows(initializer, state.init_rng, flat, state.dim,
                           state.weights.dtype)
     rows = jnp.where(hit[:, None], rows, fresh)
-    invalid = flat == empty_key(state.keys.dtype)
     rows = jnp.where(invalid[:, None], jnp.zeros_like(rows), rows)
-    return rows.reshape(indices.shape + (state.dim,))
+    return rows.reshape(out_shape)
 
 
 def apply_gradients(state: HashTableState,
@@ -389,14 +504,22 @@ def apply_gradients(state: HashTableState,
     optimizer = make_optimizer(optimizer)
     initializer = make_initializer(initializer)
     dim = state.dim
-    flat_idx = check_key_dtype(state.keys, indices.ravel())
+    empty = empty_key(state.keys.dtype)
+    if state.wide:
+        flat_idx = _wide_query(state.keys, indices)
+        n = flat_idx.shape[0]
+        capacity = dedup_capacity or n
+        uniq, inverse, valid = dedup.unique_pairs(
+            flat_idx, capacity, fill_value=empty)
+        valid = valid & (uniq[:, 1] != empty)
+    else:
+        flat_idx = check_key_dtype(state.keys, indices.ravel())
+        n = flat_idx.shape[0]
+        capacity = dedup_capacity or n
+        uniq, inverse, valid = dedup.unique_indices(
+            flat_idx, capacity, fill_value=empty)
+        valid = valid & (uniq != empty)
     flat_grads = grads.reshape(-1, dim)
-    n = flat_idx.shape[0]
-    capacity = dedup_capacity or n
-
-    uniq, inverse, valid = dedup.unique_indices(
-        flat_idx, capacity, fill_value=empty_key(flat_idx.dtype))
-    valid = valid & (uniq != empty_key(flat_idx.dtype))
     summed, counts = dedup.combine_gradients(flat_grads, inverse, capacity,
                                              in_counts)
 

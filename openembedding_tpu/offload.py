@@ -354,12 +354,20 @@ class ShardedOffloadedTable:
         # host store, eagerly initialized in bounded chunks (a table bigger
         # than HBM must not be materialized on device either)
         rng = jax.random.PRNGKey(seed)
-        self.host_weights = _alloc("weights", (self.vocab, dim), dtype)
-        chunk = max(1, (64 << 20) // max(1, dim * dtype.itemsize))
-        for lo in range(0, self.vocab, chunk):
-            hi = min(self.vocab, lo + chunk)
-            self.host_weights[lo:hi] = np.asarray(self.initializer.init(
-                jax.random.fold_in(rng, lo), (hi - lo, dim), dtype))
+        from .optim import initializers as init_lib
+        if isinstance(self.initializer, init_lib.Constant):
+            # constant init fills host-side: the chunked device path would
+            # push the whole store through device transfers (minutes over a
+            # tunneled chip for a >10 GB store) to compute a constant
+            self.host_weights = _alloc("weights", (self.vocab, dim), dtype,
+                                       fill=self.initializer.value)
+        else:
+            self.host_weights = _alloc("weights", (self.vocab, dim), dtype)
+            chunk = max(1, (64 << 20) // max(1, dim * dtype.itemsize))
+            for lo in range(0, self.vocab, chunk):
+                hi = min(self.vocab, lo + chunk)
+                self.host_weights[lo:hi] = np.asarray(self.initializer.init(
+                    jax.random.fold_in(rng, lo), (hi - lo, dim), dtype))
         self.host_slots: Dict[str, np.ndarray] = {}
         for sname, sshape in self.optimizer.slot_shapes(dim).items():
             sdtype = np.dtype(self.optimizer.slot_dtype(sname, dtype))

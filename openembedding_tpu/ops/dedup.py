@@ -76,3 +76,37 @@ def combine_gradients(grads: jnp.ndarray, inverse: jnp.ndarray, capacity: int,
 def overflow_count(inverse: jnp.ndarray, capacity: int) -> jnp.ndarray:
     """Number of batch entries whose unique slot overflowed ``capacity``."""
     return jnp.sum(inverse >= capacity)
+
+
+def unique_pairs(pairs: jnp.ndarray, capacity: int | None = None,
+                 fill_value: int = FILL
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Deduplicate WIDE keys: [n, 2] int32 (lo, hi) rows, x64-off.
+
+    The 64-bit twin of :func:`unique_indices` for processes without
+    ``jax_enable_x64`` (a jnp int64 pack is unavailable there): rows are
+    ranked lexicographically by two stable argsorts (lo then hi — stable
+    sort by the major word last), duplicates detected by adjacent-row
+    equality, and compacted into a fixed-capacity buffer. Returns
+    ``(uniq [capacity, 2], inverse [n], valid [capacity])`` with padding
+    rows equal to ``(fill_value, fill_value)``.
+    """
+    n = pairs.shape[0]
+    if capacity is None:
+        capacity = n
+    lo, hi = pairs[:, 0], pairs[:, 1]
+    order = jnp.argsort(lo, stable=True)
+    order = order[jnp.argsort(hi[order], stable=True)]
+    slo, shi = lo[order], hi[order]
+    new_group = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (slo[1:] != slo[:-1]) | (shi[1:] != shi[:-1])])
+    # group ordinal per sorted row -> unique slot; first of group writes it
+    slot_sorted = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    inverse = jnp.zeros((n,), jnp.int32).at[order].set(slot_sorted)
+    fill = jnp.asarray(fill_value, pairs.dtype)
+    uniq = jnp.full((capacity, 2), fill, dtype=pairs.dtype)
+    dst = jnp.where(new_group, slot_sorted, capacity)
+    uniq = uniq.at[dst].set(jnp.stack([slo, shi], axis=1), mode="drop")
+    valid = jnp.arange(capacity) <= (slot_sorted[-1] if n else -1)
+    return uniq, inverse, valid

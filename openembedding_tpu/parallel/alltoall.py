@@ -322,7 +322,11 @@ def exchange_push(flat_idx: jnp.ndarray,
 
     Both branches are exact; the reference gets the same guarantee from
     variable-size RPCs + server-side MpscGradientReducer
-    (EmbeddingPushOperator.cpp:29-104). Keys and counts share one integer
+    (EmbeddingPushOperator.cpp:29-104). Note for appliers that dedup with a
+    bounded capacity: the OWNED-UNIQUE count an applier sees is identical
+    in both branches (each peer slice contributes a key at most once either
+    way — the gathered batch is longer but not more unique), so capacity
+    sizing is branch-independent. Keys and counts share one integer
     exchange buffer ([.., 2] channels) so a routed push costs two
     collectives per mesh axis, not three.
     """

@@ -65,6 +65,12 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--load", action="append", default=[])
     p.add_argument("--peers", default="")
+    p.add_argument("--shard_index", type=int, default=0)
+    p.add_argument("--shard_count", type=int, default=1,
+                   help=">1: this replica serves only its shard slice of "
+                        "each --load model (ids/keys ≡ shard_index mod "
+                        "shard_count) — shard-group serving for models "
+                        "larger than one process")
     p.add_argument("--hash_capacity", type=int, default=None)
     p.add_argument("--config", default="",
                    help="EnvConfig JSON file (serving section: port, "
@@ -89,8 +95,11 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
 
     for item in args.load:
         sign, _, uri = item.partition("=")
-        registry.create_model(uri, model_sign=sign or None, block=True)
-        print(f"replica: loaded {sign or uri}", flush=True)
+        registry.create_model(uri, model_sign=sign or None, block=True,
+                              shard_index=args.shard_index,
+                              shard_count=args.shard_count)
+        print(f"replica: loaded {sign or uri} "
+              f"(shard {args.shard_index}/{args.shard_count})", flush=True)
 
     if peers:
         n = restore_from_peers(registry, peers)
@@ -111,12 +120,15 @@ def restore_from_peers(registry, peers: Sequence[str],
     Aggregates the catalogs of ALL live peers (a replica must not pass its
     own endpoint here — it would see its own empty catalog as live). Peers
     still loading (models in CREATING) are polled for up to ``wait`` seconds
-    so concurrently-booting clusters converge; a model whose checkpoint
-    cannot be read is skipped with a log line instead of killing the
-    replacement replica. Returns the number restored.
+    so concurrently-booting clusters converge. A model whose checkpoint
+    URI cannot be read falls back to STREAMING THE ROWS from the living
+    peer itself (the reference's coordinated-restore iterator,
+    server/EmbeddingRestoreOperator.cpp:12-106) — losing the dump store
+    does not prevent recovery while a replica lives. Returns the number
+    restored.
     """
     deadline = time.time() + wait
-    catalog: Dict[str, str] = {}
+    catalog: Dict[str, tuple] = {}
     while True:
         catalog.clear()
         creating = False
@@ -127,7 +139,8 @@ def restore_from_peers(registry, peers: Sequence[str],
             for m in h.get("models", []):
                 status = m.get("model_status")
                 if status == "NORMAL":
-                    catalog.setdefault(m["model_sign"], m["model_uri"])
+                    catalog.setdefault(m["model_sign"],
+                                       (m["model_uri"], ep))
                 elif status == "CREATING":
                     creating = True
         # keep polling while any peer model is still loading — a settled
@@ -136,22 +149,152 @@ def restore_from_peers(registry, peers: Sequence[str],
             break
         time.sleep(0.5)
     n = 0
-    for sign, uri in catalog.items():
+    for sign, (uri, ep) in catalog.items():
         try:
             registry.create_model(uri, model_sign=sign, block=True)
             n += 1
         except ValueError:
             pass  # already loading/loaded locally
-        except RuntimeError as e:
-            print(f"replica: restore of {sign!r} from {uri!r} failed: {e}",
-                  flush=True)
+        except (RuntimeError, OSError) as e:
+            # RuntimeError: load thread failed; OSError: the dump URI itself
+            # is gone (deleted/unreachable store) — the exact case the
+            # peer-row stream exists for
+            print(f"replica: dump restore of {sign!r} from {uri!r} failed "
+                  f"({e}); streaming rows from peer {ep}", flush=True)
+            try:
+                restore_model_from_peer(registry, ep, sign)
+                n += 1
+            except Exception as e2:  # noqa: BLE001 — logged, not fatal
+                print(f"replica: peer-row restore of {sign!r} failed: "
+                      f"{e2}", flush=True)
     return n
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def fetch_rows_page(endpoint: str, sign: str, variable: str, offset: int,
+                    limit: int, timeout: float = 60.0):
+    """One page of the peer-restore row stream: ``(ids, rows, total)``."""
+    url = (f"http://{endpoint}/models/{sign}/rows?variable={variable}"
+           f"&offset={offset}&limit={limit}")
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        raw = r.read()
+    nl = raw.index(b"\n")
+    head = json.loads(raw[:nl])
+    body = raw[nl + 1:]
+    n = head["n"]
+    ids = np.frombuffer(body[:n * 8], np.int64)
+    rows = np.frombuffer(body[n * 8:], _np_dtype(head["dtype"]))
+    rows = rows.reshape(n, head["dim"]) if head["dim"] else \
+        rows.reshape(n, 0)
+    return ids, rows, head["total"]
+
+
+def restore_model_from_peer(registry, endpoint: str, sign: str, *,
+                            page: int = 1 << 16,
+                            timeout: float = 60.0) -> str:
+    """Rebuild ``sign`` purely from a LIVING replica's memory.
+
+    The dump-less restore path: fetch the peer's ModelMeta, allocate blank
+    states, page every variable's rows over the binary /rows endpoint and
+    deliver them through the same machinery the checkpoint loader uses —
+    the reference's replica-iterator restore
+    (server/EmbeddingRestoreOperator.cpp:12-106) as HTTP row streaming.
+    For shard-group models the peer must belong to the SAME group (ids are
+    global; the restorer re-filters by its own slice on delivery).
+    """
+    import jax
+    from ..meta import ModelMeta
+    from ..parallel import sharded_hash as sh
+    from ..parallel import sharded_table as st
+    from .. import hash_table as hash_lib
+    from .. import table as table_lib
+    from ..embedding import EmbeddingCollection
+    from .registry import ServingModel, _specs_from_meta
+
+    with urllib.request.urlopen(
+            f"http://{endpoint}/models/{sign}/meta", timeout=timeout) as r:
+        info = json.loads(r.read())
+    meta = ModelMeta.loads(info["meta"])
+    shard_slice = ((info["shard_index"], info["shard_count"])
+                   if info.get("shard_count", 1) > 1 else None)
+    specs = _specs_from_meta(meta, registry.default_hash_capacity, -1,
+                             shard_slice)
+    coll = EmbeddingCollection(specs, registry.mesh)
+    hash_names = [n for n, s in coll.specs.items() if s.use_hash]
+    states = coll.init(jax.random.PRNGKey(0), only=hash_names)
+    out = {}
+    for name, spec in coll.specs.items():
+        sspec = coll.sharding_spec(name)
+        offset, total = 0, None
+        if spec.use_hash:
+            state = states[name]
+            empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+            while total is None or offset < total:
+                ids, rows, total = fetch_rows_page(
+                    endpoint, sign, name, offset, page, timeout)
+                offset += page
+                if not ids.size:
+                    continue
+                ck = np.full((page,), empty,
+                             dtype=np.dtype(state.keys.dtype))
+                ck[:ids.size] = ids
+                cw = np.zeros((page,) + rows.shape[1:], rows.dtype)
+                cw[:ids.size] = rows
+                import jax.numpy as jnp
+                state = sh.insert_rows_sharded(
+                    state, jnp.asarray(ck), jnp.asarray(cw), {},
+                    mesh=coll.mesh, spec=sspec)
+            if int(jax.device_get(state.insert_failures)) > 0:
+                raise RuntimeError(
+                    f"peer restore of {name!r}: rows did not fit the "
+                    "local hash capacity")
+            out[name] = state
+        else:
+            import jax.numpy as jnp
+            dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
+            weights = st.filled_sharded(coll.mesh, sspec,
+                                        (spec.output_dim,), 0.0, dtype)
+            while total is None or offset < total:
+                ids, rows, total = fetch_rows_page(
+                    endpoint, sign, name, offset, page, timeout)
+                offset += page
+                if not ids.size:
+                    continue
+                if shard_slice is not None:
+                    k, G = shard_slice
+                    sel = (ids % G) == k
+                    local = ids[sel] // G
+                    rows = rows[sel]
+                else:
+                    local = ids
+                shard, loc = sspec.shard_and_local(local)
+                phys = np.where(local < spec.input_dim,
+                                shard * sspec.rows_per_shard + loc, -1)
+                phys_p = np.full((page,), -1, np.int64)
+                phys_p[:phys.size] = phys
+                rows_p = np.zeros((page,) + rows.shape[1:], dtype)
+                rows_p[:rows.shape[0]] = rows
+                weights = st.deliver_rows_sharded(
+                    weights, jnp.asarray(phys_p), jnp.asarray(rows_p),
+                    mesh=coll.mesh, spec=sspec)
+            out[name] = table_lib.TableState(weights=weights, slots={})
+    model = ServingModel(sign, coll, out, meta, shard_slice=shard_slice)
+    return registry.register_model(model)
 
 
 def spawn_replica(port: int, *, load: Sequence[str] = (),
                   peers: Sequence[str] = (),
                   env: Optional[Dict[str, str]] = None,
-                  devices: int = 1) -> subprocess.Popen:
+                  devices: int = 1,
+                  shard_index: int = 0,
+                  shard_count: int = 1) -> subprocess.Popen:
     """Start a replica daemon as a child process (test/driver helper)."""
     cmd = [sys.executable, "-m", "openembedding_tpu.serving.ha",
            "--port", str(port)]
@@ -159,6 +302,9 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
         cmd += ["--load", item]
     if peers:
         cmd += ["--peers", ",".join(peers)]
+    if shard_count > 1:
+        cmd += ["--shard_index", str(shard_index),
+                "--shard_count", str(shard_count)]
     child_env = {**os.environ, **(env or {})}
     child_env.setdefault("JAX_PLATFORMS", "cpu")
     child_env.setdefault("JAX_NUM_CPU_DEVICES", str(devices))
@@ -241,6 +387,13 @@ class RoutingClient:
         raise ConnectionError(
             f"no live replica among {self.endpoints}: {last_err}")
 
+    def _request_bin(self, endpoint: str, path: str, body: bytes) -> bytes:
+        req = urllib.request.Request(
+            f"http://{endpoint}{path}", data=body, method="POST",
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read()
+
     # -- serving API -------------------------------------------------------
     def lookup(self, sign: str, variable: Any, indices) -> np.ndarray:
         """Read-only pull with replica failover (never fails while one
@@ -250,6 +403,38 @@ class RoutingClient:
             {"variable": variable,
              "indices": np.asarray(indices).tolist()})
         return np.asarray(out["rows"], dtype=np.float32)
+
+    def lookup_bin(self, sign: str, variable: Any, indices) -> np.ndarray:
+        """Binary-protocol pull: packed ids out, packed f32 rows back — the
+        serving-grade data plane (no JSON list marshalling; the reference's
+        zero-copy RpcView role, server/RpcView.h). Same failover rotation
+        as :meth:`lookup`."""
+        idx = np.ascontiguousarray(np.asarray(indices))
+        head = json.dumps({"variable": variable,
+                           "dtype": idx.dtype.name}).encode() + b"\n"
+        body = head + idx.tobytes()
+        order = list(self.endpoints)
+        start = random.randrange(len(order))
+        order = order[start:] + order[:start]
+        last_err: Optional[Exception] = None
+        for ep in order:
+            try:
+                raw = self._request_bin(
+                    ep, f"/models/{sign}/lookup_bin", body)
+                nl = raw.index(b"\n")
+                h = json.loads(raw[:nl])
+                return np.frombuffer(raw[nl + 1:], np.float32).reshape(
+                    h["n"], h["dim"])
+            except urllib.error.HTTPError as e:
+                if e.code in (409, 503):
+                    last_err = e
+                    continue
+                raise
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, OSError, TimeoutError) as e:
+                last_err = e
+        raise ConnectionError(
+            f"no live replica among {self.endpoints}: {last_err}")
 
     def create_model(self, model_uri: str, *,
                      model_sign: Optional[str] = None,
@@ -267,6 +452,68 @@ class RoutingClient:
         """Cluster liveness, client-side aggregated."""
         from .rest import probe_nodes
         return probe_nodes(self.endpoints)
+
+
+class ShardedRoutingClient:
+    """Shard-group lookup client: shards x replicas over N processes.
+
+    The reference places shard x replica over PS nodes and a pull fans out
+    per-shard requests, picking one live replica per shard
+    (/root/reference/openembedding/client/Model.cpp:153-186,
+    server/EmbeddingPullOperator.cpp:50-57). Here ``groups[k]`` lists the
+    replica endpoints of shard k (ids/keys ≡ k mod G); a lookup partitions
+    its indices by owner, queries each owner group through that group's
+    failover rotation, and merges rows back by position. Service survives
+    any failure that leaves >= 1 live replica per shard group.
+    """
+
+    def __init__(self, groups: Sequence[Sequence[str]],
+                 timeout: float = 10.0):
+        if not groups or any(not g for g in groups):
+            raise ValueError("need >= 1 replica endpoint per shard group")
+        self.groups = [RoutingClient(list(g), timeout=timeout)
+                       for g in groups]
+
+    @property
+    def shard_count(self) -> int:
+        return len(self.groups)
+
+    def lookup(self, sign: str, variable: Any, indices) -> np.ndarray:
+        idx = np.asarray(indices)
+        flat = idx.ravel()
+        G = self.shard_count
+        owner = flat % G
+        rows = None
+        for k in range(G):
+            sel = np.nonzero(owner == k)[0]
+            if not sel.size:
+                continue
+            part = self.groups[k].lookup(sign, variable, flat[sel])
+            if rows is None:
+                rows = np.zeros((flat.size,) + part.shape[1:], part.dtype)
+            rows[sel] = part
+        if rows is None:
+            rows = np.zeros((0, 0), np.float32)
+        return rows.reshape(idx.shape + rows.shape[1:])
+
+    def create_model(self, model_uri: str, *,
+                     model_sign: Optional[str] = None,
+                     block: bool = True) -> List[str]:
+        """Create the model on every process with its group's shard slice."""
+        signs = []
+        for k, group in enumerate(self.groups):
+            for ep in group.endpoints:
+                out = group._request(
+                    ep, "POST", "/models",
+                    {"model_uri": model_uri, "model_sign": model_sign,
+                     "shard_index": k, "shard_count": self.shard_count,
+                     "block": block})
+                signs.append(out["model_sign"])
+        return signs
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        from .rest import probe_nodes
+        return probe_nodes([ep for g in self.groups for ep in g.endpoints])
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..embedding import EmbeddingCollection, EmbeddingSpec
@@ -34,45 +35,113 @@ from .. import checkpoint as ckpt_lib
 
 
 class ServingModel:
-    """One loaded model: collection + read-only states."""
+    """One loaded model: collection + read-only states.
+
+    ``shard_slice=(k, G)`` marks a SHARD-GROUP member: this process holds
+    only ids/keys with ``id % G == k`` (the reference's shard placement
+    over PS nodes, client/Model.cpp:153-186). Lookups accept GLOBAL ids:
+    bounded ids are mapped to the local row space, non-owned ids return
+    zero rows (the router only sends owned ids; stray ones are harmless).
+    """
 
     def __init__(self, sign: str, collection: EmbeddingCollection,
-                 states: Dict[str, Any], meta: ModelMeta):
+                 states: Dict[str, Any], meta: ModelMeta,
+                 shard_slice=None):
         self.sign = sign
         self.collection = collection
         self.states = states
         self.meta = meta
+        self.shard_slice = tuple(shard_slice) if shard_slice else None
         self._by_id = {collection.variable_id(name): name
                        for name in collection.specs}
 
     def variable_name(self, variable_id: int) -> str:
         return self._by_id[variable_id]
 
+    def export_rows(self, variable: Any, offset: int, limit: int):
+        """Page through this replica's live rows: ``(ids, rows, total)``.
+
+        The peer-to-peer restore protocol (the reference's coordinated-
+        restore iterator, server/EmbeddingRestoreOperator.cpp:12-106): a
+        respawned replica pages ``offset`` from 0 to ``total`` on a LIVING
+        peer and rebuilds state from the responses alone — no dump URI
+        involved. Ids are GLOBAL (shard-sliced models re-globalize their
+        local rows), so any group member can restore from any same-group
+        peer.
+        """
+        name = (variable if isinstance(variable, str)
+                else self._by_id[int(variable)])
+        spec = self.collection.specs[name]
+        state = self.states[name]
+        if spec.use_hash:
+            total = int(state.keys.shape[0])
+            hi = min(offset + limit, total)
+            keys = np.asarray(jax.device_get(state.keys[offset:hi]))
+            from .. import hash_table as hash_lib
+            live = keys != hash_lib.empty_key(keys.dtype)
+            ids = keys[live].astype(np.int64)
+            # weights are slot-parallel to keys: slice directly instead of
+            # re-probing the table for slots already in hand (restore
+            # wall-clock stays memcpy-bound, not probe-bound)
+            rows = np.asarray(jax.device_get(
+                state.weights[offset:hi]))[live] \
+                if ids.size else np.zeros((0, spec.output_dim), np.float32)
+            return ids, rows, total
+        total = int(spec.input_dim)
+        hi = min(offset + limit, total)
+        local = np.arange(offset, hi, dtype=np.int64)
+        if self.shard_slice is not None:
+            k, G = self.shard_slice
+            ids = local * G + k
+        else:
+            ids = local
+        rows = np.asarray(self.lookup(name, ids)) \
+            if ids.size else np.zeros((0, spec.output_dim), np.float32)
+        return ids, rows, total
+
     def lookup(self, variable: Any, indices) -> jnp.ndarray:
         """Read-only pull for one variable (by name or variable_id)."""
         name = (variable if isinstance(variable, str)
                 else self._by_id[int(variable)])
         idx = jnp.asarray(indices)
+        if self.shard_slice is not None:
+            k, G = self.shard_slice
+            if not self.collection.specs[name].use_hash:
+                idx = jnp.where(idx % G == k, idx // G, -1)
+            else:
+                from .. import hash_table as hash_lib
+                empty = hash_lib.empty_key(idx.dtype)
+                idx = jnp.where(idx % G == k, idx, empty)
         rows = self.collection.pull(self.states, {name: idx},
                                     batch_sharded=False, read_only=True)
         return rows[name]
 
 
 def _specs_from_meta(meta: ModelMeta, hash_capacity: int,
-                     num_shards: int = -1) -> List[EmbeddingSpec]:
+                     num_shards: int = -1,
+                     shard_slice=None) -> List[EmbeddingSpec]:
     """Rebuild EmbeddingSpecs from a checkpoint's model_meta — the serving
     process needs no model code, just the dump (like TF-Serving + the
     reference's SavedModel + <dir>/openembedding sidecar). Hash geometry
     (capacity/key dtype) comes from the meta's ``hash_variables`` extra when
     the checkpoint recorded it, so serving tables can hold every trained row."""
+    from .. import checkpoint as ckpt_mod
     hash_info = meta.extra.get("hash_variables", {})
     poolings = meta.extra.get("variable_pooling", {})
     specs = []
     for v in sorted(meta.variables, key=lambda v: v.variable_id):
         hash_var = v.meta.vocabulary_size >= UNBOUNDED_VOCAB
         info = hash_info.get(v.name, {})
+        vocab = v.meta.vocabulary_size
+        cap = int(info.get("hash_capacity", hash_capacity))
+        if shard_slice is not None:
+            # shard-group member: bounded vocab shrinks to the owned rows,
+            # hash capacity to this shard's share
+            k, G = shard_slice
+            vocab = ckpt_mod.shard_slice_vocab(vocab, k, G)
+            cap = max(1, -(-cap // G))
         specs.append(EmbeddingSpec(
-            name=v.name, input_dim=-1 if hash_var else v.meta.vocabulary_size,
+            name=v.name, input_dim=-1 if hash_var else vocab,
             output_dim=v.meta.embedding_dim, dtype=v.meta.datatype,
             # serving is read-only: the stateless "default" optimizer means
             # no slot arrays are allocated or loaded (the reference serves
@@ -98,16 +167,23 @@ class ModelRegistry:
     # --- lifecycle (ModelController.create/delete/show equivalents) -------
     def create_model(self, model_uri: str, *, model_sign: Optional[str] = None,
                      replica_num: int = 3, num_shards: int = -1,
+                     shard_index: int = 0, shard_count: int = 1,
                      block: bool = True) -> str:
         """Load a checkpoint for serving; returns the model_sign.
 
         Async when ``block=False``: status is CREATING until the load thread
         finishes (reference ModelController.cpp:47-85 thread-group load).
+        ``shard_count > 1`` loads only this process's shard slice (ids/keys
+        ≡ shard_index mod shard_count) so a model larger than one process
+        serves from a shard group — the reference's shard x replica
+        placement over PS nodes (client/Model.cpp:153-186).
         """
-        with open(f"{model_uri}/{ckpt_lib.MODEL_META_FILE}",
-                  encoding="utf-8") as f:
-            meta = ModelMeta.loads(f.read())
+        from ..utils import fs as fs_lib
+        with fs_lib.open_file(
+                fs_lib.join(model_uri, ckpt_lib.MODEL_META_FILE), "rb") as f:
+            meta = ModelMeta.loads(f.read().decode("utf-8"))
         sign = model_sign or meta.model_sign or model_uri
+        shard_slice = (shard_index, shard_count) if shard_count > 1 else None
         with self._lock:
             if sign in self._status and \
                     self._status[sign]["model_status"] == ModelStatus.CREATING:
@@ -116,15 +192,18 @@ class ModelRegistry:
                 "model_sign": sign, "model_uri": model_uri,
                 "model_status": ModelStatus.CREATING, "model_error": "",
                 "replica_num": replica_num,
+                "shard_index": shard_index, "shard_count": shard_count,
             }
 
         def _load():
             try:
                 specs = _specs_from_meta(meta, self.default_hash_capacity,
-                                         num_shards)
+                                         num_shards, shard_slice)
                 coll = EmbeddingCollection(specs, self.mesh)
-                states = ckpt_lib.load_checkpoint(model_uri, coll)
-                model = ServingModel(sign, coll, states, meta)
+                states = ckpt_lib.load_checkpoint(model_uri, coll,
+                                                  shard_slice=shard_slice)
+                model = ServingModel(sign, coll, states, meta,
+                                     shard_slice=shard_slice)
                 with self._lock:
                     self._models[sign] = model
                     self._status[sign]["model_status"] = ModelStatus.NORMAL
@@ -142,6 +221,22 @@ class ModelRegistry:
         else:
             threading.Thread(target=_load, daemon=True).start()
         return sign
+
+    def register_model(self, model: ServingModel, *,
+                       replica_num: int = 3) -> str:
+        """Install an externally assembled model (peer-to-peer restore:
+        the states were streamed from a living replica, not a dump)."""
+        ss = model.shard_slice or (0, 1)
+        with self._lock:
+            self._models[model.sign] = model
+            self._status[model.sign] = {
+                "model_sign": model.sign,
+                "model_uri": model.meta.model_uri or "",
+                "model_status": ModelStatus.NORMAL, "model_error": "",
+                "replica_num": replica_num,
+                "shard_index": ss[0], "shard_count": ss[1],
+            }
+        return model.sign
 
     def delete_model(self, sign: str) -> None:
         with self._lock:

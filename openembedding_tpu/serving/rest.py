@@ -102,6 +102,42 @@ def make_handler(registry: ModelRegistry, peers=None):
                     return None
                 if self.path == "/models":
                     return self._send(200, registry.show_models())
+                m = re.fullmatch(r"/models/([^/]+)/meta", self.path)
+                if m:
+                    # full ModelMeta for peer-to-peer restore: the restorer
+                    # rebuilds specs from this alone, like the dump loader
+                    model = registry.find_model(m.group(1))
+                    st = registry.show_model(m.group(1))
+                    return self._send(200, {
+                        "meta": model.meta.dumps(),
+                        "shard_index": st.get("shard_index", 0),
+                        "shard_count": st.get("shard_count", 1),
+                        "variables": [
+                            {"name": name,
+                             "use_hash": model.collection.specs[
+                                 name].use_hash}
+                            for name in model.collection.specs]})
+                m = re.fullmatch(
+                    r"/models/([^/]+)/rows\?variable=([^&]+)"
+                    r"&offset=(\d+)&limit=(\d+)", self.path)
+                if m:
+                    # binary row page (peer restore data plane): one JSON
+                    # header line + raw int64 ids + raw row bytes
+                    model = registry.find_model(m.group(1))
+                    ids, rows, total = model.export_rows(
+                        m.group(2), int(m.group(3)), int(m.group(4)))
+                    header = json.dumps({
+                        "n": int(ids.shape[0]), "total": int(total),
+                        "dim": int(rows.shape[1]) if rows.ndim == 2 else 0,
+                        "dtype": rows.dtype.name}).encode() + b"\n"
+                    payload = header + ids.tobytes() + rows.tobytes()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return None
                 m = re.fullmatch(r"/models/([^/]+)", self.path)
                 if m:
                     return self._send(200, registry.show_model(m.group(1)))
@@ -129,6 +165,8 @@ def make_handler(registry: ModelRegistry, peers=None):
                         model_sign=req.get("model_sign"),
                         replica_num=int(req.get("replica_num", 3)),
                         num_shards=int(req.get("num_shards", -1)),
+                        shard_index=int(req.get("shard_index", 0)),
+                        shard_count=int(req.get("shard_count", 1)),
                         block=bool(req.get("block", False)))
                     return self._send(201, {"model_sign": sign},
                                       location=f"/models/{sign}")
@@ -141,6 +179,31 @@ def make_handler(registry: ModelRegistry, peers=None):
                         np.asarray(req["indices"], dtype=np.int64
                                    if req.get("int64") else np.int32))
                     return self._send(200, {"rows": np.asarray(rows).tolist()})
+                m = re.fullmatch(r"/models/([^/]+)/lookup_bin", self.path)
+                if m:
+                    # serving-grade data plane: packed ids in, packed f32
+                    # rows out — no JSON list marshalling (the reference's
+                    # zero-copy RpcView role, server/RpcView.h)
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n)
+                    nl = raw.index(b"\n")
+                    head = json.loads(raw[:nl])
+                    idx = np.frombuffer(raw[nl + 1:],
+                                        dtype=np.dtype(head["dtype"]))
+                    model = registry.find_model(m.group(1))
+                    rows = np.asarray(model.lookup(head["variable"], idx),
+                                      dtype=np.float32)
+                    hdr = json.dumps({"n": int(rows.shape[0]),
+                                      "dim": int(rows.shape[1])}
+                                     ).encode() + b"\n"
+                    payload = hdr + rows.tobytes()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return None
                 self._send(404, {"error": "not found"})
             except (KeyError, ValueError) as e:
                 self._send(400, {"error": str(e)})

@@ -362,3 +362,107 @@ def test_local_dump_copied_to_remote_loads(devices8, tmp_path):
         np.testing.assert_allclose(np.asarray(before[k]),
                                    np.asarray(after[k]),
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_category_hotswap_array_to_hash(devices8, tmp_path):
+    """An ARRAY dump loads into a HASH variable (bounded-vocab growth):
+    logical row ids become keys, weights bit-equal, matching-optimizer
+    slots restored — the reference's copy_from streaming conversion
+    (EmbeddingVariable.cpp:29-60)."""
+    mesh = create_mesh(2, 4, devices8)
+    arr_specs = (EmbeddingSpec(name="v", input_dim=VOCAB, output_dim=DIM,
+                               optimizer={"category": "adam",
+                                          "learning_rate": 0.05}),)
+    coll_a = EmbeddingCollection(arr_specs, mesh)
+    states = coll_a.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        idx = {"v": jnp.asarray(rng.randint(0, VOCAB, 16).astype(np.int32))}
+        rows = coll_a.pull(states, idx, batch_sharded=False)
+        states = coll_a.apply_gradients(
+            states, idx, {"v": jnp.ones_like(rows["v"]) * 0.2},
+            batch_sharded=False)
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, coll_a, states)
+
+    hash_specs = (EmbeddingSpec(name="v", input_dim=-1, output_dim=DIM,
+                                hash_capacity=4 * VOCAB,
+                                optimizer={"category": "adam",
+                                           "learning_rate": 0.05}),)
+    coll_h = EmbeddingCollection(hash_specs, mesh)
+    loaded = ckpt.load_checkpoint(p, coll_h)
+    allv = jnp.arange(VOCAB, dtype=jnp.int32)
+    want = coll_a.pull(states, {"v": allv}, batch_sharded=False)["v"]
+    got = coll_h.pull(loaded, {"v": allv}, batch_sharded=False,
+                      read_only=True)["v"]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # adam slots came along: one identical step matches the array twin
+    g = jnp.ones((VOCAB, DIM), jnp.float32) * 0.1
+    s_a = coll_a.apply_gradients(states, {"v": allv}, {"v": g},
+                                 batch_sharded=False)
+    s_h = coll_h.apply_gradients(loaded, {"v": allv}, {"v": g},
+                                 batch_sharded=False)
+    wa = coll_a.pull(s_a, {"v": allv}, batch_sharded=False)["v"]
+    wh = coll_h.pull(s_h, {"v": allv}, batch_sharded=False,
+                     read_only=True)["v"]
+    np.testing.assert_allclose(np.asarray(wh), np.asarray(wa),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_category_hotswap_hash_to_array(devices8, tmp_path):
+    """A HASH dump whose keys fit the bounded vocab loads into an ARRAY
+    variable; out-of-range keys fail the load (deliver-or-fail)."""
+    mesh = create_mesh(2, 4, devices8)
+    hash_specs = (EmbeddingSpec(name="v", input_dim=-1, output_dim=DIM,
+                                hash_capacity=512,
+                                optimizer={"category": "adagrad",
+                                           "learning_rate": 0.1}),)
+    coll_h = EmbeddingCollection(hash_specs, mesh)
+    states = coll_h.init(jax.random.PRNGKey(1))
+    keys = jnp.asarray(np.arange(0, VOCAB, 3, dtype=np.int32))
+    rows = coll_h.pull(states, {"v": keys}, batch_sharded=False)
+    states = coll_h.apply_gradients(
+        states, {"v": keys}, {"v": jnp.ones_like(rows["v"])},
+        batch_sharded=False)
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, coll_h, states)
+
+    arr_specs = (EmbeddingSpec(name="v", input_dim=VOCAB, output_dim=DIM,
+                               optimizer={"category": "adagrad",
+                                          "learning_rate": 0.1}),)
+    coll_a = EmbeddingCollection(arr_specs, mesh)
+    loaded = ckpt.load_checkpoint(p, coll_a)
+    want = coll_h.pull(states, {"v": keys}, batch_sharded=False,
+                       read_only=True)["v"]
+    got = coll_a.pull(loaded, {"v": keys}, batch_sharded=False)["v"]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # untouched ids hold the array-table fill (zeros), not garbage
+    miss = coll_a.pull(loaded, {"v": jnp.asarray([1], jnp.int32)},
+                       batch_sharded=False)["v"]
+    np.testing.assert_array_equal(np.asarray(miss), 0.0)
+
+    # a key beyond the bounded vocab must fail the conversion
+    big = jnp.asarray([VOCAB + 7], jnp.int32)
+    rows = coll_h.pull(states, {"v": big}, batch_sharded=False)
+    states = coll_h.apply_gradients(
+        states, {"v": big}, {"v": jnp.ones_like(rows["v"])},
+        batch_sharded=False)
+    p2 = str(tmp_path / "m2")
+    ckpt.save_checkpoint(p2, coll_h, states)
+    with pytest.raises(ValueError, match="outside the bounded vocab"):
+        ckpt.load_checkpoint(p2, coll_a)
+
+
+def test_bounded_vocab_mismatch_still_rejected(devices8, tmp_path):
+    """Category swap is allowed; bounded->bounded resize is not."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = EmbeddingCollection(
+        (EmbeddingSpec(name="v", input_dim=VOCAB, output_dim=DIM),), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, coll, states)
+    coll2 = EmbeddingCollection(
+        (EmbeddingSpec(name="v", input_dim=2 * VOCAB, output_dim=DIM),),
+        mesh)
+    with pytest.raises(ValueError, match="meta mismatch"):
+        ckpt.load_checkpoint(p, coll2)

@@ -215,3 +215,72 @@ def test_pallas_probe_gather_parity():
                     np.asarray(weights)[np.maximum(np.asarray(slots), 0)],
                     0.0)
     np.testing.assert_array_equal(np.asarray(rows), want)
+
+
+def test_wide_keys_full_width_without_x64():
+    """64-bit key space in a DEFAULT (x64-off) process: keys are [n, 2]
+    int32 (lo, hi) pairs, so ids that differ only above bit 31 must map to
+    distinct rows — the aliasing an int32 table would silently commit.
+    Covers the reference's 2^62 hashed key space
+    (criteo_deepctr.py to_hash_bucket_fast(2**62)) without the global flag.
+    """
+    assert not jax.config.jax_enable_x64
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer({"category": "sgd", "learning_rate": 1.0})
+    init = {"category": "constant", "value": 0.0}
+    t = ht.create_hash_table(meta, opt, capacity=1024, key_width=64)
+    assert t.wide and t.keys.shape == (1024, 2)
+
+    # keys congruent mod 2^32: identical lo words, distinct hi words
+    base = np.asarray([12345, 12345 + (1 << 32), 12345 + (5 << 40),
+                       -(7 << 35) + 12345], np.int64)
+    pairs = jnp.asarray(ht.split64(base))
+    assert np.asarray(pairs[:, 0]).tolist() == [np.int32(12345)] * 4
+    g = jnp.asarray(np.arange(1, 5, dtype=np.float32))[:, None] * \
+        jnp.ones((4, DIM), jnp.float32)
+    t = ht.apply_gradients(t, opt, init, pairs, g)
+    assert int(t.insert_failures) == 0
+    assert int(t.num_used()) == 4  # four distinct rows, no aliasing
+    rows = np.asarray(ht.pull(t, pairs, None))
+    np.testing.assert_allclose(rows[:, 0], [-1.0, -2.0, -3.0, -4.0],
+                               rtol=1e-6)
+
+    # round-trip through the host helpers
+    np.testing.assert_array_equal(ht.join64(ht.split64(base)), base)
+
+    # duplicate pairs combine exactly once per key (pair dedup)
+    dup = jnp.asarray(ht.split64(np.asarray(
+        [99, 99 + (1 << 32), 99, 99 + (1 << 32)], np.int64)))
+    t = ht.apply_gradients(t, opt, init, dup,
+                           jnp.ones((4, DIM), jnp.float32))
+    rows = np.asarray(ht.pull(t, dup[:2], None))
+    # sgd with count semantics: grads summed per unique key
+    np.testing.assert_allclose(rows[:, 0], -2.0, rtol=1e-6)
+
+    # pull of an absent wide key returns the deterministic init row (zeros
+    # under constant-0) and EMPTY-hi pairs return zeros
+    probe = jnp.asarray(ht.split64(np.asarray([424242 + (9 << 33)],
+                                              np.int64)))
+    np.testing.assert_allclose(np.asarray(ht.pull(t, probe, init)), 0.0)
+
+    # wide tables refuse narrow queries ([B, F] narrow-table indices are
+    # legitimately any-shape, so only the wide side can police shapes)
+    with pytest.raises(ValueError, match="key-shape mismatch"):
+        ht.pull(t, jnp.asarray([1, 2], jnp.int32), None)
+
+
+def test_wide_keys_insert_rows_and_find():
+    """Load-path delivery + find on a wide-key table."""
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer({"category": "default"})
+    t = ht.create_hash_table(meta, opt, capacity=256, key_width=64)
+    k64 = np.asarray([7, 7 + (1 << 32), (3 << 45) + 1], np.int64)
+    pairs = jnp.asarray(ht.split64(k64))
+    w = jnp.asarray(np.eye(3, DIM, dtype=np.float32) * 5.0)
+    t = ht.insert_rows(t, pairs, w)
+    assert int(t.insert_failures) == 0
+    slots = ht.find_rows(t.keys, pairs)
+    assert (np.asarray(slots) >= 0).all()
+    assert len(set(np.asarray(slots).tolist())) == 3
+    got = np.asarray(ht.pull(t, pairs, None))
+    np.testing.assert_allclose(got, np.asarray(w), rtol=1e-6)

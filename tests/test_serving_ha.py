@@ -161,3 +161,54 @@ def _tail(proc, n=20):
     except Exception:  # noqa: BLE001
         out = ""
     return "\n".join((out or "").splitlines()[-n:])
+
+
+def test_binary_lookup_parity(model_dir):
+    """lookup_bin (packed-bytes data plane) returns the same rows as the
+    JSON lookup — the serving-grade protocol, reference RpcView role."""
+    port = _free_port()
+    proc = ha.spawn_replica(port, load=[f"{SIGN}={model_dir}"])
+    try:
+        ep = f"127.0.0.1:{port}"
+        assert ha.wait_ready(ep, sign=SIGN), _tail(proc)
+        router = ha.RoutingClient([ep], timeout=15.0)
+        idx = np.asarray([1, 7, 63], np.int32)
+        a = router.lookup(SIGN, "emb", idx)
+        b = router.lookup_bin(SIGN, "emb", idx)
+        np.testing.assert_array_equal(a, b)
+    finally:
+        proc.kill()
+
+
+def test_peer_row_restore_without_dump(model_dir, tmp_path):
+    """The dump store dies AFTER boot; a respawned replica must rebuild
+    purely from a living peer's memory (the reference's coordinated-restore
+    iterator, EmbeddingRestoreOperator.cpp:12-106) — catalog hand-off alone
+    is not enough when the URI is unreadable."""
+    import shutil
+    # work on a private copy of the model dir so other tests keep theirs
+    mdir = str(tmp_path / "model")
+    shutil.copytree(model_dir, mdir)
+    ports = [_free_port() for _ in range(2)]
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    procs = {}
+    try:
+        procs[0] = ha.spawn_replica(ports[0], load=[f"{SIGN}={mdir}"])
+        assert ha.wait_ready(eps[0], sign=SIGN), _tail(procs[0])
+        # the checkpoint store is lost
+        shutil.rmtree(mdir)
+        # a replacement replica boots with only a living peer
+        procs[1] = ha.spawn_replica(ports[1], peers=[eps[0]])
+        assert ha.wait_ready(eps[1], sign=SIGN, timeout=180.0), \
+            _tail(procs[1])
+        # the restored replica serves the right rows BY ITSELF
+        solo = ha.RoutingClient([eps[1]], timeout=15.0)
+        rows = solo.lookup(SIGN, "emb", [1, 7, 63])
+        np.testing.assert_allclose(rows, 0.5, rtol=1e-6)
+        # and survives the original dying (it holds real state, not a proxy)
+        procs[0].kill()
+        procs[0].wait()
+        rows = solo.lookup(SIGN, "emb", [0, 2])
+        np.testing.assert_allclose(rows, 0.5, rtol=1e-6)
+    finally:
+        _cleanup(procs)

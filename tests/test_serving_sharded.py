@@ -1,0 +1,156 @@
+"""Sharded multi-process serving: shard groups x replicas.
+
+The reference serves models LARGER than one process by placing shard x
+replica over PS nodes: every variable's key space is partitioned across
+server processes and a pull fans out per-shard requests
+(/root/reference/openembedding/client/Model.cpp:153-186). Here: G serving
+processes each load the slice ids/keys ≡ k (mod G) of the checkpoint, a
+ShardedRoutingClient partitions lookups by owner and merges rows, and each
+shard group carries its own replicas for HA (killing one replica of a group
+keeps service alive via its peer — the chaos invariant per group).
+"""
+
+import signal
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+from openembedding_tpu import checkpoint as ckpt
+from openembedding_tpu.parallel.mesh import create_mesh
+from openembedding_tpu.serving import ha
+
+DIM = 4
+VOCAB = 64
+SIGN = "sharded-model-1"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def sharded_model(tmp_path_factory, devices8):
+    """Checkpoint with row-distinguishable values + the expected rows."""
+    path = str(tmp_path_factory.mktemp("sharded") / "model")
+    mesh = create_mesh(1, 1, jax.devices()[:1])
+    specs = (
+        EmbeddingSpec(name="emb", input_dim=VOCAB, output_dim=DIM,
+                      initializer={"category": "normal", "stddev": 1.0}),
+        EmbeddingSpec(name="hsh", input_dim=-1, output_dim=DIM,
+                      hash_capacity=512,
+                      initializer={"category": "constant", "value": 0.0},
+                      optimizer={"category": "sgd", "learning_rate": 1.0}),
+    )
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(jax.random.PRNGKey(3))
+    # make hash rows exist with value -key/100 (sgd on constant grads)
+    hkeys = jnp.asarray(np.arange(1, 41, dtype=np.int32))
+    rows = coll.pull(states, {"hsh": hkeys}, batch_sharded=False)
+    g = jnp.broadcast_to((np.arange(1, 41, dtype=np.float32) / 100.0)
+                         [:, None], rows["hsh"].shape)
+    states = coll.apply_gradients(states, {"hsh": hkeys}, {"hsh": g},
+                                  batch_sharded=False)
+    ckpt.save_checkpoint(path, coll, states, model_sign=SIGN)
+    allv = jnp.arange(VOCAB, dtype=jnp.int32)
+    want_emb = np.asarray(
+        coll.pull(states, {"emb": allv}, batch_sharded=False)["emb"])
+    want_hsh = np.asarray(
+        coll.pull(states, {"hsh": hkeys}, batch_sharded=False,
+                  read_only=True)["hsh"])
+    return path, want_emb, want_hsh
+
+
+def _cleanup(procs):
+    for p in procs.values():
+        if p and p.poll() is None:
+            p.kill()
+
+
+def _tail(proc, n=20):
+    try:
+        out = proc.stdout.read() if proc.poll() is not None else ""
+    except Exception:  # noqa: BLE001
+        out = ""
+    return "\n".join((out or "").splitlines()[-n:])
+
+
+def _lookup_retry(fn, deadline_s=60.0):
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            return fn()
+        except ConnectionError as e:
+            if "timed out" not in str(e) or time.time() >= deadline:
+                raise
+            time.sleep(0.5)
+
+
+def test_shard_groups_with_replicas(sharded_model):
+    path, want_emb, want_hsh = sharded_model
+    G, R = 2, 2
+    ports = [[_free_port() for _ in range(R)] for _ in range(G)]
+    eps = [[f"127.0.0.1:{p}" for p in row] for row in ports]
+    procs = {}
+    try:
+        for k in range(G):
+            for r in range(R):
+                procs[(k, r)] = ha.spawn_replica(
+                    ports[k][r], load=[f"{SIGN}={path}"],
+                    shard_index=k, shard_count=G)
+        for k in range(G):
+            for r in range(R):
+                assert ha.wait_ready(eps[k][r], sign=SIGN), \
+                    _tail(procs[(k, r)])
+
+        router = ha.ShardedRoutingClient(eps, timeout=15.0)
+
+        # full-vocab lookup through the router == the source model
+        got = _lookup_retry(
+            lambda: router.lookup(SIGN, "emb", np.arange(VOCAB)))
+        np.testing.assert_allclose(got, want_emb, rtol=1e-6, atol=1e-7)
+        # hash variable: keys of both parities resolve through their owners
+        hkeys = np.arange(1, 41, dtype=np.int32)
+        got_h = _lookup_retry(lambda: router.lookup(SIGN, "hsh", hkeys))
+        np.testing.assert_allclose(got_h, want_hsh, rtol=1e-6, atol=1e-7)
+
+        # each process holds ONLY its slice: a direct probe of a group-1
+        # endpoint with a group-0-owned id returns a zero row
+        solo = ha.RoutingClient([eps[1][0]], timeout=15.0)
+        direct = _lookup_retry(lambda: solo.lookup(SIGN, "emb", [2]))
+        np.testing.assert_array_equal(direct, 0.0)
+        # /health reports the shard geometry
+        from openembedding_tpu.serving.rest import probe_health
+        h = probe_health(eps[1][0], timeout=10.0)
+        m = [x for x in h["models"] if x["model_sign"] == SIGN][0]
+        assert (m["shard_index"], m["shard_count"]) == (1, G)
+
+        # chaos: kill one replica of group 0 — its peer keeps the group
+        # alive, service stays correct end-to-end
+        procs[(0, 0)].send_signal(signal.SIGKILL)
+        procs[(0, 0)].wait()
+        for _ in range(3):
+            got = _lookup_retry(
+                lambda: router.lookup(SIGN, "emb", np.arange(VOCAB)))
+            np.testing.assert_allclose(got, want_emb, rtol=1e-6, atol=1e-7)
+
+        # kill the group's LAST replica: lookups hitting shard 0 now fail —
+        # per-group replica exhaustion is an outage, not silent zeros
+        procs[(0, 1)].send_signal(signal.SIGKILL)
+        procs[(0, 1)].wait()
+        with pytest.raises(ConnectionError):
+            router.lookup(SIGN, "emb", np.asarray([0]))  # shard-0-owned
+        # shard 1 ids still serve
+        got1 = _lookup_retry(
+            lambda: router.lookup(SIGN, "emb", np.asarray([1, 3])))
+        np.testing.assert_allclose(got1, want_emb[[1, 3]], rtol=1e-6,
+                                   atol=1e-7)
+    finally:
+        _cleanup(procs)
