@@ -18,6 +18,7 @@ MFU would flatter it: the dense MLP is a small fraction of the work).
 """
 
 import argparse
+import gc
 import json
 import sys
 import time
@@ -157,6 +158,9 @@ def run_config(name, config, *, steps, warmup, repeats=5):
             jax.block_until_ready(jax.tree.leaves(emb))
             stage["update_ms"] = round(1000 * (time.perf_counter() - t0)
                                        / steps, 3)
+            # the isolated-update result is a full second copy of every
+            # table — release it before the next timed block/config
+            del emb, rows, grads
     except Exception as e:  # noqa: BLE001 — breakdown is best-effort
         stage["stage_error"] = f"{type(e).__name__}: {e}"
 
@@ -493,13 +497,18 @@ states = coll.init(jax.random.PRNGKey(0))
 nbytes = sum(x.nbytes for x in jax.tree.leaves(states))
 d = tempfile.mkdtemp(prefix="bench_ckpt_local_")
 try:
-    t0 = time.perf_counter()
-    ckpt.save_checkpoint(d, coll, states)
-    save_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    loaded = ckpt.load_checkpoint(d, coll)
-    jax.block_until_ready(jax.tree.leaves(loaded))
-    load_s = time.perf_counter() - t0
+    # two passes, best-of: the first pays compile + cold page cache, and
+    # the parent bench process's device client adds host noise
+    save_s = load_s = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ckpt.save_checkpoint(d, coll, states)
+        save_s = min(save_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        loaded = ckpt.load_checkpoint(d, coll)
+        jax.block_until_ready(jax.tree.leaves(loaded))
+        load_s = min(load_s, time.perf_counter() - t0)
+        del loaded
 finally:
     shutil.rmtree(d, ignore_errors=True)
 print(json.dumps({{"gb": nbytes / 1e9, "save_s": save_s,
@@ -508,6 +517,8 @@ print(json.dumps({{"gb": nbytes / 1e9, "save_s": save_s,
     env = {**os.environ}
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
+    # the CPU-backend child must not claim the TPU tunnel at start
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     out = subprocess.run([_sys.executable, "-c", code], env=env,
                          capture_output=True, text=True, timeout=1200)
     if out.returncode != 0:
@@ -606,6 +617,13 @@ def main(argv=None):
         except Exception as e:  # noqa: BLE001 — a config too big for this
             # chip (OOM) must not kill the rest of the suite
             r = {"metric": name, "error": f"{type(e).__name__}: {e}"}
+        finally:
+            # drop every compiled program + cached table reference between
+            # configs: a 9 GB bigvocab state pinned by a program cache OOMs
+            # every config after it on a 16 GB chip
+            gc.collect()
+            jax.clear_caches()
+            gc.collect()
         results.append(r)
         if args.suite or args.configs:
             print(json.dumps(r), flush=True)

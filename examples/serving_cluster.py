@@ -5,12 +5,17 @@ The TPU-native counterpart of the reference's serving examples
 TF-Serving — plus the controller cluster of documents/en/serving.md):
 
     python examples/serving_cluster.py --replicas 2 --steps 20
+    python examples/serving_cluster.py --shards 2 --replicas 2   # 2x2 grid
 
 trains a small DeepFM, saves a version-stamped checkpoint, boots N replica
 daemons (one loads the model, the rest restore the catalog from a living
 peer), then issues lookups through the failover router and prints the
 cluster's liveness and /metrics endpoints. Kill a replica while it runs to
 watch the router ride through (the chaos test automates exactly that).
+``--shards G`` demonstrates SHARD-GROUP serving for models larger than one
+process: G groups x --replicas processes each load only ids = k (mod G),
+and a ShardedRoutingClient fans lookups to owners and merges rows — the
+reference's shard x replica placement (client/Model.cpp:153-186).
 """
 
 import argparse
@@ -22,6 +27,9 @@ import time
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--shards", type=int, default=1,
+                   help=">1: shard-group serving (each process holds a "
+                        "1/G slice of every table)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--lookups", type=int, default=5)
     args = p.parse_args(argv)
@@ -69,18 +77,43 @@ def main(argv=None):
             s.bind(("127.0.0.1", 0))
             return s.getsockname()[1]
 
-    ports = [free_port() for _ in range(args.replicas)]
-    eps = [f"127.0.0.1:{pt}" for pt in ports]
-    procs = [ha.spawn_replica(ports[0], load=[f"{sign}={model_dir}"])]
-    assert ha.wait_ready(eps[0], sign=sign), "first replica failed"
-    for pt in ports[1:]:
-        procs.append(ha.spawn_replica(pt, peers=[eps[0]]))
-    for ep in eps[1:]:
-        assert ha.wait_ready(ep, sign=sign), f"replica {ep} failed"
-    print(f"cluster up: {eps}")
+    if args.shards > 1:
+        # shard groups x replicas: every process loads its slice directly
+        groups = [[free_port() for _ in range(args.replicas)]
+                  for _ in range(args.shards)]
+        eps = [[f"127.0.0.1:{pt}" for pt in row] for row in groups]
+        procs = []
+        for k, row in enumerate(groups):
+            for pt in row:
+                procs.append(ha.spawn_replica(
+                    pt, load=[f"{sign}={model_dir}"],
+                    shard_index=k, shard_count=args.shards))
+        for i, ep in enumerate(ep for row in eps for ep in row):
+            if not ha.wait_ready(ep, sign=sign, timeout=300.0):
+                pr = procs[i]
+                pr.kill()
+                out = (pr.stdout.read() or "") if pr.stdout else ""
+                for other in procs:   # no orphaned daemons on failure
+                    other.kill()
+                raise AssertionError(
+                    f"replica {ep} failed; last output:\n"
+                    + "\n".join(out.splitlines()[-15:]))
+        print(f"shard-group cluster up: {eps}")
+        flat_eps = [ep for row in eps for ep in row]
+    else:
+        ports = [free_port() for _ in range(args.replicas)]
+        flat_eps = eps = [f"127.0.0.1:{pt}" for pt in ports]
+        procs = [ha.spawn_replica(ports[0], load=[f"{sign}={model_dir}"])]
+        assert ha.wait_ready(eps[0], sign=sign, timeout=300.0), "first replica failed"
+        for pt in ports[1:]:
+            procs.append(ha.spawn_replica(pt, peers=[eps[0]]))
+        for ep in eps[1:]:
+            assert ha.wait_ready(ep, sign=sign, timeout=300.0), f"replica {ep} failed"
+        print(f"cluster up: {eps}")
 
     try:
-        router = ha.RoutingClient(eps)
+        router = (ha.ShardedRoutingClient(eps) if args.shards > 1
+                  else ha.RoutingClient(eps))
         for n in router.nodes():
             print(f"  node {n['endpoint']}: alive={n['alive']} "
                   f"models={n['models']}")
@@ -90,9 +123,10 @@ def main(argv=None):
             print(f"lookup fields[0:8] -> shape {rows.shape}, "
                   f"|row0|={np.abs(rows[0]).sum():.4f}")
             time.sleep(0.2)
-        print(f"metrics: curl http://{eps[0]}/metrics")
-        print(f"cluster: curl http://{eps[1] if len(eps) > 1 else eps[0]}"
-              "/cluster")
+        ep0 = flat_eps[0]
+        print(f"metrics: curl http://{ep0}/metrics")
+        print(f"cluster: curl http://"
+              f"{flat_eps[1] if len(flat_eps) > 1 else ep0}/cluster")
     finally:
         for pr in procs:
             pr.kill()

@@ -204,28 +204,67 @@ def save_checkpoint(path: str,
     _sync("ckpt_done")
 
 
+_SAVE_WINDOW_BYTES = 256 << 20
+
+
 def _save_array_var(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
                     include_optimizer: bool) -> None:
     """Stream one bounded variable to ``<vdir>/{weights,slot_*}.npy``.
 
     Arrays are written in *logical id order* (only the real vocab rows —
     padding rows differ across mesh shapes and are unreachable), so the
-    checkpoint is shard-topology independent. Each shard's physical block
-    maps to logical positions with vectorized index math; host memory stays
-    bounded by the block size.
+    checkpoint is shard-topology independent. The writer walks LOGICAL
+    windows: each shard's contribution to a window is a CONTIGUOUS slice of
+    its device buffer (device reads stay bulk transfers), the mod-layout
+    interleave happens in a RAM staging buffer, and the file is written
+    strictly sequentially — strided memmap writes measured 0.015 GB/s on
+    local disk (page-granularity random IO); sequential windows run at disk
+    bandwidth. Host memory stays bounded by the window size.
     """
     targets = {"weights": state.weights}
     if include_optimizer:
         for sname, sval in state.slots.items():
             targets[f"slot_{sname}"] = sval
+    S, rps = sspec.num_shards, sspec.rows_per_shard
     for fname, arr in targets.items():
+        dtype = np.dtype(arr.dtype)
+        row_shape = arr.shape[1:]
+        row_bytes = max(1, int(np.prod(row_shape, dtype=np.int64))
+                        * dtype.itemsize)
+        win = max(1, _SAVE_WINDOW_BYTES // row_bytes)
+        shards = sorted(
+            (s for s in arr.addressable_shards if s.replica_id == 0),
+            key=lambda s: s.index[0].start or 0)
         mm = np.lib.format.open_memmap(
             os.path.join(vdir, fname + ".npy"), mode="w+",
-            dtype=np.dtype(arr.dtype), shape=(vocab,) + arr.shape[1:])
-        for phys_start, block in _iter_shard_blocks(arr):
-            sl, nv = _logical_slice(sspec, vocab, phys_start, block.shape[0])
-            if nv:
-                mm[sl] = block[:nv]
+            dtype=dtype, shape=(vocab,) + row_shape)
+        for l0 in range(0, vocab, win):
+            l1 = min(vocab, l0 + win)
+            buf = np.empty((l1 - l0,) + row_shape, dtype)
+            for sh in shards:
+                p0 = sh.index[0].start or 0
+                s = p0 // rps
+                if sspec.layout == "mod":
+                    # shard s owns logical ids l = local * S + s
+                    lo_s = max(0, -(-(l0 - s) // S))
+                    hi_s = max(0, -(-(l1 - s) // S))
+                    hi_s = min(hi_s, sh.data.shape[0])
+                    if hi_s <= lo_s:
+                        continue
+                    block = np.asarray(jax.device_get(
+                        sh.data[lo_s:hi_s]))
+                    a = s + lo_s * S - l0
+                    buf[a:a + (hi_s - lo_s - 1) * S + 1:S] = block
+                else:
+                    # div layout: logical == physical position
+                    a = max(l0, p0)
+                    b = min(l1, p0 + sh.data.shape[0])
+                    if b <= a:
+                        continue
+                    block = np.asarray(jax.device_get(
+                        sh.data[a - p0:b - p0]))
+                    buf[a - l0:b - l0] = block
+            mm[l0:l1] = buf
         mm.flush()
         del mm
 
@@ -284,9 +323,11 @@ def _save_hash_var(vdir: str, state, include_optimizer: bool,
     files for multi-host dumps (each host writes only its shards).
     """
     empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+    wide = state.keys.ndim == 2
     total = sum(
-        int(jax.device_get(jnp.sum(s.data != np.asarray(
-            empty, dtype=np.dtype(state.keys.dtype)))))
+        int(jax.device_get(jnp.sum(
+            (s.data[:, 1] if wide else s.data) != np.asarray(
+                empty, dtype=np.dtype(state.keys.dtype)))))
         for s in state.keys.addressable_shards if s.replica_id == 0)
     targets = {"keys": state.keys, "weights": state.weights}
     if include_optimizer:
@@ -302,7 +343,9 @@ def _save_hash_var(vdir: str, state, include_optimizer: bool,
         }
         offset = 0
         for blocks in _aligned_shard_blocks(targets):
-            live = blocks["keys"] != empty
+            bk = blocks["keys"]
+            # wide ([cap, 2]) keys: a slot is free iff its HI word is EMPTY
+            live = (bk[:, 1] != empty) if bk.ndim == 2 else (bk != empty)
             n = int(live.sum())
             if n:
                 for fname, block in blocks.items():
@@ -529,7 +572,11 @@ def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
         offset = 0
         for chunk in _aligned_reader_chunks(r, names, size):
             if id_field:
-                ids = chunk[id_field].astype(np.int64)
+                ids = chunk[id_field]
+                if ids.ndim == 2:
+                    # wide (pair) hash dump: join to 64-bit logical ids
+                    ids = hash_lib.join64(ids)
+                ids = ids.astype(np.int64)
                 if from_hash and ids.size and (
                         ids.min() < 0 or ids.max() >= vocab):
                     bad = ids[(ids < 0) | (ids >= vocab)][0]
@@ -738,17 +785,29 @@ def _insert_hash_rows(state, data, collection, sspec, with_opt,
             raw_keys = np.arange(offset, offset + got, dtype=np.int64)
             offset += got
         if from_array:
-            # logical ids are bounded by the dump vocab; refuse ids the
-            # table's key dtype cannot hold rather than alias mod 2^32
-            if raw_keys.size and int(raw_keys.max()) > np.iinfo(
-                    key_dtype).max:
-                raise ValueError(
-                    f"array->hash conversion: logical id {raw_keys.max()} "
-                    f"does not fit key dtype {key_dtype}")
-            raw_keys = raw_keys.astype(key_dtype)
-        ck = np.full((size,), empty, dtype=raw_keys.dtype)
+            if state.keys.ndim == 2:
+                # wide target: logical id i becomes the pair (lo=i, hi=0)
+                # == the 64-bit key i (split64 of the int64 id)
+                raw_keys = hash_lib.split64(raw_keys.astype(np.int64))
+            else:
+                # logical ids are bounded by the dump vocab; refuse ids the
+                # table's key dtype cannot hold rather than alias mod 2^32
+                if raw_keys.size and int(raw_keys.max()) > np.iinfo(
+                        key_dtype).max:
+                    raise ValueError(
+                        f"array->hash conversion: logical id "
+                        f"{raw_keys.max()} does not fit key dtype "
+                        f"{key_dtype}")
+                raw_keys = raw_keys.astype(key_dtype)
+        # wide pair keys pad with all-EMPTY rows (hi EMPTY marks padding)
+        ck = np.full((size,) + raw_keys.shape[1:], empty,
+                     dtype=raw_keys.dtype)
         ck[:got] = raw_keys
         if shard_slice is not None:
+            if raw_keys.ndim == 2:
+                raise ValueError(
+                    "serving shard slices over wide-key dumps are not "
+                    "supported yet; serve wide-key models unsliced")
             # serving shard group: non-owned keys become EMPTY (skipped by
             # the insert path); owner rule matches the router's key % G
             k, G = shard_slice

@@ -62,8 +62,11 @@ class EmbeddingSpec:
     num_shards: int = -1             # -1 => one shard per device (a2a plane)
     hash_capacity: int = 2**20       # reserve_items for hash variables
     layout: str = "mod"              # array-table row layout
-    key_dtype: str = "int32"         # hash key storage; "int64" needs x64 for
-                                     # the reference's full 2^62 key space
+    key_dtype: str = "int32"         # hash key storage; "wide" = [.., 2]
+                                     # int32 (lo, hi) pairs = full 64-bit
+                                     # space with x64 OFF (ids via
+                                     # hash_table.split64); "int64" needs
+                                     # the global x64 flag
     plane: str = "a2a"               # "a2a" owner-routed | "psum" baseline
     a2a_capacity: int = 0            # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0           # auto bucket = slack * mean
@@ -111,6 +114,11 @@ class EmbeddingCollection:
                 raise ValueError(
                     f"embedding {spec.name!r}: unknown pooling "
                     f"{spec.pooling!r}; known: {ragged.POOLINGS}")
+            if spec.key_dtype == "wide" and spec.pooling is not None:
+                raise ValueError(
+                    f"embedding {spec.name!r}: pooling over wide-key "
+                    "(pair) inputs is not supported; hash the sequence "
+                    "ids into the int32/int64 space instead")
             self.specs[spec.name] = spec
             self._variable_ids[spec.name] = i
             self._optimizers[spec.name] = make_optimizer(
@@ -121,7 +129,8 @@ class EmbeddingCollection:
                 self._shardings[spec.name] = sh.make_hash_sharding_spec(
                     mesh, total_capacity=spec.hash_capacity,
                     num_shards=spec.num_shards, plane=spec.plane,
-                    a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack)
+                    a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack,
+                    key_width=64 if spec.key_dtype == "wide" else 32)
             else:
                 self._shardings[spec.name] = st.make_sharding_spec(
                     spec.meta(), mesh, num_shards=spec.num_shards,
@@ -190,7 +199,8 @@ class EmbeddingCollection:
                         spec.meta(), self._optimizers[name],
                         mesh=self.mesh,
                         spec=self._shardings[name], rng=sub,
-                        key_dtype=jnp.dtype(spec.key_dtype))
+                        key_dtype=jnp.int32 if spec.key_dtype == "wide"
+                        else jnp.dtype(spec.key_dtype))
                 else:
                     states[name] = st.create_sharded_table(
                         spec.meta(), self._optimizers[name],

@@ -188,14 +188,17 @@ def grid_info(mesh, shard_axes: Sequence[str], model_axis: str,
 
 def split_slice(flat: jnp.ndarray, num_parts: int, my_part: jnp.ndarray,
                 fill) -> Tuple[jnp.ndarray, int]:
-    """Pad ``flat`` [n] to a multiple of ``num_parts`` and take slice
-    ``my_part`` of size m = ceil(n / num_parts). Returns (slice, m)."""
+    """Pad ``flat`` [n] (or [n, kc] wide keys) to a multiple of
+    ``num_parts`` and take slice ``my_part`` of size m = ceil(n /
+    num_parts). Returns (slice, m)."""
     n = flat.shape[0]
     m = -(-n // num_parts)
-    padded = jnp.full((m * num_parts,), fill, dtype=flat.dtype)
+    padded = jnp.full((m * num_parts,) + flat.shape[1:], fill,
+                      dtype=flat.dtype)
     padded = padded.at[:n].set(flat)
     start = (my_part * m).astype(jnp.int32)
-    return lax.dynamic_slice(padded, (start,), (m,)), m
+    starts = (start,) + (jnp.zeros((), jnp.int32),) * (flat.ndim - 1)
+    return lax.dynamic_slice(padded, starts, (m,) + flat.shape[1:]), m
 
 
 def split_slice_rows(rows: jnp.ndarray, num_parts: int, my_part: jnp.ndarray
@@ -231,6 +234,8 @@ def exchange_pull(flat_idx: jnp.ndarray,
     must return zero rows for keys it does not own (sentinel included).
     ``owner_fn(keys)`` maps keys to shard ordinals (>= num_shards = do not
     send). The result is replicated over ``split_axes`` again (all_gather).
+    WIDE keys ride as [n, 2] int32 (lo, hi) pairs (x64-off 64-bit space);
+    a pair is padding iff its hi word equals ``sentinel``.
 
     Round 1 routes everything that fits the fixed-capacity buckets; any
     residue (structured key skew) loops through further rounds until the
@@ -240,8 +245,14 @@ def exchange_pull(flat_idx: jnp.ndarray,
     """
     my_part = linear_shard_id(split_axes, split_sizes)
     n = flat_idx.shape[0]
+    wide = flat_idx.ndim == 2
     sl, m = split_slice(flat_idx, math.prod(split_sizes), my_part, sentinel)
-    uniq, inverse, _valid = dedup.unique_indices(sl, m, fill_value=sentinel)
+    if wide:
+        uniq, inverse, _valid = dedup.unique_pairs(sl, m,
+                                                   fill_value=sentinel)
+    else:
+        uniq, inverse, _valid = dedup.unique_indices(sl, m,
+                                                     fill_value=sentinel)
     cap = bucket_capacity(m, num_shards, capacity, slack)
     owners = owner_fn(uniq)
 
@@ -249,7 +260,7 @@ def exchange_pull(flat_idx: jnp.ndarray,
         dest, ok = bucketize(pending, num_shards, cap)
         send = fill_buckets(uniq, dest, num_shards, cap, sentinel)
         req = grid_all_to_all(send, grid_axes, grid_sizes)
-        rows = resolve_fn(req.ravel())
+        rows = resolve_fn(req.reshape((-1, 2)) if wide else req.ravel())
         resp = grid_all_to_all(rows.reshape((num_shards, cap, dim)),
                                grid_axes, grid_sizes)
         flat_resp = resp.reshape((num_shards * cap, dim))
@@ -333,27 +344,37 @@ def exchange_push(flat_idx: jnp.ndarray,
     dim = grads.shape[-1]
     my_part = linear_shard_id(split_axes, split_sizes)
     parts = math.prod(split_sizes)
+    wide = flat_idx.ndim == 2
     sl, m = split_slice(flat_idx, parts, my_part, sentinel)
     g2 = split_slice_rows(grads.reshape((-1, dim)), parts, my_part)
-    uniq, inverse, _valid = dedup.unique_indices(sl, m, fill_value=sentinel)
+    if wide:
+        uniq, inverse, _valid = dedup.unique_pairs(sl, m,
+                                                   fill_value=sentinel)
+    else:
+        uniq, inverse, _valid = dedup.unique_indices(sl, m,
+                                                     fill_value=sentinel)
     summed, counts = dedup.combine_gradients(g2, inverse, m)
     cap = bucket_capacity(m, num_shards, capacity, slack)
     owners = owner_fn(uniq)
     dest, ok = bucketize(owners, num_shards, cap)
+    kw = 2 if wide else 1  # key words per entry in the exchange buffer
 
     def routed(st):
-        kc = jnp.stack([uniq, counts.astype(uniq.dtype)], axis=1)  # [m, 2]
+        ku = uniq if wide else uniq[:, None]
+        kc = jnp.concatenate(
+            [ku, counts.astype(ku.dtype)[:, None]], axis=1)  # [m, kw+1]
         send_kc = fill_buckets(kc, dest, num_shards, cap, sentinel)
         send_g = fill_buckets(summed, dest, num_shards, cap, 0)
         rkc = grid_all_to_all(send_kc, grid_axes, grid_sizes)
         rg = grid_all_to_all(send_g, grid_axes, grid_sizes)
-        k = rkc[..., 0].ravel()
-        rc = rkc[..., 1].ravel().astype(jnp.int32)
-        return apply_fn(st, k, rg.reshape((k.shape[0], dim)), rc)
+        flat_kc = rkc.reshape((-1, kw + 1))
+        k = flat_kc[:, :kw] if wide else flat_kc[:, 0]
+        rc = flat_kc[:, kw].astype(jnp.int32)
+        return apply_fn(st, k, rg.reshape((flat_kc.shape[0], dim)), rc)
 
     def gathered(st):
         ga = tuple(grid_axes)
-        k = lax.all_gather(uniq, ga, tiled=True)
+        k = lax.all_gather(uniq, ga, tiled=True)  # [P*m] or [P*m, 2]
         g = lax.all_gather(summed, ga, tiled=True)
         c = lax.all_gather(counts, ga, tiled=True)
         return apply_fn(st, k, g, c)

@@ -47,6 +47,7 @@ class HashShardingSpec:
     plane: str = "a2a"   # "a2a" | "psum"
     a2a_capacity: int = 0
     a2a_slack: float = 2.0
+    key_width: int = 32  # 64 = [n, 2] int32 (lo, hi) pairs, x64-off
 
     @property
     def shard_axes(self) -> tuple:
@@ -54,10 +55,23 @@ class HashShardingSpec:
             return (self.data_axis, self.model_axis)
         return (self.model_axis,)
 
+    @property
+    def wide(self) -> bool:
+        return self.key_width == 64
+
     def row_spec(self) -> P:
         return P(self.shard_axes)
 
     def owner_shard(self, keys: jnp.ndarray) -> jnp.ndarray:
+        if keys.ndim == 2:
+            # unsigned 64-bit key mod S computed in 32-bit arithmetic
+            # (x64-off): (hi * 2^32 + lo) mod S with 2^32 mod S folded in.
+            # Safe while S < 2^16 (S^2 fits uint32) — far beyond any mesh.
+            s = self.num_shards
+            c = jnp.uint32((1 << 32) % s)
+            lo = keys[:, 0].astype(jnp.uint32)
+            hi = keys[:, 1].astype(jnp.uint32)
+            return (((hi % s) * c + lo % s) % s).astype(jnp.int32)
         # unsigned mod so negative (but valid) hashed keys still land on a
         # deterministic shard; jnp % already yields non-negative for positive
         # divisors, the cast keeps int64/int32 behavior identical.
@@ -69,10 +83,13 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
                             max_probes: int = hash_lib.DEFAULT_MAX_PROBES,
                             plane: str = "a2a",
                             a2a_capacity: int = 0,
-                            a2a_slack: float = 2.0) -> HashShardingSpec:
+                            a2a_slack: float = 2.0,
+                            key_width: int = 32) -> HashShardingSpec:
     """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum")."""
     if plane not in ("a2a", "psum"):
         raise ValueError(f"unknown plane {plane!r}")
+    if key_width not in (32, 64):
+        raise ValueError(f"key_width must be 32 or 64, got {key_width}")
     want = mesh.size if plane == "a2a" else mesh.shape[MODEL_AXIS]
     if num_shards == -1:
         num_shards = want
@@ -83,7 +100,8 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
     cap = hash_lib.round_capacity(-(-total_capacity // num_shards))
     return HashShardingSpec(num_shards=num_shards, capacity_per_shard=cap,
                             max_probes=max_probes, plane=plane,
-                            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack)
+                            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
+                            key_width=key_width)
 
 
 def state_specs(optimizer: SparseOptimizer, dim: int, spec: HashShardingSpec):
@@ -116,7 +134,8 @@ def create_sharded_hash_table(meta: EmbeddingVariableMeta,
     def _init(key):
         return hash_lib.create_hash_table(
             meta, optimizer,
-            capacity=spec.capacity_per_shard, rng=key, key_dtype=key_dtype)
+            capacity=spec.capacity_per_shard, rng=key, key_dtype=key_dtype,
+            key_width=spec.key_width)
 
     fn = shard_map(_init, mesh=mesh,
                    in_specs=(P(),),
@@ -128,6 +147,9 @@ def create_sharded_hash_table(meta: EmbeddingVariableMeta,
 def _mask_non_owned(spec: HashShardingSpec, flat: jnp.ndarray,
                     me: jnp.ndarray) -> jnp.ndarray:
     empty = hash_lib.empty_key(flat.dtype)
+    if flat.ndim == 2:
+        owned = (spec.owner_shard(flat) == me) & (flat[:, 1] != empty)
+        return jnp.where(owned[:, None], flat, empty)
     owned = (spec.owner_shard(flat) == me) & (flat != empty)
     return jnp.where(owned, flat, empty)
 
@@ -148,7 +170,8 @@ def _insert_rows_program(mesh: Mesh, spec: HashShardingSpec,
         local = hash_lib.HashTableState(
             keys=tkeys, weights=tweights, slots=tslots, init_rng=init_rng,
             insert_failures=jnp.zeros((), jnp.int32))
-        masked = _mask_non_owned(spec, k.ravel(), _my_shard(mesh, spec))
+        flat = k.reshape(-1, 2) if spec.wide else k.ravel()
+        masked = _mask_non_owned(spec, flat, _my_shard(mesh, spec))
         new = hash_lib.insert_rows(local, masked, w, srows or None,
                                    max_probes=spec.max_probes)
         failed = lax.psum(new.insert_failures, spec.shard_axes)
@@ -206,7 +229,9 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
             local = hash_lib.HashTableState(
                 keys=keys, weights=weights, slots={}, init_rng=init_rng,
                 insert_failures=jnp.zeros((), jnp.int32))
-            flat = idx.ravel()
+            flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
+            out_shape = (idx.shape[:-1] if spec.wide else idx.shape) \
+                + (dim,)
             sentinel = hash_lib.empty_key(flat.dtype)
 
             def resolve(q):
@@ -215,7 +240,7 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                                      max_probes=spec.max_probes)
 
             def owner(q):
-                valid = q != sentinel
+                valid = (q[:, 1] if spec.wide else q) != sentinel
                 return jnp.where(valid, spec.owner_shard(q),
                                  spec.num_shards).astype(jnp.int32)
 
@@ -225,18 +250,21 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
                 slack=spec.a2a_slack, record_stats=record_stats)
-            return rows.reshape(idx.shape + (dim,))
+            return rows.reshape(out_shape)
     else:
         def _pull(keys, weights, init_rng, idx):
             local = hash_lib.HashTableState(
                 keys=keys, weights=weights, slots={}, init_rng=init_rng,
                 insert_failures=jnp.zeros((), jnp.int32))
-            flat = _mask_non_owned(spec, idx.ravel(),
+            flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
+            out_shape = (idx.shape[:-1] if spec.wide else idx.shape) \
+                + (dim,)
+            flat = _mask_non_owned(spec, flat,
                                    lax.axis_index(spec.model_axis))
             rows = hash_lib.pull(local, flat, initializer,
                                  max_probes=spec.max_probes)
             rows = lax.psum(rows, spec.model_axis)
-            return rows.reshape(idx.shape + (dim,))
+            return rows.reshape(out_shape)
 
     row = spec.row_spec()
     fn = shard_map(_pull, mesh=mesh,
@@ -280,11 +308,11 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
 
         def _apply(keys, weights, slots, init_rng, idx, g):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
-            flat = idx.ravel()
+            flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
             sentinel = hash_lib.empty_key(flat.dtype)
 
             def owner(q):
-                valid = q != sentinel
+                valid = (q[:, 1] if spec.wide else q) != sentinel
                 return jnp.where(valid, spec.owner_shard(q),
                                  spec.num_shards).astype(jnp.int32)
 
@@ -316,7 +344,7 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                     lax.psum(fails, spec.shard_axes))
     else:
         def _apply(keys, weights, slots, init_rng, idx, g):
-            flat = idx.ravel()
+            flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
             g2 = g.reshape(-1, dim)
             if batch_sharded:
                 flat = lax.all_gather(flat, spec.data_axis, tiled=True)

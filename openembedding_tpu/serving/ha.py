@@ -236,15 +236,21 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
         if spec.use_hash:
             state = states[name]
             empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+            wide = state.keys.ndim == 2
             while total is None or offset < total:
                 ids, rows, total = fetch_rows_page(
                     endpoint, sign, name, offset, page, timeout)
                 offset += page
                 if not ids.size:
                     continue
-                ck = np.full((page,), empty,
-                             dtype=np.dtype(state.keys.dtype))
-                ck[:ids.size] = ids
+                if wide:
+                    # ids travel joined as int64; re-split for the table
+                    ck = np.full((page, 2), empty, np.int32)
+                    ck[:ids.size] = hash_lib.split64(ids)
+                else:
+                    ck = np.full((page,), empty,
+                                 dtype=np.dtype(state.keys.dtype))
+                    ck[:ids.size] = ids
                 cw = np.zeros((page,) + rows.shape[1:], rows.dtype)
                 cw[:ids.size] = rows
                 import jax.numpy as jnp
@@ -309,6 +315,11 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
     child_env.setdefault("JAX_PLATFORMS", "cpu")
     child_env.setdefault("JAX_NUM_CPU_DEVICES", str(devices))
     child_env.pop("XLA_FLAGS", None)
+    if child_env.get("JAX_PLATFORMS") == "cpu":
+        # a CPU-only replica must not register the host's TPU-tunnel PJRT
+        # plugin at interpreter start: plugin session claims can hang the
+        # child when the tunnel is unhealthy, and the replica never uses it
+        child_env.pop("PALLAS_AXON_POOL_IPS", None)
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     child_env["PYTHONPATH"] = root + os.pathsep + child_env.get(

@@ -78,8 +78,15 @@ class ServingModel:
             hi = min(offset + limit, total)
             keys = np.asarray(jax.device_get(state.keys[offset:hi]))
             from .. import hash_table as hash_lib
-            live = keys != hash_lib.empty_key(keys.dtype)
-            ids = keys[live].astype(np.int64)
+            empty = hash_lib.empty_key(keys.dtype)
+            if keys.ndim == 2:
+                # wide (64-bit pair) keys: free iff the HI word is EMPTY;
+                # ids travel as joined int64 (the wire is 64-bit anyway)
+                live = keys[:, 1] != empty
+                ids = hash_lib.join64(keys[live])
+            else:
+                live = keys != empty
+                ids = keys[live].astype(np.int64)
             # weights are slot-parallel to keys: slice directly instead of
             # re-probing the table for slots already in hand (restore
             # wall-clock stays memcpy-bound, not probe-bound)

@@ -322,3 +322,56 @@ def test_collection_planes_agree(devices8):
     for k in got_a2a:
         np.testing.assert_allclose(got_a2a[k], got_psum[k],
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_a2a_wide_keys_sharded_matches_single(devices8):
+    """WIDE (64-bit pair, x64-off) keys through the sharded a2a plane:
+    parity with a single wide table, keys spanning >2^32 with colliding
+    lo words — the default-configuration full-width key space (the
+    reference's 2^62 hashed ids) without a dedicated x64 process."""
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer({"category": "adagrad", "learning_rate": 0.1})
+    init = {"category": "constant", "value": 0.25}
+    spec = sh.make_hash_sharding_spec(mesh, total_capacity=4096,
+                                      plane="a2a", key_width=64)
+    assert spec.wide
+    state = sh.create_sharded_hash_table(meta, opt, mesh=mesh, spec=spec)
+    assert state.keys.ndim == 2
+    single = hash_lib.create_hash_table(meta, opt, capacity=4096,
+                                        rng=jax.random.PRNGKey(0),
+                                        key_width=64)
+
+    rng = np.random.RandomState(7)
+    B = 64
+    for step in range(3):
+        lo = rng.randint(0, 1 << 16, size=B).astype(np.int64)
+        hi = rng.randint(0, 1 << 28, size=B).astype(np.int64)
+        k64 = lo + (hi << 32)           # heavy lo-word collisions
+        k64[1] = k64[0]                 # duplicates combine
+        pairs = jnp.asarray(hash_lib.split64(k64))
+        g = rng.randn(B, DIM).astype(np.float32)
+        jg = jnp.asarray(g)
+        got = sh.pull_sharded(state, pairs, init, mesh=mesh, spec=spec,
+                              batch_sharded=False)
+        want = hash_lib.pull(single, pairs, init)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+        state = sh.apply_gradients_sharded(state, opt, init, pairs, jg,
+                                           mesh=mesh, spec=spec,
+                                           batch_sharded=False)
+        single = hash_lib.apply_gradients(single, opt, init, pairs, jg)
+        assert int(state.insert_failures) == 0
+
+    got = sh.pull_sharded(state, pairs, None, mesh=mesh, spec=spec,
+                          batch_sharded=False)
+    want = hash_lib.pull(single, pairs, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # distinct rows for keys sharing lo words: no mod-2^32 aliasing
+    probe = jnp.asarray(hash_lib.split64(
+        np.asarray([42, 42 + (1 << 32)], np.int64)))
+    r = sh.pull_sharded(state, probe, init, mesh=mesh, spec=spec,
+                        batch_sharded=False)
+    w = hash_lib.pull(single, probe, init)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(w), rtol=1e-6)

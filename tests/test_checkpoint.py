@@ -466,3 +466,78 @@ def test_bounded_vocab_mismatch_still_rejected(devices8, tmp_path):
         mesh)
     with pytest.raises(ValueError, match="meta mismatch"):
         ckpt.load_checkpoint(p, coll2)
+
+
+def test_wide_key_collection_roundtrip(devices8, tmp_path):
+    """key_dtype='wide' hash variables (64-bit pair keys, x64 off) train
+    through the collection and survive a checkpoint round trip."""
+    from openembedding_tpu import hash_table as hl
+    mesh = create_mesh(2, 4, devices8)
+    specs = (EmbeddingSpec(name="w", input_dim=-1, output_dim=DIM,
+                           hash_capacity=2048, key_dtype="wide",
+                           optimizer={"category": "adagrad",
+                                      "learning_rate": 0.1}),)
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    assert states["w"].keys.ndim == 2
+    rng = np.random.RandomState(2)
+    k64 = (rng.randint(0, 1 << 20, 32).astype(np.int64)
+           + (rng.randint(0, 1 << 20, 32).astype(np.int64) << 32))
+    pairs = jnp.asarray(hl.split64(k64))
+    for _ in range(2):
+        rows = coll.pull(states, {"w": pairs}, batch_sharded=False)
+        states = coll.apply_gradients(
+            states, {"w": pairs}, {"w": jnp.ones_like(rows["w"]) * 0.1},
+            batch_sharded=False)
+    want = coll.pull(states, {"w": pairs}, batch_sharded=False,
+                     read_only=True)["w"]
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, coll, states)
+    loaded = ckpt.load_checkpoint(p, coll)
+    got = coll.pull(loaded, {"w": pairs}, batch_sharded=False,
+                    read_only=True)["w"]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # keys sharing lo words stay distinct through the round trip
+    probe = jnp.asarray(hl.split64(np.asarray(
+        [int(k64[0]), int(k64[0]) ^ (1 << 40)], np.int64)))
+    r = np.asarray(coll.pull(loaded, {"w": probe}, batch_sharded=False,
+                             read_only=True)["w"])
+    assert (np.abs(r[0] - r[1]) > 1e-9).any() or (r[1] == 0).all()
+
+
+def test_category_hotswap_array_to_wide_hash(devices8, tmp_path):
+    """Array dump -> WIDE-key hash variable: logical ids become (lo, hi=0)
+    pairs; weights bit-equal; and a wide hash dump converts back to a
+    bounded variable via joined 64-bit ids."""
+    from openembedding_tpu import hash_table as hl
+    mesh = create_mesh(2, 4, devices8)
+    coll_a = EmbeddingCollection(
+        (EmbeddingSpec(name="v", input_dim=VOCAB, output_dim=DIM,
+                       initializer={"category": "normal", "stddev": 1.0},
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 0.5}),), mesh)
+    states = coll_a.init(jax.random.PRNGKey(4))
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, coll_a, states)
+
+    coll_w = EmbeddingCollection(
+        (EmbeddingSpec(name="v", input_dim=-1, output_dim=DIM,
+                       hash_capacity=4 * VOCAB, key_dtype="wide",
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 0.5}),), mesh)
+    loaded = ckpt.load_checkpoint(p, coll_w)
+    allv = jnp.arange(VOCAB, dtype=jnp.int32)
+    want = np.asarray(
+        coll_a.pull(states, {"v": allv}, batch_sharded=False)["v"])
+    pairs = jnp.asarray(hl.split64(np.arange(VOCAB, dtype=np.int64)))
+    got = np.asarray(coll_w.pull(loaded, {"v": pairs}, batch_sharded=False,
+                                 read_only=True)["v"])
+    np.testing.assert_array_equal(got, want)
+
+    # wide hash dump -> bounded array (keys joined back to logical ids)
+    p2 = str(tmp_path / "m2")
+    ckpt.save_checkpoint(p2, coll_w, loaded)
+    loaded_a = ckpt.load_checkpoint(p2, coll_a)
+    got_a = np.asarray(
+        coll_a.pull(loaded_a, {"v": allv}, batch_sharded=False)["v"])
+    np.testing.assert_array_equal(got_a, want)

@@ -30,6 +30,7 @@ def test_two_process_training_step(tmp_path, devices8):
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PYTHONPATH": root + os.pathsep + os.environ.get("PYTHONPATH", "")}
     env.pop("XLA_FLAGS", None)  # workers set their own device counts
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU child: skip tunnel plugin
     procs = [subprocess.Popen(
         [sys.executable, _WORKER, str(r), str(port), ckpt_dir], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)

@@ -31,3 +31,30 @@ def test_example_wdl_psum_plane(devices8):
 def test_example_lr_hybrid_and_history(devices8):
     _run(["--model", "lr", *BASE, "--no-fused",
           "--sparse_as_dense", "2048", "--hist_len", "4"])
+
+
+def test_example_tfrecord_input(devices8, tmp_path):
+    """--format tfrecord: the dependency-free TFRecord reader feeds the
+    training pipeline (the reference's criteo_tfrecord.py data path)."""
+    import numpy as np
+    from openembedding_tpu.data import tfrecord as tfr
+    rng = np.random.RandomState(0)
+    path = tmp_path / "tf-part.00001"
+    with open(path, "wb") as f:
+        for _ in range(300):
+            feats = {"label": [int(rng.randint(0, 2))]}
+            for j in range(1, 14):
+                feats[f"I{j}"] = [float(np.float32(rng.randn()))]
+            for j in range(1, 27):
+                feats[f"C{j}"] = [int(rng.randint(0, 2048))]
+            tfr.write_record(f, tfr.make_example(feats))
+    _run(["--model", "deepfm", *BASE, "--data", str(path),
+          "--format", "tfrecord"])
+
+
+def test_example_sharded_serving_cluster(devices8):
+    """serving_cluster --shards 2: the shard-group demo boots a 2x1 grid
+    and serves through the ShardedRoutingClient."""
+    from examples import serving_cluster
+    assert serving_cluster.main(["--shards", "2", "--replicas", "1",
+                                 "--steps", "2", "--lookups", "1"]) == 0

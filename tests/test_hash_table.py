@@ -164,6 +164,7 @@ def test_int64_keys_full_width(devices8):
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PYTHONPATH": root + os.pathsep + os.environ.get("PYTHONPATH", "")}
     env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU child: skip tunnel plugin
     out = subprocess.run([sys.executable, worker], env=env,
                          capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, out.stdout + out.stderr

@@ -212,3 +212,52 @@ def test_peer_row_restore_without_dump(model_dir, tmp_path):
         np.testing.assert_allclose(rows, 0.5, rtol=1e-6)
     finally:
         _cleanup(procs)
+
+
+def test_peer_row_restore_wide_keys(tmp_path, devices8):
+    """Peer-to-peer restore of a WIDE-key model: /rows pages carry joined
+    int64 ids, the restorer re-splits them into pairs."""
+    import shutil
+    import jax as _jax
+    import jax.numpy as _jnp
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as _ckpt
+    from openembedding_tpu import hash_table as hl
+    from openembedding_tpu.parallel.mesh import create_mesh as _cm
+
+    sign = "wide-ha-1"
+    mesh = _cm(1, 1, jax.devices()[:1])
+    specs = (EmbeddingSpec(name="w", input_dim=-1, output_dim=DIM,
+                           hash_capacity=512, key_dtype="wide",
+                           initializer={"category": "constant",
+                                        "value": 0.0},
+                           optimizer={"category": "sgd",
+                                      "learning_rate": 1.0}),)
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(_jax.random.PRNGKey(0))
+    k64 = np.asarray([3, 3 + (1 << 35), (9 << 40) + 1], np.int64)
+    pairs = _jnp.asarray(hl.split64(k64))
+    rows = coll.pull(states, {"w": pairs}, batch_sharded=False)
+    g = _jnp.asarray(np.arange(1, 4, dtype=np.float32))[:, None] * \
+        _jnp.ones_like(rows["w"])
+    states = coll.apply_gradients(states, {"w": pairs}, {"w": g},
+                                  batch_sharded=False)
+    mdir = str(tmp_path / "model")
+    _ckpt.save_checkpoint(mdir, coll, states, model_sign=sign)
+
+    ports = [_free_port() for _ in range(2)]
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    procs = {}
+    try:
+        procs[0] = ha.spawn_replica(ports[0], load=[f"{sign}={mdir}"])
+        assert ha.wait_ready(eps[0], sign=sign), _tail(procs[0])
+        shutil.rmtree(mdir)  # dump store gone: force the peer-row path
+        procs[1] = ha.spawn_replica(ports[1], peers=[eps[0]])
+        assert ha.wait_ready(eps[1], sign=sign, timeout=180.0), \
+            _tail(procs[1])
+        solo = ha.RoutingClient([eps[1]], timeout=15.0)
+        got = solo.lookup(sign, "w", hl.split64(k64).tolist())
+        np.testing.assert_allclose(got[:, 0], [-1.0, -2.0, -3.0],
+                                   rtol=1e-6)
+    finally:
+        _cleanup(procs)
