@@ -323,7 +323,7 @@ def _save_hash_var(vdir: str, state, include_optimizer: bool,
     files for multi-host dumps (each host writes only its shards).
     """
     empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
-    wide = state.keys.ndim == 2
+    wide = hash_lib.is_wide(state.keys)
     total = sum(
         int(jax.device_get(jnp.sum(
             (s.data[:, 1] if wide else s.data) != np.asarray(
@@ -345,7 +345,8 @@ def _save_hash_var(vdir: str, state, include_optimizer: bool,
         for blocks in _aligned_shard_blocks(targets):
             bk = blocks["keys"]
             # wide ([cap, 2]) keys: a slot is free iff its HI word is EMPTY
-            live = (bk[:, 1] != empty) if bk.ndim == 2 else (bk != empty)
+            live = (bk[:, 1] != empty) if hash_lib.is_wide(bk) \
+                else (bk != empty)
             n = int(live.sum())
             if n:
                 for fname, block in blocks.items():
@@ -784,8 +785,27 @@ def _insert_hash_rows(state, data, collection, sspec, with_opt,
         else:
             raw_keys = np.arange(offset, offset + got, dtype=np.int64)
             offset += got
+        if not from_array and hash_lib.is_wide(state.keys) \
+                and raw_keys.ndim == 1:
+            # int32-key dump loading into a wide table (the natural key
+            # migration): narrow keys become (lo, hi=sign-extension) pairs
+            # == the same 64-bit values
+            raw_keys = hash_lib.split64(raw_keys.astype(np.int64))
+        elif not from_array and not hash_lib.is_wide(state.keys) \
+                and raw_keys.ndim == 2:
+            # wide dump into a narrow table: join and refuse truncation
+            joined = hash_lib.join64(raw_keys)
+            kmax = np.iinfo(np.dtype(state.keys.dtype)).max
+            kmin = np.iinfo(np.dtype(state.keys.dtype)).min
+            if joined.size and (joined.max() > kmax or
+                                joined.min() < kmin):
+                raise ValueError(
+                    "wide-key dump holds keys outside the table's "
+                    f"{np.dtype(state.keys.dtype)} range; load into a "
+                    "key_dtype='wide' variable instead")
+            raw_keys = joined.astype(np.dtype(state.keys.dtype))
         if from_array:
-            if state.keys.ndim == 2:
+            if hash_lib.is_wide(state.keys):
                 # wide target: logical id i becomes the pair (lo=i, hi=0)
                 # == the 64-bit key i (split64 of the int64 id)
                 raw_keys = hash_lib.split64(raw_keys.astype(np.int64))

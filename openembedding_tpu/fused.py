@@ -13,7 +13,8 @@ table**:
 * hash (unbounded) vocabs: feature f's key k maps to ``k * F + f`` — member
   key spaces are interleaved, so one open-addressing table serves all
   features. (With int32 keys this divides the usable per-feature key space by
-  F; use ``key_dtype='int64'`` for the full reference-scale space.)
+  F; use ``key_dtype='wide'`` — [B, F, 2] pair keys, x64 OFF — or
+  ``key_dtype='int64'`` under x64 for the full reference-scale space.)
 
 Semantically identical to per-feature variables (offsets are disjoint;
 out-of-range ids still yield zero rows and dropped gradients) while cutting
@@ -45,6 +46,9 @@ class FusedMapper:
     vocab_sizes: Tuple[int, ...]        # -1 everywhere => hash fusion
     name: str = FUSED_NAME
     need_linear: bool = True
+    key_dtype: str = "int32"            # hash fusion: "wide" = [B, F, 2]
+                                        # pair keys, full 64-bit space
+                                        # with x64 OFF
 
     @property
     def use_hash(self) -> bool:
@@ -76,12 +80,19 @@ class FusedMapper:
             F = np.int64(self.num_features)
             fused = ids.astype(np.int64) * F + np.arange(
                 self.num_features, dtype=np.int64)[None, :]
-            if ids.dtype == np.int32:
+            if self.key_dtype == "wide":
+                # full 64-bit interleaved key space carried as [B, F, 2]
+                # int32 (lo, hi) pairs — no truncation, no x64 flag
+                from . import hash_table as _ht
+                fused = _ht.split64(fused)
+            elif ids.dtype == np.int32:
                 # avalanche-mix before truncating to 31 bits: F shares a
                 # factor with 2^31, so a plain mask would alias distinct
                 # features onto the same row in a structured way
                 fused = (mix64(fused) & np.uint64(2**31 - 1)).astype(np.int64)
-            fused = fused.astype(ids.dtype)
+                fused = fused.astype(ids.dtype)
+            else:
+                fused = fused.astype(ids.dtype)
         else:
             vocab = np.asarray(self.vocab_sizes, dtype=np.int64)[None, :]
             valid = (ids >= 0) & (ids < vocab)
@@ -131,7 +142,8 @@ def make_fused_specs(feature_names: Sequence[str],
                          "group; make two groups")
     mapper = FusedMapper(feature_names=tuple(feature_names),
                          vocab_sizes=tuple(int(v) for v in vocab_sizes),
-                         name=name, need_linear=need_linear)
+                         name=name, need_linear=need_linear,
+                         key_dtype=key_dtype)
     input_dim = -1 if mapper.use_hash else mapper.total_vocab
     emb_init = initializer or {"category": "normal", "mean": 0.0,
                                "stddev": 1e-4}

@@ -108,5 +108,8 @@ def unique_pairs(pairs: jnp.ndarray, capacity: int | None = None,
     uniq = jnp.full((capacity, 2), fill, dtype=pairs.dtype)
     dst = jnp.where(new_group, slot_sorted, capacity)
     uniq = uniq.at[dst].set(jnp.stack([slo, shi], axis=1), mode="drop")
-    valid = jnp.arange(capacity) <= (slot_sorted[-1] if n else -1)
+    # match unique_indices' contract: the sentinel group (padding rows,
+    # hi == fill) is NOT a valid unique
+    valid = (jnp.arange(capacity) <= (slot_sorted[-1] if n else -1)) \
+        & (uniq[:, 1] != fill)
     return uniq, inverse, valid

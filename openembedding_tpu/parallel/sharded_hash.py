@@ -63,7 +63,7 @@ class HashShardingSpec:
         return P(self.shard_axes)
 
     def owner_shard(self, keys: jnp.ndarray) -> jnp.ndarray:
-        if keys.ndim == 2:
+        if hash_lib.is_wide(keys):
             # unsigned 64-bit key mod S computed in 32-bit arithmetic
             # (x64-off): (hi * 2^32 + lo) mod S with 2^32 mod S folded in.
             # Safe while S < 2^16 (S^2 fits uint32) — far beyond any mesh.
@@ -147,7 +147,7 @@ def create_sharded_hash_table(meta: EmbeddingVariableMeta,
 def _mask_non_owned(spec: HashShardingSpec, flat: jnp.ndarray,
                     me: jnp.ndarray) -> jnp.ndarray:
     empty = hash_lib.empty_key(flat.dtype)
-    if flat.ndim == 2:
+    if hash_lib.is_wide(flat):
         owned = (spec.owner_shard(flat) == me) & (flat[:, 1] != empty)
         return jnp.where(owned[:, None], flat, empty)
     owned = (spec.owner_shard(flat) == me) & (flat != empty)

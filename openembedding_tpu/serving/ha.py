@@ -236,7 +236,7 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
         if spec.use_hash:
             state = states[name]
             empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
-            wide = state.keys.ndim == 2
+            wide = hash_lib.is_wide(state.keys)
             while total is None or offset < total:
                 ids, rows, total = fetch_rows_page(
                     endpoint, sign, name, offset, page, timeout)

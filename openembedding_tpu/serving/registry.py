@@ -79,7 +79,7 @@ class ServingModel:
             keys = np.asarray(jax.device_get(state.keys[offset:hi]))
             from .. import hash_table as hash_lib
             empty = hash_lib.empty_key(keys.dtype)
-            if keys.ndim == 2:
+            if hash_lib.is_wide(keys):
                 # wide (64-bit pair) keys: free iff the HI word is EMPTY;
                 # ids travel as joined int64 (the wire is 64-bit anyway)
                 live = keys[:, 1] != empty
@@ -154,7 +154,7 @@ def _specs_from_meta(meta: ModelMeta, hash_capacity: int,
             # no slot arrays are allocated or loaded (the reference serves
             # through the no-optimizer default, EmbeddingOptimizer.h default)
             optimizer={"category": "default"},
-            hash_capacity=int(info.get("hash_capacity", hash_capacity)),
+            hash_capacity=cap,
             key_dtype=info.get("key_dtype", "int32"),
             num_shards=num_shards,
             pooling=poolings.get(v.name)))

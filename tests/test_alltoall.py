@@ -375,3 +375,34 @@ def test_a2a_wide_keys_sharded_matches_single(devices8):
                         batch_sharded=False)
     w = hash_lib.pull(single, probe, init)
     np.testing.assert_allclose(np.asarray(r), np.asarray(w), rtol=1e-6)
+
+
+def test_a2a_wide_keys_exact_under_skew(devices8):
+    """Wide pair keys + structured owner skew: the residue/fallback
+    machinery must stay exact when every unique is owned by one shard."""
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer({"category": "sgd", "learning_rate": 1.0})
+    init = {"category": "constant", "value": 0.0}
+    spec = sh.make_hash_sharding_spec(mesh, total_capacity=4096,
+                                      plane="a2a", key_width=64)
+    state = sh.create_sharded_hash_table(meta, opt, mesh=mesh, spec=spec)
+    single = hash_lib.create_hash_table(meta, opt, capacity=4096,
+                                        rng=jax.random.PRNGKey(0),
+                                        key_width=64)
+    B = 256
+    # craft keys all landing on ONE owner under the (hi*2^32+lo) mod 8
+    # rule: lo = 8*i, hi = 0  ->  key mod 8 == 0 for all
+    k64 = np.arange(B, dtype=np.int64) * 8
+    pairs = jnp.asarray(hash_lib.split64(k64))
+    owners = np.asarray(spec.owner_shard(pairs))
+    assert (owners == owners[0]).all()
+    g = jnp.ones((B, DIM), jnp.float32)
+    state = sh.apply_gradients_sharded(state, opt, init, pairs, g,
+                                       mesh=mesh, spec=spec)
+    single = hash_lib.apply_gradients(single, opt, init, pairs, g)
+    assert int(state.insert_failures) == 0
+    got = sh.pull_sharded(state, pairs, None, mesh=mesh, spec=spec)
+    want = hash_lib.pull(single, pairs, None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_allclose(np.asarray(got), -1.0, rtol=1e-6)

@@ -541,3 +541,49 @@ def test_category_hotswap_array_to_wide_hash(devices8, tmp_path):
     got_a = np.asarray(
         coll_a.pull(loaded_a, {"v": allv}, batch_sharded=False)["v"])
     np.testing.assert_array_equal(got_a, want)
+
+
+def test_hash_key_width_migration(devices8, tmp_path):
+    """int32-key hash dumps load into key_dtype='wide' variables (key-space
+    migration) and wide dumps refuse narrow tables when keys overflow."""
+    from openembedding_tpu import hash_table as hl
+    mesh = create_mesh(2, 4, devices8)
+    n32 = EmbeddingCollection(
+        (EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
+                       hash_capacity=1024,
+                       initializer={"category": "constant", "value": 0.0},
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 1.0}),), mesh)
+    s32 = n32.init(jax.random.PRNGKey(0))
+    keys = jnp.asarray([11, -7, 12345], jnp.int32)
+    rows = n32.pull(s32, {"h": keys}, batch_sharded=False)
+    s32 = n32.apply_gradients(s32, {"h": keys},
+                              {"h": jnp.ones_like(rows["h"])},
+                              batch_sharded=False)
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, n32, s32)
+
+    wide = EmbeddingCollection(
+        (EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
+                       hash_capacity=1024, key_dtype="wide",
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 1.0}),), mesh)
+    loaded = ckpt.load_checkpoint(p, wide)
+    pairs = jnp.asarray(hl.split64(np.asarray([11, -7, 12345], np.int64)))
+    got = wide.pull(loaded, {"h": pairs}, batch_sharded=False,
+                    read_only=True)["h"]
+    want = n32.pull(s32, {"h": keys}, batch_sharded=False,
+                    read_only=True)["h"]
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # wide dump with a key past 2^31 must refuse a narrow table
+    sw = wide.init(jax.random.PRNGKey(1))
+    big = jnp.asarray(hl.split64(np.asarray([5 + (1 << 40)], np.int64)))
+    rows = wide.pull(sw, {"h": big}, batch_sharded=False)
+    sw = wide.apply_gradients(sw, {"h": big},
+                              {"h": jnp.ones_like(rows["h"])},
+                              batch_sharded=False)
+    p2 = str(tmp_path / "m2")
+    ckpt.save_checkpoint(p2, wide, sw)
+    with pytest.raises(ValueError, match="outside the table's"):
+        ckpt.load_checkpoint(p2, n32)

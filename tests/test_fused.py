@@ -141,3 +141,41 @@ def test_fused_hash_training(devices8):
         state, m = trainer.train_step(state, b)
     assert np.isfinite(float(m["loss"]))
     assert int(state.emb["fields"].insert_failures) == 0
+
+
+def test_fused_wide_keys(devices8):
+    """Hash fusion with key_dtype='wide': [B, F, 2] pair keys keep the
+    full 64-bit interleaved key space (no 31-bit truncation) with the
+    global x64 flag OFF."""
+    import jax
+    from openembedding_tpu import EmbeddingCollection
+    from openembedding_tpu.fused import make_fused_specs
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    feats = ("a", "b", "c")
+    specs, mapper = make_fused_specs(feats, -1, 4, hash_capacity=2048,
+                                     key_dtype="wide", need_linear=False)
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    assert states["fields"].keys.ndim == 2
+    rng = np.random.RandomState(0)
+    # ids above 2^31: would truncate/alias under int32 fusion
+    sparse = {f: (rng.randint(0, 1 << 20, 16).astype(np.int64)
+                  + (1 << 40)) for f in feats}
+    fused = mapper.fuse(sparse)["fields"]
+    assert fused.shape == (16, 3, 2)
+    jb = jnp.asarray(fused)
+    rows = coll.pull(states, {"fields": jb}, batch_sharded=False)
+    assert rows["fields"].shape == (16, 3, 4)
+    states = coll.apply_gradients(
+        states, {"fields": jb},
+        {"fields": jnp.ones_like(rows["fields"])}, batch_sharded=False)
+    # same feature value in different columns maps to different rows
+    # (interleaving preserved at full width)
+    s2 = {f: np.full(1, 12345 + (1 << 33), np.int64) for f in feats}
+    f2 = jnp.asarray(mapper.fuse(s2)["fields"])
+    r2 = np.asarray(coll.pull(states, {"fields": f2},
+                              batch_sharded=False)["fields"])[0]
+    from openembedding_tpu import hash_table as hl
+    j = hl.join64(np.asarray(f2)[0])
+    assert len(set(j.tolist())) == 3  # three distinct fused keys
