@@ -586,6 +586,36 @@ RUNNERS = {"offload": run_offload, "hash_probe": run_hash_probe,
            "auc": run_auc_criteo, "ckpt_local": run_ckpt_local}
 
 
+def _device_watchdog(timeout_s: int = 300) -> None:
+    """Bound backend init: a wedged TPU tunnel hangs ``jax.devices()``
+    forever inside native code, which would make the bench (and any driver
+    timing out on it) produce nothing. Probe from a thread; on timeout,
+    emit one honest JSON error line and hard-exit."""
+    import os
+    import threading
+    done = threading.Event()
+    err = []
+
+    def _probe():
+        try:
+            import jax
+            jax.devices()
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            err.append(f"{type(e).__name__}: {e}")
+        finally:
+            done.set()
+
+    threading.Thread(target=_probe, daemon=True).start()
+    if not done.wait(timeout_s) or err:
+        reason = err[0] if err else (
+            f"backend init exceeded {timeout_s}s — device tunnel "
+            "unhealthy; no measurements possible")
+        print(json.dumps({
+            "metric": "device_init_failed", "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0, "error": reason}), flush=True)
+        os._exit(1)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--suite", action="store_true",
@@ -596,6 +626,7 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=0, help="0 = auto")
     args = p.parse_args(argv)
 
+    _device_watchdog()
     import jax
     platform = jax.devices()[0].platform
     steps = args.steps or (60 if platform != "cpu" else 5)

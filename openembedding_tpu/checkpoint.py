@@ -789,8 +789,18 @@ def _insert_hash_rows(state, data, collection, sspec, with_opt,
                 and raw_keys.ndim == 1:
             # int32-key dump loading into a wide table (the natural key
             # migration): narrow keys become (lo, hi=sign-extension) pairs
-            # == the same 64-bit values
-            raw_keys = hash_lib.split64(raw_keys.astype(np.int64))
+            # == the same 64-bit values. Keys landing in the wide EMPTY
+            # band (hi == INT32_MIN, only reachable from int64 dumps) must
+            # fail the load, not silently read as free slots
+            pairs = hash_lib.split64(raw_keys.astype(np.int64))
+            banded = pairs[:, 1] == empty
+            if banded.any():
+                raise ValueError(
+                    f"{int(banded.sum())} dump keys fall in the wide-key "
+                    "EMPTY band (hi word == INT32_MIN, keys in [-2^63, "
+                    "-2^63+2^32)); the wide pair encoding excludes that "
+                    "range — keep such dumps on int64 tables")
+            raw_keys = pairs
         elif not from_array and not hash_lib.is_wide(state.keys) \
                 and raw_keys.ndim == 2:
             # wide dump into a narrow table: join and refuse truncation

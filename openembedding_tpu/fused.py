@@ -82,9 +82,19 @@ class FusedMapper:
                 self.num_features, dtype=np.int64)[None, :]
             if self.key_dtype == "wide":
                 # full 64-bit interleaved key space carried as [B, F, 2]
-                # int32 (lo, hi) pairs — no truncation, no x64 flag
+                # int32 (lo, hi) pairs — no truncation, no x64 flag. The
+                # pair encoding excludes keys with hi == INT32_MIN (the
+                # EMPTY band); ids near 2^63/F can wrap into it, so those
+                # keys are remapped up one hi step — a 2^-32 alias band,
+                # far below the reference's own 2^62 hash-collision rate
                 from . import hash_table as _ht
-                fused = _ht.split64(fused)
+                pairs = _ht.split64(fused)
+                band = pairs[..., 1] == np.int32(
+                    np.iinfo(np.int32).min)
+                pairs[..., 1] = np.where(
+                    band, np.int32(np.iinfo(np.int32).min + 1),
+                    pairs[..., 1])
+                fused = pairs
             elif ids.dtype == np.int32:
                 # avalanche-mix before truncating to 31 bits: F shares a
                 # factor with 2^31, so a plain mask would alias distinct
