@@ -311,7 +311,25 @@ float load_elem_as_float(const NpyArray& a, int64_t idx) {
   return 0.0f;
 }
 
-int64_t load_elem_as_i64(const NpyArray& a, int64_t idx) {
+bool is_wide_keys(const NpyArray& a) {
+  // wide (x64-off) hash dumps store keys as [n, 2] int32 (lo, hi) pairs
+  return a.shape.size() == 2 && a.shape[1] == 2 && a.itemsize == 4;
+}
+
+int64_t load_key_as_i64(const NpyArray& a, int64_t idx) {
+  // row-indexed key load: [n] int32/int64, or [n, 2] int32 pairs joined
+  // to the 64-bit value ((hi << 32) | unsigned lo)
+  if (is_wide_keys(a)) {
+    const char* p = a.data + idx * 2 * a.itemsize;
+    int32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    // shift in unsigned space: a signed left shift of a negative hi word
+    // is UB under -std=c++17
+    uint64_t u = (static_cast<uint64_t>(static_cast<uint32_t>(hi)) << 32)
+        | static_cast<uint32_t>(lo);
+    return static_cast<int64_t>(u);
+  }
   const char* p = a.data + idx * a.itemsize;
   if (a.itemsize == 4) {
     int32_t v;
@@ -451,7 +469,7 @@ oe_model* oe_model_load(const char* path) {
         int64_t n = kk->rows();
         var->index.reserve(var->index.size() + static_cast<size_t>(n) * 2);
         for (int64_t i = 0; i < n; ++i) {
-          var->index[load_elem_as_i64(*kk, i)] =
+          var->index[load_key_as_i64(*kk, i)] =
               (static_cast<int64_t>(k) << 40) | i;
         }
         var->keys.push_back(std::move(kk));

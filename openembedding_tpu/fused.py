@@ -89,11 +89,9 @@ class FusedMapper:
                 # far below the reference's own 2^62 hash-collision rate
                 from . import hash_table as _ht
                 pairs = _ht.split64(fused)
-                band = pairs[..., 1] == np.int32(
-                    np.iinfo(np.int32).min)
-                pairs[..., 1] = np.where(
-                    band, np.int32(np.iinfo(np.int32).min + 1),
-                    pairs[..., 1])
+                band = pairs[..., 1] == _ht.empty_key(np.int32)
+                if band.any():
+                    pairs[..., 1][band] = _ht.empty_key(np.int32) + 1
                 fused = pairs
             elif ids.dtype == np.int32:
                 # avalanche-mix before truncating to 31 bits: F shares a

@@ -116,10 +116,16 @@ class NativeModel:
 
     def lookup(self, variable, keys: Sequence[int]) -> np.ndarray:
         """Read-only pull: [n] keys -> [n, dim] float32 rows (missing/
-        invalid keys -> zero rows)."""
+        invalid keys -> zero rows). Wide [n, 2] int32 pair keys (the
+        framework's x64-off representation) are joined to their 64-bit
+        values — the native index is keyed by joined ids."""
         v = self._var(variable)
         dim = self._lib.oe_variable_dim(v)
-        k = np.ascontiguousarray(np.asarray(keys, dtype=np.int64).ravel())
+        arr = np.asarray(keys)
+        if arr.ndim == 2 and arr.shape[-1] == 2 and arr.dtype == np.int32:
+            from .. import hash_table as hash_lib
+            arr = hash_lib.join64(arr)
+        k = np.ascontiguousarray(arr.astype(np.int64).ravel())
         out = np.zeros((k.size, dim), np.float32)
         rc = self._lib.oe_pull_weights(
             v, k.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), k.size,

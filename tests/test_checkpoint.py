@@ -587,3 +587,36 @@ def test_hash_key_width_migration(devices8, tmp_path):
     ckpt.save_checkpoint(p2, wide, sw)
     with pytest.raises(ValueError, match="outside the table's"):
         ckpt.load_checkpoint(p2, n32)
+
+
+def test_int64_dump_empty_band_refused(devices8, tmp_path):
+    """int64-key dumps holding keys in [-2^63, -2^63+2^32) cannot migrate
+    to a wide table (they would split to the EMPTY sentinel and read as
+    free slots) — the load must fail, not silently drop rows."""
+    import os
+    from openembedding_tpu import hash_table as hl
+    mesh = create_mesh(2, 4, devices8)
+    wide = EmbeddingCollection(
+        (EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
+                       hash_capacity=512, key_dtype="wide"),), mesh)
+    # craft a dump dir with an int64 keys file containing a banded key:
+    # reuse a real int32 dump's layout, then rewrite keys as int64
+    n32 = EmbeddingCollection(
+        (EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
+                       hash_capacity=512,
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 1.0}),), mesh)
+    s = n32.init(jax.random.PRNGKey(0))
+    keys = jnp.asarray([5, 9], jnp.int32)
+    rows = n32.pull(s, {"h": keys}, batch_sharded=False)
+    s = n32.apply_gradients(s, {"h": keys}, {"h": jnp.ones_like(rows["h"])},
+                            batch_sharded=False)
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, n32, s)
+    vdir = [d for d in os.listdir(p) if d.endswith(".d")][0]
+    kpath = os.path.join(p, vdir, "keys.npy")
+    k = np.load(kpath).astype(np.int64)
+    k[0] = -(1 << 63) + 5  # in the excluded band
+    np.save(kpath, k)
+    with pytest.raises(ValueError, match="EMPTY band"):
+        ckpt.load_checkpoint(p, wide)

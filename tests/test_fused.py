@@ -179,3 +179,21 @@ def test_fused_wide_keys(devices8):
     from openembedding_tpu import hash_table as hl
     j = hl.join64(np.asarray(f2)[0])
     assert len(set(j.tolist())) == 3  # three distinct fused keys
+
+
+def test_fused_wide_empty_band_remap():
+    """Fused keys that wrap into the wide EMPTY band (hi == INT32_MIN,
+    reachable for ids near 2^63/F) are remapped up one hi step instead of
+    being silently treated as free slots by the table."""
+    from openembedding_tpu import hash_table as hl
+    from openembedding_tpu.fused import FusedMapper
+    m = FusedMapper(feature_names=("a", "b", "c"), vocab_sizes=(-1, -1, -1),
+                    key_dtype="wide", need_linear=False)
+    big = (1 << 63) // 3  # id whose fused key wraps to hi == INT32_MIN
+    sp = {f: np.asarray([big, 7], np.int64) for f in ("a", "b", "c")}
+    out = m.fuse(sp)["fields"]
+    assert (out[..., 1] != hl.empty_key(np.int32)).all()
+    # normal ids untouched
+    np.testing.assert_array_equal(
+        out[1], hl.split64(np.asarray([7 * 3, 7 * 3 + 1, 7 * 3 + 2],
+                                      np.int64)))

@@ -153,3 +153,35 @@ def test_native_loads_multihost_parts(native_lib, tmp_path, devices8):
         want[1:65] = full
         want[65] = 0.0
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_native_wide_key_dump(native_lib, tmp_path, devices8):
+    """Wide ([n, 2] int32 pair) hash dumps serve through the C++ lib:
+    keys.npy rows are joined to 64-bit ids in the native index."""
+    from openembedding_tpu import hash_table as hl
+    from openembedding_tpu.serving.native import NativeModel
+    mesh = create_mesh(2, 4, jax.devices()[:8])
+    specs = (EmbeddingSpec(name="w", input_dim=-1, output_dim=DIM,
+                           hash_capacity=512, key_dtype="wide",
+                           initializer={"category": "constant",
+                                        "value": 0.0},
+                           optimizer={"category": "sgd",
+                                      "learning_rate": 1.0}),)
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    k64 = np.asarray([17, 17 + (1 << 34), (5 << 45) + 3, -44], np.int64)
+    pairs = jnp.asarray(hl.split64(k64))
+    rows = coll.pull(states, {"w": pairs}, batch_sharded=False)
+    g = jnp.asarray(np.arange(1, 5, dtype=np.float32))[:, None] * \
+        jnp.ones_like(rows["w"])
+    states = coll.apply_gradients(states, {"w": pairs}, {"w": g},
+                                  batch_sharded=False)
+    p = str(tmp_path / "m")
+    ckpt.save_checkpoint(p, coll, states, model_sign="wide-native-1")
+    m = NativeModel(p, lib_path=native_lib)
+    got = m.lookup("w", k64)
+    np.testing.assert_allclose(got[:, 0], [-1.0, -2.0, -3.0, -4.0],
+                               rtol=1e-6)
+    # unknown 64-bit key -> zero row; lo-word collision stays distinct
+    got2 = m.lookup("w", np.asarray([17 + (1 << 35)], np.int64))
+    np.testing.assert_array_equal(got2, 0.0)
