@@ -122,7 +122,9 @@ class NativeModel:
         v = self._var(variable)
         dim = self._lib.oe_variable_dim(v)
         arr = np.asarray(keys)
-        if arr.ndim == 2 and arr.shape[-1] == 2 and arr.dtype == np.int32:
+        if arr.ndim >= 2 and arr.shape[-1] == 2 and arr.dtype == np.int32:
+            # wide pairs of ANY batch shape ([n, 2], [B, F, 2], ...):
+            # join over the last axis
             from .. import hash_table as hash_lib
             arr = hash_lib.join64(arr)
         k = np.ascontiguousarray(arr.astype(np.int64).ravel())
@@ -132,4 +134,5 @@ class NativeModel:
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if rc != 0:
             raise RuntimeError(self._lib.oe_last_error().decode())
-        return out.reshape(np.asarray(keys).shape + (dim,))
+        # batch shape AFTER the join: pair inputs collapse their last axis
+        return out.reshape(arr.shape + (dim,))

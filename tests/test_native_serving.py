@@ -182,6 +182,13 @@ def test_native_wide_key_dump(native_lib, tmp_path, devices8):
     got = m.lookup("w", k64)
     np.testing.assert_allclose(got[:, 0], [-1.0, -2.0, -3.0, -4.0],
                                rtol=1e-6)
+    # the framework's [n, 2] pair representation works directly...
+    got_pairs = m.lookup("w", hl.split64(k64))
+    np.testing.assert_array_equal(got_pairs, got)
+    # ...and so do [B, F, 2] fused-mapper-shaped batches
+    got_bf = m.lookup("w", hl.split64(k64.reshape(2, 2)))
+    assert got_bf.shape == (2, 2, DIM)
+    np.testing.assert_array_equal(got_bf.reshape(4, DIM), got)
     # unknown 64-bit key -> zero row; lo-word collision stays distinct
     got2 = m.lookup("w", np.asarray([17 + (1 << 35)], np.int64))
     np.testing.assert_array_equal(got2, 0.0)
