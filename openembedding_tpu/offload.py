@@ -67,12 +67,26 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
     ``COMPACT_CHAIN_LEN`` entries: a fresh base replaces the whole chain and
     superseded files are deleted, bounding file count, meta size, and
     restore replay time over arbitrarily long runs.
+
+    The commit is CRASH-CONSISTENT (the transactional property of the
+    reference's checkpoint list in the pool root,
+    PmemEmbeddingItemPool.h:236-296): the chain file and the meta are each
+    written tmp + fsync + atomic-rename (``fs.open_atomic``), and the meta
+    rename is the single commit point. A kill at ANY instant leaves either
+    the previous chain (new file is an orphan, GC'd on restore) or the new
+    chain (stale files are orphans, GC'd on restore) — never a meta that
+    references a torn or missing file.
     """
     fs.makedirs(path)
     meta_path = fs.join(path, OFFLOAD_META_FILE)
     chain = []
     if fs.exists(meta_path):
         chain = fs.read_json(meta_path)["checkpoints"]
+    # GC runs on the WRITE path only: the persisting process owns this
+    # directory (one table = one dir, single writer), so sweeping here can
+    # never race another writer's in-flight files — a restore-side sweep
+    # could delete a live writer's just-renamed chain file or tmp
+    _gc_orphans(path, chain)
     if len(chain) >= COMPACT_CHAIN_LEN:
         stale = [e["file"] for e in chain]
         chain = []
@@ -80,7 +94,7 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
         stale = []
     if not chain:
         fname = f"base_{work_id}.npz"
-        with fs.open_file(fs.join(path, fname), "wb") as f:
+        with fs.open_atomic(fs.join(path, fname)) as f:
             np.savez(f, ids=np.arange(vocab, dtype=np.int64),
                      weights=host_weights, work_id=host_work_id,
                      **{f"slot_{k}": v for k, v in host_slots.items()})
@@ -88,14 +102,15 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
     else:
         ids = np.nonzero(host_work_id > persisted_work)[0].astype(np.int64)
         fname = f"inc_{work_id}.npz"
-        with fs.open_file(fs.join(path, fname), "wb") as f:
+        with fs.open_atomic(fs.join(path, fname)) as f:
             np.savez(f, ids=ids, weights=host_weights[ids],
                      work_id=host_work_id[ids],
                      **{f"slot_{k}": v[ids] for k, v in host_slots.items()})
         changed = int(ids.size)
     chain.append({"file": fname, "work_id": work_id})
-    fs.write_json(meta_path, {"checkpoints": chain, "vocab": vocab,
-                              "meta": meta.to_json()})
+    # the commit point: before this rename readers see the old chain
+    fs.write_json_atomic(meta_path, {"checkpoints": chain, "vocab": vocab,
+                                     "meta": meta.to_json()})
     for old in stale:
         try:
             fs.remove(fs.join(path, old))
@@ -104,11 +119,41 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
     return {"file": fname, "rows": changed}
 
 
+def _gc_orphans(path: str, chain) -> int:
+    """Remove chain files the committed meta does not reference (plus
+    leftover ``*.tmp.<pid>`` writes) — the debris of a kill between the
+    chain-file write and the meta commit, or between the meta commit and
+    the stale-file sweep. Called at the start of ``_persist_store`` (the
+    directory's single writer) so debris never accumulates and the sweep
+    never races an in-flight write."""
+    live = {e["file"] for e in chain} | {OFFLOAD_META_FILE}
+    n = 0
+    try:
+        names = fs.listdir(path)
+    except OSError:  # pragma: no cover — listing is best-effort
+        return 0
+    for fname in names:
+        orphan_chain = (fname.endswith(".npz")
+                        and (fname.startswith("base_")
+                             or fname.startswith("inc_"))
+                        and fname not in live)
+        if orphan_chain or fs.is_tmp_orphan(fname):
+            try:
+                fs.remove(fs.join(path, fname))
+                n += 1
+            except OSError:  # pragma: no cover
+                pass
+    return n
+
+
 def _replay_store(path: str, *, vocab: int, host_weights: np.ndarray,
                   host_slots: Dict[str, np.ndarray],
                   host_work_id: np.ndarray) -> int:
     """Shared restore: replay base + increments (newest wins by order).
-    Returns the highest persisted work id."""
+    Returns the highest persisted work id. Orphan files newer than the
+    committed meta (the debris of a kill mid-persist) are simply IGNORED —
+    only the meta's chain is ever read; the next persist (the directory's
+    single writer) garbage-collects them."""
     meta = fs.read_json(fs.join(path, OFFLOAD_META_FILE))
     if int(meta["vocab"]) != vocab:
         raise ValueError(f"offload checkpoint vocab {meta['vocab']} != "

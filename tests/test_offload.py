@@ -287,6 +287,98 @@ class TestShardedOffload:
         assert scores.shape == (32,)
 
 
+_KILL_CHILD = r"""
+import os, signal, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+sys.path.insert(0, {root!r})
+from openembedding_tpu import EmbeddingVariableMeta
+from openembedding_tpu.offload import HostOffloadedTable
+from openembedding_tpu.utils import fs
+
+t = HostOffloadedTable(
+    EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=1000),
+    {{"category": "sgd", "learning_rate": 1.0}},
+    {{"category": "constant", "value": 0.5}},
+    vocab=1000, cache_capacity=256)
+p = {pdir!r}
+ids1 = np.array([1, 2, 3], np.int32)
+t.prepare(ids1)
+t.apply_gradients(jnp.asarray(ids1), jnp.ones((3, 4), jnp.float32))
+t.persist(p)                               # committed base checkpoint
+ids2 = np.array([10, 11], np.int32)
+t.prepare(ids2)
+t.apply_gradients(jnp.asarray(ids2), jnp.ones((2, 4), jnp.float32) * 2.0)
+
+mode = {mode!r}
+if mode == "mid_file":
+    # SIGKILL while the incremental chain file's bytes are mid-write
+    orig_write = fs._AtomicFile.write
+    def dying_write(self, data):
+        orig_write(self, bytes(data)[: max(1, len(data) // 2)])
+        self._f.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    fs._AtomicFile.write = dying_write
+else:
+    # chain file fully committed, SIGKILL before the meta commit point
+    def dying_json(path, obj):
+        os.kill(os.getpid(), signal.SIGKILL)
+    fs.write_json_atomic = dying_json
+    import openembedding_tpu.offload as off
+    off.fs.write_json_atomic = dying_json
+print("persisting", flush=True)
+t.persist(p)                               # never returns
+"""
+
+
+@pytest.mark.parametrize("mode", ["mid_file", "pre_meta"])
+def test_kill_mid_persist_restores_watermark(tmp_path, mode):
+    """SIGKILL INSIDE persist (mid chain-file write / before the meta
+    commit) must leave a restorable checkpoint at the PREVIOUS watermark —
+    the reference's transactional pool-root commit
+    (PmemEmbeddingItemPool.h:236-296). Restore ignores the debris; the
+    next persist (the directory's single writer) GCs it."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pdir = str(tmp_path / "off")
+    code = _KILL_CHILD.format(root=root, pdir=pdir, mode=mode)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == -9, (out.returncode, out.stdout, out.stderr)
+    assert "persisting" in out.stdout
+
+    # a fresh process restores the BASE state (pre-second-persist watermark)
+    t2 = make_table()
+    t2.restore(pdir)
+    np.testing.assert_allclose(t2.host_weights[[1, 2, 3]], 0.5 - 1.0,
+                               rtol=1e-6)
+    # the second batch's update was never committed
+    np.testing.assert_allclose(t2.host_weights[[10, 11]], 0.5)
+    # the survivor trains on and persists: the writer-side sweep GCs the
+    # crash debris, and the new chain is fully consistent
+    ids3 = np.array([42], np.int32)
+    t2.prepare(ids3)
+    t2.apply_gradients(jnp.asarray(ids3), jnp.ones((1, DIM), jnp.float32))
+    t2.persist(pdir)
+    from openembedding_tpu import offload as off
+    left = sorted(os.listdir(pdir))
+    assert off.OFFLOAD_META_FILE in left
+    import json
+    with open(os.path.join(pdir, off.OFFLOAD_META_FILE)) as f:
+        chain = {e["file"] for e in json.load(f)["checkpoints"]}
+    assert set(left) == chain | {off.OFFLOAD_META_FILE}, (left, chain)
+    t3 = make_table()
+    t3.restore(pdir)
+    np.testing.assert_allclose(t3.host_weights[42], 0.5 - 1.0, rtol=1e-6)
+
+
 def test_persist_restore_remote_uri(tmp_path):
     """Offload persistence streams to fsspec URIs like the checkpoint dump
     (memory:// stands in for gs://; the reference persists its PMem pool
