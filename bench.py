@@ -281,16 +281,20 @@ def run_offload(name, config, *, steps, warmup):
         for i in range(warmup):
             state, m = trainer.train_step(state, make_batch())
         jax.block_until_ready(m["loss"])
-        t0 = time.perf_counter()
         # fresh zipf batches every step: the long tail keeps missing, the
-        # hot head keeps hitting — the steady-state cache economics
+        # hot head keeps hitting — the steady-state cache economics.
+        # Pre-generate so batch synthesis is outside the timed loop, and
+        # PIPELINE with next_batch: batch N+1's host gather overlaps the
+        # device step (the prepare/step overlap this tier is built around)
+        timed = [make_batch() for _ in range(steps)] + [None]
+        t0 = time.perf_counter()
         for i in range(steps):
-            b = make_batch()
+            b = timed[i]
             uniq = np.unique(b["sparse"]["uid"])
             was_resident = int(table._resident[uniq].sum())
             hits += was_resident
             misses += uniq.size - was_resident
-            state, m = trainer.train_step(state, b)
+            state, m = trainer.train_step(state, b, next_batch=timed[i + 1])
         jax.block_until_ready(m["loss"])
         dt = time.perf_counter() - t0
         t0 = time.perf_counter()
@@ -323,6 +327,86 @@ def run_offload(name, config, *, steps, warmup):
         }
     finally:
         shutil.rmtree(backing, ignore_errors=True)
+
+
+def run_offload_sweep(name, config, *, steps, warmup):
+    """Cache-size -> hit-rate/throughput sweep for the offload tier, plus
+    an in-HBM array-table ROOFLINE of the same model/batch: the tier must
+    approach the roofline as the working set fits the cache — the
+    reference's PMem bar (PMem ~= DRAM once the cache holds the hot set,
+    documents/en/pmem.md:1-7)."""
+    import jax
+    import optax
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec, Trainer
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    entries = []
+    for cache in config["caches"]:
+        sub = dict(config, cache=cache)
+        r = run_offload(f"{name}_c{cache}", sub, steps=steps, warmup=warmup)
+        entries.append({
+            "cache_rows": cache,
+            "examples_per_sec": r["value"],
+            "hit_rate": r["cache_hit_rate"],
+            "step_ms": r["step_ms"],
+        })
+        gc.collect()
+        jax.clear_caches()
+
+    # roofline: identical model/batch with plain in-HBM array tables
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    mesh = create_mesh(1, n_dev)
+    batch, dim = config["batch"], config["dim"]
+    hbm_vocab = 1 << 22
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    specs = (EmbeddingSpec(name="uid", input_dim=hbm_vocab, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="uid:linear", input_dim=hbm_vocab,
+                           output_dim=1, optimizer=opt),
+             EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                           output_dim=1, optimizer=opt))
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                      coll, optax.adagrad(0.01))
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        z = rng.zipf(config.get("zipf_a", 1.08), size=batch)
+        uid = ((z * 2654435761) % hbm_vocab).astype(np.int32)
+        ctx = rng.randint(0, 100_000, batch).astype(np.int32)
+        return {"label": (rng.rand(batch) > 0.75).astype(np.float32),
+                "dense": rng.randn(batch, 13).astype(np.float32),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+
+    batches = [make_batch() for _ in range(8)]
+    state = trainer.init(jax.random.PRNGKey(0),
+                         trainer.shard_batch(batches[0]))
+    for i in range(warmup):
+        state, m = trainer.train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = trainer.train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    roofline_eps = steps * batch / dt
+    del state
+
+    best = max(e["examples_per_sec"] for e in entries)
+    return {
+        "metric": f"{name}_{platform}{n_dev}",
+        "value": round(best / roofline_eps, 3),
+        "unit": "fraction_of_array_roofline",
+        "vs_baseline": round(best / roofline_eps, 3),
+        "array_roofline_eps": round(roofline_eps, 1),
+        "sweep": entries,
+        "config": dict(config),
+    }
 
 
 def run_hash_probe(name, config, *, steps, warmup):
@@ -569,6 +653,12 @@ CONFIGS = {
     # 16 GB HBM) on disk memmap, HBM cache 2^22 rows, zipf stream
     "offload_bigvocab": {"kind": "offload", "dim": 8, "vocab": 400_000_000,
                          "cache": 1 << 22, "batch": 4096, "zipf_a": 1.08},
+    # cache-size -> hit-rate/throughput sweep vs an in-HBM array roofline
+    # (moderate 5x10^7-row store so three sweep points stay tractable);
+    # value = best sweep point as a fraction of the roofline
+    "offload_sweep": {"kind": "offload_sweep", "dim": 8,
+                      "vocab": 50_000_000, "batch": 4096, "zipf_a": 1.08,
+                      "caches": [1 << 18, 1 << 20, 1 << 22]},
     # hash pull path: bucket-row XLA probe vs fused Pallas kernel vs the
     # array row-gather roofline (dim 128 so the kernel's lane constraint
     # holds); value = XLA probe us, vs_baseline = roofline ratio
@@ -582,7 +672,8 @@ CONFIGS = {
                        "devices": 4},
 }
 HEADLINE = "deepfm_dim9"
-RUNNERS = {"offload": run_offload, "hash_probe": run_hash_probe,
+RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
+           "hash_probe": run_hash_probe,
            "auc": run_auc_criteo, "ckpt_local": run_ckpt_local}
 
 

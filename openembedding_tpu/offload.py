@@ -33,6 +33,7 @@ Protocol mapping:
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 from typing import Any, Dict, Optional
@@ -325,6 +326,25 @@ class HostOffloadedTable:
         self.clear_cache()  # stale pre-restore rows must not write back
 
 
+@dataclasses.dataclass
+class PreparedBatch:
+    """Host-side half of a prepare, produced ahead of time.
+
+    ``host_prepare`` builds one of these on a BACKGROUND thread while the
+    device executes the previous step (the reference's
+    PrefetchPullWeights issuing pulls N batches ahead, exb_ops.cpp:109-205);
+    ``apply_prepared`` then turns it into device inserts. ``needs_evict``
+    marks a batch whose misses would overflow the cache budget — eviction
+    rebuilds the cache, so that batch falls back to the synchronous path.
+    """
+
+    uniq: np.ndarray                      # unique valid batch ids
+    missing: np.ndarray                   # the non-resident subset
+    rows: Optional[np.ndarray]            # host_weights[missing]
+    slot_rows: Dict[str, np.ndarray]      # host_slots[*][missing]
+    needs_evict: bool = False
+
+
 class ShardedOffloadedTable:
     """Mesh-sharded offload tier: host store + sharded HBM cache + Trainer.
 
@@ -430,6 +450,9 @@ class ShardedOffloadedTable:
         self._batches_since_persist = 0
         self._writer: Optional[threading.Thread] = None
         self._writer_err: Optional[BaseException] = None
+        self._persister: Optional[threading.Thread] = None
+        self._persister_err: Optional[BaseException] = None
+        self._overflow_pending = None  # deferred insert_failures readback
 
     # --- spec / state creation ---------------------------------------------
     def embedding_spec(self, **kw) -> EmbeddingSpec:
@@ -465,6 +488,9 @@ class ShardedOffloadedTable:
         """Launch device->host copy of the cache + background scatter of
         ``dirty_ids`` rows into the host store."""
         self._join_writeback()
+        # an async persist is READING host rows; the scatter below is the
+        # only host-row writer — wait until the snapshot is on disk
+        self._join_persist()
         arrays = {"keys": cache.keys, "weights": cache.weights,
                   **{f"slot_{k}": v for k, v in cache.slots.items()}}
         for a in arrays.values():
@@ -506,7 +532,19 @@ class ShardedOffloadedTable:
         self._writer.start()
 
     # --- cache management ---------------------------------------------------
-    def _insert_from_host(self, cache, ids: np.ndarray):
+    def _gather_host(self, ids: np.ndarray):
+        """Host-row gather for ``ids``: (weights, slot rows). Pure reads —
+        safe on a background thread as long as no writeback/evict mutates
+        the store meanwhile (writebacks only touch DIRTY rows, which are
+        resident, and gathers only touch MISSING rows, which are not — the
+        two row sets are disjoint by construction)."""
+        rows = self.host_weights[ids]
+        srows = {k: v[ids] for k, v in self.host_slots.items()}
+        return rows, srows
+
+    def _insert_rows(self, cache, ids: np.ndarray, rows: np.ndarray,
+                     slot_rows: Dict[str, np.ndarray]):
+        """Device half of an insert: pre-gathered host rows -> HBM cache."""
         from .parallel import sharded_hash as sh
         chunk = 1 << 16
         key_dtype = np.dtype(cache.keys.dtype)
@@ -521,44 +559,103 @@ class ShardedOffloadedTable:
             ck[:sub.size] = sub
             cw = np.zeros((size,) + self.host_weights.shape[1:],
                           self.host_weights.dtype)
-            cw[:sub.size] = self.host_weights[sub]
+            cw[:sub.size] = rows[lo:lo + chunk]
             srows = {}
             for sname, arr in self.host_slots.items():
                 cs = np.zeros((size,) + arr.shape[1:], arr.dtype)
-                cs[:sub.size] = arr[sub]
+                cs[:sub.size] = slot_rows[sname][lo:lo + chunk]
                 srows[sname] = jnp.asarray(cs)
             cache = sh.insert_rows_sharded(
                 cache, jnp.asarray(ck), jnp.asarray(cw), srows,
                 mesh=self.mesh, spec=self.spec)
-        if int(jax.device_get(cache.insert_failures)) > 0:
+        # DEFER the overflow readback: a blocking device_get here would
+        # stall the host until the device caught up — the per-step sync
+        # that serialized the whole tier (r3's 466 ms steps). The counter
+        # is copied into an INDEPENDENT buffer (the jitted step donates
+        # the cache pytree, deleting its buffers) and checked one step
+        # later at the next join point.
+        self._overflow_pending = cache.insert_failures + jnp.int32(0)
+        return cache
+
+    def check_overflow(self) -> None:
+        """Blocking read of the last deferred insert-overflow counter;
+        raises if any cache insert ever overflowed. Called automatically
+        at the next ``apply_prepared``/``flush``/``persist``/``restore``;
+        call directly after a hand-driven loop's final step."""
+        if self._overflow_pending is None:
+            return
+        v, self._overflow_pending = self._overflow_pending, None
+        if int(jax.device_get(v)) > 0:
             raise RuntimeError(
                 f"offloaded table {self.name!r}: HBM cache insert overflow "
                 "— raise cache_capacity or lower occupancy_threshold")
+
+    def _insert_from_host(self, cache, ids: np.ndarray):
+        rows, srows = self._gather_host(ids)
+        return self._insert_rows(cache, ids, rows, srows)
+
+    def host_prepare(self, ids) -> PreparedBatch:
+        """Host-only half of :meth:`prepare`: residency math + host gather.
+
+        Mutates NO bookkeeping, so it may run on a background thread while
+        the device executes the previous step (the reference's prefetch
+        issuing pulls ahead, exb_ops.cpp:109-205). Validity contract: the
+        result holds for as long as residency does not change, i.e. until
+        the next ``apply_prepared`` / ``prepare`` / ``restore`` call —
+        the Trainer's pipeline dispatches step N, then host-prepares
+        batch N+1, then applies it before step N+1.
+        """
+        ids = np.unique(np.asarray(ids).ravel())
+        ids = ids[(ids >= 0) & (ids < self.vocab)]
+        missing = ids[~self._resident[ids]]
+        budget = int(self.occupancy_threshold * self.cache_capacity)
+        if self._resident_count + missing.size > budget:
+            # eviction rebuilds the cache (synchronous path); don't gather
+            return PreparedBatch(uniq=ids, missing=missing, rows=None,
+                                 slot_rows={}, needs_evict=True)
+        rows, srows = self._gather_host(missing)
+        return PreparedBatch(uniq=ids, missing=missing, rows=rows,
+                             slot_rows=srows)
+
+    def apply_prepared(self, cache, prep: PreparedBatch):
+        """Device half: turn a :class:`PreparedBatch` into cache inserts.
+        Falls back to the synchronous evict path when the batch overflows
+        the budget. Returns the updated cache state."""
+        # join FIRST: the caller's next jitted step may donate (delete) the
+        # very cache buffers an in-flight async flush is still reading
+        self._join_writeback()
+        # the PREVIOUS insert's deferred overflow counter: reading it now
+        # blocks only until that insert executed (the device is already a
+        # step ahead of it), keeping the host pipelined
+        self.check_overflow()
+        if prep.needs_evict:
+            budget = int(self.occupancy_threshold * self.cache_capacity)
+            self._last_touch[prep.uniq] = self.work_id
+            cache = self._evict(cache, protect=prep.uniq, budget=budget,
+                                incoming=prep.missing.size)
+            missing = prep.uniq[~self._resident[prep.uniq]]
+            if missing.size == 0:
+                return cache
+            cache = self._insert_from_host(cache, missing)
+            self._resident[missing] = True
+            self._resident_count += int(missing.size)
+            return cache
+        self._last_touch[prep.uniq] = self.work_id
+        if prep.missing.size == 0:
+            return cache
+        cache = self._insert_rows(cache, prep.missing, prep.rows,
+                                  prep.slot_rows)
+        self._resident[prep.missing] = True
+        self._resident_count += int(prep.missing.size)
         return cache
 
     def prepare(self, cache, ids):
         """Make every (unique, valid) batch id cache-resident; returns the
         updated cache state. Evicts the least-recently-touched rows first
-        when the incoming set would overflow the load-factor budget."""
-        # join FIRST: the caller's next jitted step may donate (delete) the
-        # very cache buffers an in-flight async flush is still reading, and
-        # host rows must be current before any gather below
-        self._join_writeback()
-        ids = np.unique(np.asarray(ids).ravel())
-        ids = ids[(ids >= 0) & (ids < self.vocab)]
-        self._last_touch[ids] = self.work_id
-        missing = ids[~self._resident[ids]]
-        budget = int(self.occupancy_threshold * self.cache_capacity)
-        if self._resident_count + missing.size > budget:
-            cache = self._evict(cache, protect=ids, budget=budget,
-                                incoming=missing.size)
-            missing = ids[~self._resident[ids]]
-        if missing.size == 0:
-            return cache
-        cache = self._insert_from_host(cache, missing)
-        self._resident[missing] = True
-        self._resident_count += int(missing.size)
-        return cache
+        when the incoming set would overflow the load-factor budget.
+        (The synchronous convenience composition of ``host_prepare`` +
+        ``apply_prepared``.)"""
+        return self.apply_prepared(cache, self.host_prepare(ids))
 
     def _evict(self, cache, protect: np.ndarray, budget: int,
                incoming: int):
@@ -591,18 +688,22 @@ class ShardedOffloadedTable:
         return cache
 
     # --- step bookkeeping ---------------------------------------------------
-    def note_update(self, ids) -> None:
+    def note_update(self, ids, *, uniq: Optional[np.ndarray] = None) -> None:
         """Record that the jitted step applied gradients for ``ids``
-        (host-side dirty marks + work watermark advance)."""
-        ids = np.unique(np.asarray(ids).ravel())
-        ids = ids[(ids >= 0) & (ids < self.vocab)]
-        self._dirty[ids] = True
+        (host-side dirty marks + work watermark advance). ``uniq`` skips
+        the np.unique when the caller already holds this batch's unique
+        valid ids (a PreparedBatch carries them)."""
+        if uniq is None:
+            uniq = np.unique(np.asarray(ids).ravel())
+            uniq = uniq[(uniq >= 0) & (uniq < self.vocab)]
+        self._dirty[uniq] = True
         self.work_id += 1
         self._batches_since_persist += 1
 
     # --- persistence --------------------------------------------------------
     def flush(self, cache) -> int:
         """Asynchronously write back all dirty rows (cache stays intact)."""
+        self.check_overflow()
         dirty_ids = np.nonzero(self._dirty)[0]
         if dirty_ids.size:
             self._start_writeback(cache, dirty_ids)
@@ -614,23 +715,74 @@ class ShardedOffloadedTable:
                 or self._resident_count
                 >= self.occupancy_threshold * self.cache_capacity)
 
-    def persist(self, cache, path: str) -> Dict[str, Any]:
-        """Incremental checkpoint (base on first call, deltas afterwards)."""
+    def _join_persist(self) -> None:
+        if self._persister is not None:
+            self._persister.join()
+            self._persister = None
+        if self._persister_err is not None:
+            err, self._persister_err = self._persister_err, None
+            raise RuntimeError("async persist failed") from err
+
+    def finish(self) -> None:
+        """End-of-loop barrier for the pipeline's loose ends: raises any
+        deferred insert overflow and joins/raises the async persist.
+        ``Trainer.fit`` calls this before returning; hand-driven loops
+        should too (a daemon persister thread would otherwise die with
+        the interpreter mid-write)."""
+        self.check_overflow()
+        self._join_persist()
+
+    def persist(self, cache, path: str, *,
+                blocking: bool = True) -> Dict[str, Any]:
+        """Incremental checkpoint (base on first call, deltas afterwards).
+
+        ``blocking=False`` runs the file write on a BACKGROUND thread so
+        training continues during the commit — the reference's
+        update_early_return overlap (EmbeddingStoreOperator.cpp:42-57).
+        Safe because the persister only READS host rows and the only host-
+        row WRITER (``_start_writeback``) joins any in-flight persist
+        first; crash-consistency comes from the atomic chain/meta commits.
+        Returns ``{"async": True}`` immediately in that mode; errors
+        surface on the next persist/flush/restore join.
+        """
         self.flush(cache)
         self._join_writeback()
-        out = _persist_store(
-            path, vocab=self.vocab, meta=self.meta, work_id=self.work_id,
-            persisted_work=self.persisted_work,
-            host_weights=self.host_weights, host_slots=self.host_slots,
-            host_work_id=self.host_work_id)
+        self._join_persist()
+        work, persisted = self.work_id, self.persisted_work
+        # watermarks advance optimistically: should_persist goes quiet now;
+        # on failure the join raises and the next persist re-covers the
+        # rows (their host_work_id stamps are > the last COMMITTED meta)
         self.persisted_work = self.work_id
         self._batches_since_persist = 0
-        return out
+        if blocking:
+            return _persist_store(
+                path, vocab=self.vocab, meta=self.meta, work_id=work,
+                persisted_work=persisted,
+                host_weights=self.host_weights, host_slots=self.host_slots,
+                host_work_id=self.host_work_id)
+
+        def _run():
+            try:
+                _persist_store(
+                    path, vocab=self.vocab, meta=self.meta, work_id=work,
+                    persisted_work=persisted,
+                    host_weights=self.host_weights,
+                    host_slots=self.host_slots,
+                    host_work_id=self.host_work_id)
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                self._persister_err = e
+                self.persisted_work = persisted
+
+        self._persister = threading.Thread(target=_run, daemon=True)
+        self._persister.start()
+        return {"async": True, "work_id": work}
 
     def restore(self, path: str):
         """Replay base + increments into the host store; returns a FRESH
         empty cache state (pre-restore cache rows must not write back)."""
         self._join_writeback()
+        self._join_persist()
+        self._overflow_pending = None  # pre-restore cache is discarded
         max_work = _replay_store(
             path, vocab=self.vocab, host_weights=self.host_weights,
             host_slots=self.host_slots, host_work_id=self.host_work_id)

@@ -19,6 +19,7 @@ tf.Variables (exb.py:100-104, README "Cache" mode).
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Any, Callable, Dict, Optional
 
@@ -102,6 +103,8 @@ class Trainer:
         self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self._train_step = None
         self._eval_step = None
+        # in-flight lookahead prepare: (thread, batch, results, errors)
+        self._prep = None
 
     # --- initialization ----------------------------------------------------
     def _split_sparse(self, sparse: Dict[str, Any]):
@@ -182,14 +185,77 @@ class Trainer:
 
         return jax.jit(eval_fn)
 
-    def train_step(self, state: TrainState, batch) -> tuple:
+    def train_step(self, state: TrainState, batch, *,
+                   next_batch=None) -> tuple:
+        """One pipelined step. With ``next_batch``, the HOST half of the
+        next batch's offload prepare (residency math + host-store row
+        gather) runs on a background thread WHILE the device executes this
+        step — the reference's PrefetchPullWeights issuing pulls ahead of
+        the graph (exb_ops.cpp:109-205). The device-insert half is applied
+        just before the next step consumes it, so step time approaches
+        max(host prepare, device step) instead of their sum. ``fit`` wires
+        the lookahead automatically; callers driving steps by hand pass
+        ``next_batch`` themselves (or skip it and keep the serial path).
+        """
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        state = self.prepare_offload(state, batch)
+        state, uniqs = self._apply_prepared_offload(state, batch)
         state, metrics = self._train_step(state, self.shard_batch(batch))
         for name, table in self.offload.items():
-            table.note_update(batch["sparse"][name])
+            table.note_update(batch["sparse"][name], uniq=uniqs.get(name))
+        if next_batch is not None and self.offload:
+            self._start_host_prepare(next_batch)
         return state, metrics
+
+    def _start_host_prepare(self, batch) -> None:
+        """Launch the host-only prepare of ``batch`` on a background
+        thread (one thread covering every offloaded table, in registration
+        order). Results are picked up — and the thread joined — by the
+        next ``_apply_prepared_offload`` call."""
+        self._join_host_prepare()
+        results: Dict[str, Any] = {}
+        err: list = []
+
+        def _run():
+            try:
+                for name, table in self.offload.items():
+                    results[name] = table.host_prepare(
+                        batch["sparse"][name])
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                err.append(e)
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        self._prep = (t, batch, results, err)
+
+    def _join_host_prepare(self):
+        if self._prep is None:
+            return None
+        t, batch, results, err = self._prep
+        t.join()
+        self._prep = None
+        if err:
+            raise RuntimeError("background offload prepare failed") \
+                from err[0]
+        return batch, results
+
+    def _apply_prepared_offload(self, state: TrainState, batch):
+        """Apply this batch's prepared inserts (from the lookahead thread
+        when it prepared exactly this batch, else synchronously)."""
+        if not self.offload:
+            return state, {}
+        prepped = self._join_host_prepare()
+        emb = dict(state.emb)
+        uniqs: Dict[str, Any] = {}
+        for name, table in self.offload.items():
+            prep = None
+            if prepped is not None and prepped[0] is batch:
+                prep = prepped[1].get(name)
+            if prep is None:
+                prep = table.host_prepare(batch["sparse"][name])
+            emb[name] = table.apply_prepared(emb[name], prep)
+            uniqs[name] = prep.uniq
+        return state.replace(emb=emb), uniqs
 
     def prepare_offload(self, state: TrainState, batch) -> TrainState:
         """Pre-touch offloaded rows for this batch (host->HBM cache inserts).
@@ -202,10 +268,8 @@ class Trainer:
         """
         if not self.offload:
             return state
-        emb = dict(state.emb)
-        for name, table in self.offload.items():
-            emb[name] = table.prepare(emb[name], batch["sparse"][name])
-        return state.replace(emb=emb)
+        state, _ = self._apply_prepared_offload(state, batch)
+        return state
 
     def eval_step(self, state: TrainState, batch) -> jnp.ndarray:
         if self._eval_step is None:
@@ -230,22 +294,39 @@ class Trainer:
             log_fn=print, persist_dir: Optional[str] = None):
         """Simple host loop over an iterable of batches (model.fit analogue).
 
+        Peeks ONE batch ahead so offloaded tables host-prepare batch N+1
+        while the device runs step N (see :meth:`train_step`).
+
         ``persist_dir``: incremental-persist offloaded tables whenever they
         signal ``should_persist`` — the reference's AutoPersist callback
         (test/benchmark/criteo_deepctr.py:113-124 polling
-        should_persist_server_model each batch).
+        should_persist_server_model each batch). Persists run on a
+        background thread (``blocking=False``) so the loop keeps training
+        during the commit — the update_early_return overlap
+        (EmbeddingStoreOperator.cpp:42-57).
         """
         last = None
-        for i, batch in enumerate(batches):
-            state, metrics = self.train_step(state, batch)
+        it = iter(batches)
+        batch = next(it, None)
+        i = 0
+        while batch is not None:
+            nxt = next(it, None)
+            state, metrics = self.train_step(state, batch, next_batch=nxt)
             last = metrics
             if persist_dir:
                 for name, table in self.offload.items():
                     if table.should_persist:
                         info = table.persist(state.emb[name],
-                                             f"{persist_dir}/{name}")
+                                             f"{persist_dir}/{name}",
+                                             blocking=False)
                         if log_every:
                             log_fn(f"persisted {name}: {info}")
             if log_every and (i + 1) % log_every == 0:
                 log_fn(f"step {i + 1}: loss={float(metrics['loss']):.5f}")
+            batch = nxt
+            i += 1
+        # drain the pipeline: the LAST batch's deferred overflow counter and
+        # any in-flight background persist must raise HERE, not be lost
+        for table in self.offload.values():
+            table.finish()
         return state, last

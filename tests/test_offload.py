@@ -287,6 +287,105 @@ class TestShardedOffload:
         assert scores.shape == (32,)
 
 
+class TestPipelinedOffload:
+    """The prepare-ahead pipeline (host gather of batch N+1 overlapping
+    step N) and async persist must be bit-identical to the serial path —
+    overlap is a scheduling change, not a numerics change (the reference's
+    prefetch_pull_weights contract, exb_ops.cpp:109-205)."""
+
+    def _trainer(self, mesh, vocab=2048, cache=256):
+        import optax
+        from openembedding_tpu import EmbeddingCollection, Trainer
+        from openembedding_tpu.models import deepctr
+        from openembedding_tpu.offload import ShardedOffloadedTable
+        meta = EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=vocab)
+        table = ShardedOffloadedTable(
+            "off", meta, {"category": "adagrad", "learning_rate": 0.1},
+            {"category": "constant", "value": 0.25},
+            vocab=vocab, cache_capacity=cache, mesh=mesh,
+            persist_pending_window=2)
+        lin = ShardedOffloadedTable(
+            "off:linear",
+            EmbeddingVariableMeta(embedding_dim=1, vocabulary_size=vocab),
+            {"category": "adagrad", "learning_rate": 0.1},
+            {"category": "constant", "value": 0.25},
+            vocab=vocab, cache_capacity=cache, mesh=mesh,
+            persist_pending_window=2)
+        coll = EmbeddingCollection(
+            (table.embedding_spec(), lin.embedding_spec()), mesh)
+        trainer = Trainer(
+            deepctr.LogisticRegression(feature_names=("off",)),
+            coll, optax.sgd(0.1),
+            offload={"off": table, "off:linear": lin})
+        return trainer, table, lin
+
+    def _batches(self, n, vocab=2048, seed=0):
+        rng = np.random.RandomState(seed)
+        out = []
+        for i in range(n):
+            lo = (i * 300) % (vocab - 400)
+            ids = rng.randint(lo, lo + 400, 64).astype(np.int32)
+            out.append({"label": (ids % 2).astype(np.float32),
+                        "dense": None,
+                        "sparse": {"off": ids, "off:linear": ids}})
+        return out
+
+    def test_pipelined_fit_matches_serial_steps(self, devices8, tmp_path):
+        from openembedding_tpu.parallel.mesh import create_mesh
+        mesh = create_mesh(2, 4, devices8)
+        batches = self._batches(8)
+
+        # serial: explicit steps, no lookahead, blocking persist
+        t_ser, tab_ser, lin_ser = self._trainer(mesh)
+        s_ser = t_ser.init(jax.random.PRNGKey(0),
+                           t_ser.shard_batch(batches[0]))
+        for b in batches:
+            s_ser, m_ser = t_ser.train_step(s_ser, b)
+        tab_ser.flush(s_ser.emb["off"]); tab_ser._join_writeback()
+
+        # pipelined: fit with lookahead + background persist
+        t_pipe, tab_pipe, lin_pipe = self._trainer(mesh)
+        s_pipe = t_pipe.init(jax.random.PRNGKey(0),
+                             t_pipe.shard_batch(batches[0]))
+        s_pipe, m_pipe = t_pipe.fit(s_pipe, batches,
+                                    persist_dir=str(tmp_path / "p"))
+        tab_pipe._join_persist()
+        tab_pipe.flush(s_pipe.emb["off"]); tab_pipe._join_writeback()
+
+        assert float(m_ser["loss"]) == pytest.approx(float(m_pipe["loss"]),
+                                                     rel=1e-6)
+        np.testing.assert_array_equal(tab_ser.host_weights,
+                                      tab_pipe.host_weights)
+        assert tab_ser.work_id == tab_pipe.work_id
+
+        # the background persists committed a restorable chain
+        tab_r = self._trainer(mesh)[1]
+        c = tab_r.restore(str(tmp_path / "p" / "off"))
+        assert tab_r.persisted_work > 0
+        assert c.keys.shape[0] == tab_r.cache_capacity
+
+    def test_pipeline_survives_eviction_batches(self, devices8):
+        """A lookahead batch that would overflow the cache falls back to
+        the synchronous evict path mid-pipeline, values staying exact."""
+        from openembedding_tpu.parallel.mesh import create_mesh
+        mesh = create_mesh(2, 4, devices8)
+        batches = self._batches(10, seed=5)
+        t_small, tab_small, _ = self._trainer(mesh, cache=256)  # evicts
+        s = t_small.init(jax.random.PRNGKey(0),
+                         t_small.shard_batch(batches[0]))
+        s, _ = t_small.fit(s, batches)
+        tab_small.flush(s.emb["off"]); tab_small._join_writeback()
+
+        t_big, tab_big, _ = self._trainer(mesh, cache=2048)  # never evicts
+        s2 = t_big.init(jax.random.PRNGKey(0),
+                        t_big.shard_batch(batches[0]))
+        s2, _ = t_big.fit(s2, batches)
+        tab_big.flush(s2.emb["off"]); tab_big._join_writeback()
+        np.testing.assert_allclose(tab_small.host_weights,
+                                   tab_big.host_weights,
+                                   rtol=1e-5, atol=1e-6)
+
+
 _KILL_CHILD = r"""
 import os, signal, sys
 import jax
