@@ -834,14 +834,16 @@ def _insert_hash_rows(state, data, collection, sspec, with_opt,
                      dtype=raw_keys.dtype)
         ck[:got] = raw_keys
         if shard_slice is not None:
-            if raw_keys.ndim == 2:
-                raise ValueError(
-                    "serving shard slices over wide-key dumps are not "
-                    "supported yet; serve wide-key models unsliced")
             # serving shard group: non-owned keys become EMPTY (skipped by
-            # the insert path); owner rule matches the router's key % G
+            # the insert path). The owner rule is ``id % G`` on the JOINED
+            # 64-bit value — identical for every key width, so placement
+            # survives key migrations and matches the router's partition
+            # (ha.py ShardedRoutingClient) and the in-process filter
+            # (registry.py ServingModel.lookup / hash_table.pair_mod)
             k, G = shard_slice
-            ck[:got][(raw_keys % G) != k] = empty
+            ids64 = hash_lib.join64(raw_keys) if raw_keys.ndim == 2 \
+                else raw_keys.astype(np.int64)
+            ck[:got][(ids64 % G) != k] = empty
         wdtype = np.dtype(state.weights.dtype)
         cw = np.zeros((size,) + chunk["weights"].shape[1:], wdtype)
         cw[:got] = fs.view_as(chunk["weights"], wdtype)

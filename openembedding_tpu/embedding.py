@@ -114,11 +114,6 @@ class EmbeddingCollection:
                 raise ValueError(
                     f"embedding {spec.name!r}: unknown pooling "
                     f"{spec.pooling!r}; known: {ragged.POOLINGS}")
-            if spec.key_dtype == "wide" and spec.pooling is not None:
-                raise ValueError(
-                    f"embedding {spec.name!r}: pooling over wide-key "
-                    "(pair) inputs is not supported; hash the sequence "
-                    "ids into the int32/int64 space instead")
             self.specs[spec.name] = spec
             self._variable_ids[spec.name] = i
             self._optimizers[spec.name] = make_optimizer(
@@ -249,9 +244,12 @@ class EmbeddingCollection:
                     states[name], idx, mesh=self.mesh,
                     spec=self._shardings[name], batch_sharded=batch_sharded)
             if spec.pooling:
+                # wide sequence features carry [B, L, 2] pair ids; the
+                # combiner counts validity on the hi word (ragged.py)
                 r = ragged.pool_rows(r, idx, spec.pooling,
                                      ragged.pad_id_for(spec),
-                                     self._pool_vocab(spec))
+                                     self._pool_vocab(spec),
+                                     wide=spec.key_dtype == "wide")
             rows[name] = r
         return rows
 
@@ -275,7 +273,8 @@ class EmbeddingCollection:
                 # pooling VJP so each valid slot updates like a raw lookup
                 g = ragged.expand_pooled_grads(
                     g, inputs[name], spec.pooling, ragged.pad_id_for(spec),
-                    self._pool_vocab(spec))
+                    self._pool_vocab(spec),
+                    wide=spec.key_dtype == "wide")
             if spec.use_hash:
                 new_states[name] = sh.apply_gradients_sharded(
                     states[name], self._optimizers[name],

@@ -96,6 +96,25 @@ def join64(pairs: np.ndarray) -> np.ndarray:
     return (hi << np.int64(32)) | lo.astype(np.int64)
 
 
+def pair_mod(pairs: jnp.ndarray, g: int) -> jnp.ndarray:
+    """``join64(pairs) mod g`` computed in 32-bit words (x64-off safe).
+
+    The serving shard-group owner rule for wide keys — identical to the
+    narrow rule ``id % g`` on the joined 64-bit value, so a model keeps
+    its placement across key-width migrations (int32 dump -> wide table,
+    wide dump -> int64 table). Python-modulo semantics (result in
+    [0, g)): ``(hi*2^32 + lo_unsigned) mod g`` decomposes as
+    ``((hi mod g) * (2^32 mod g) + lo mod g) mod g``; every intermediate
+    fits int32 for any realistic shard count (g < 2^15).
+    """
+    if not 0 < g < (1 << 15):
+        raise ValueError(f"shard count {g} out of range [1, 2^15)")
+    hi_m = jnp.mod(pairs[..., 1], jnp.int32(g))           # in [0, g)
+    lo_m = (pairs[..., 0].astype(jnp.uint32)
+            % jnp.uint32(g)).astype(jnp.int32)
+    return jnp.mod(hi_m * jnp.int32((1 << 32) % g) + lo_m, jnp.int32(g))
+
+
 def _mix_pair(lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
     """32-bit-only avalanche over a key pair (x64-off safe)."""
     a = lo.astype(jnp.uint32)

@@ -31,9 +31,16 @@ POOLINGS = ("sum", "mean", "sqrtn")
 
 
 def pad_id_for(spec) -> int:
-    """Canonical padding id for one EmbeddingSpec's key space."""
+    """Canonical padding id for one EmbeddingSpec's key space.
+
+    Wide (64-bit pair) hash features pad with the EMPTY *hi word*
+    (INT32_MIN): a ``[B, L, 2]`` id matrix's padding slots carry
+    ``(EMPTY, EMPTY)`` pairs, and a pair is invalid iff its hi word is
+    EMPTY — the framework-wide wide-key invalidity rule."""
     if spec.use_hash:
         from . import hash_table as hash_lib
+        if spec.key_dtype == "wide":
+            return hash_lib.empty_key(jnp.int32)
         return hash_lib.empty_key(jnp.dtype(spec.key_dtype))
     return -1
 
@@ -64,47 +71,87 @@ def pad_ragged(sequences: Iterable[Sequence[int]],
     return out
 
 
+def pad_ragged_wide(sequences: Iterable[Sequence[int]],
+                    max_len: Optional[int] = None) -> np.ndarray:
+    """Host-side: variable-length INT64 id lists -> [B, L, 2] padded pair
+    matrix (``hash_table.split64`` per id; padding slots are (EMPTY, EMPTY)
+    pairs, invalid by the hi-word rule). The wide twin of
+    :func:`pad_ragged` for x64-off processes addressing the 2^62 space."""
+    from . import hash_table as hash_lib
+    empty = hash_lib.empty_key(jnp.int32)
+    seqs = [np.asarray(s, dtype=np.int64).ravel() for s in sequences]
+    if max_len is None:
+        max_len = max((s.size for s in seqs), default=1) or 1
+    out = np.full((len(seqs), max_len, 2), empty, dtype=np.int32)
+    for i, s in enumerate(seqs):
+        if s.size > max_len:
+            s = s[-max_len:]
+        if s.size:
+            pairs = hash_lib.split64(s)
+            # ids in [-2^63, -2^63+2^32) split to hi == EMPTY — they would
+            # read as padding and be silently dropped; the wide encoding
+            # excludes that band (same guard as the checkpoint loader)
+            banded = pairs[:, 1] == empty
+            if banded.any():
+                raise ValueError(
+                    f"sequence {i}: {int(banded.sum())} id(s) fall in the "
+                    "wide-key EMPTY band (ids in [-2^63, -2^63+2^32)); "
+                    "the pair encoding excludes that range")
+            out[i, :s.size] = pairs
+    return out
+
+
 def valid_mask(ids: jnp.ndarray, pad_id: int,
-               vocab: Optional[int] = None) -> jnp.ndarray:
-    """[B, L] bool: slots holding a real id (pull's validity contract)."""
+               vocab: Optional[int] = None,
+               wide: bool = False) -> jnp.ndarray:
+    """[B, L] bool: slots holding a real id (pull's validity contract).
+    ``wide``: ids are [B, L, 2] pairs, invalid iff the hi word is EMPTY."""
+    if wide:
+        return ids[..., 1] != jnp.asarray(pad_id, ids.dtype)
     if vocab is not None and pad_id == -1:
         return (ids >= 0) & (ids < vocab)
     return ids != jnp.asarray(pad_id, ids.dtype)
 
 
 def seq_lengths(ids: jnp.ndarray, pad_id: int,
-                vocab: Optional[int] = None) -> jnp.ndarray:
+                vocab: Optional[int] = None,
+                wide: bool = False) -> jnp.ndarray:
     """[B] count of valid ids per row (clamped below at 1 for division)."""
-    n = jnp.sum(valid_mask(ids, pad_id, vocab), axis=-1)
+    n = jnp.sum(valid_mask(ids, pad_id, vocab, wide), axis=-1)
     return jnp.maximum(n, 1)
 
 
 def _scale(pooling: str, ids: jnp.ndarray, pad_id: int,
-           vocab: Optional[int], dtype) -> jnp.ndarray:
+           vocab: Optional[int], dtype, wide: bool) -> jnp.ndarray:
     """[B, 1] divisor applied to the pooled sum (and to expanded grads)."""
     if pooling == "sum":
         return jnp.ones((ids.shape[0], 1), dtype)
-    n = seq_lengths(ids, pad_id, vocab).astype(dtype)[:, None]
+    n = seq_lengths(ids, pad_id, vocab, wide).astype(dtype)[:, None]
     return n if pooling == "mean" else jnp.sqrt(n)
 
 
 def pool_rows(rows: jnp.ndarray, ids: jnp.ndarray, pooling: str,
-              pad_id: int, vocab: Optional[int] = None) -> jnp.ndarray:
+              pad_id: int, vocab: Optional[int] = None,
+              wide: bool = False) -> jnp.ndarray:
     """[B, L, dim] -> [B, dim] combiner. Padding rows are zero by contract,
-    so the sum needs no mask; mean/sqrtn divide by the true lengths."""
+    so the sum needs no mask; mean/sqrtn divide by the true lengths.
+    ``wide``: ids are [B, L, 2] (lo, hi) pairs (full 64-bit key space,
+    reference RaggedTensor-over-hash lookups, exb.py:315-321)."""
     if pooling not in POOLINGS:
         raise ValueError(f"unknown pooling {pooling!r}; known: {POOLINGS}")
     if rows.ndim != 3:
         raise ValueError(
             f"pooling needs [B, L, dim] rows, got shape {rows.shape} — "
-            "sequence features take [B, L] padded id matrices")
+            "sequence features take [B, L] padded id matrices "
+            "([B, L, 2] pair matrices for wide keys)")
     s = jnp.sum(rows, axis=1)
-    return s / _scale(pooling, ids, pad_id, vocab, s.dtype)
+    return s / _scale(pooling, ids, pad_id, vocab, s.dtype, wide)
 
 
 def expand_pooled_grads(g: jnp.ndarray, ids: jnp.ndarray, pooling: str,
                         pad_id: int,
-                        vocab: Optional[int] = None) -> jnp.ndarray:
+                        vocab: Optional[int] = None,
+                        wide: bool = False) -> jnp.ndarray:
     """VJP of :func:`pool_rows` wrt the rows: [B, dim] -> [B, L, dim].
 
     Every valid slot receives the pooled grad (scaled for mean/sqrtn);
@@ -113,6 +160,6 @@ def expand_pooled_grads(g: jnp.ndarray, ids: jnp.ndarray, pooling: str,
     """
     if pooling not in POOLINGS:
         raise ValueError(f"unknown pooling {pooling!r}; known: {POOLINGS}")
-    scaled = g / _scale(pooling, ids, pad_id, vocab, g.dtype)
+    scaled = g / _scale(pooling, ids, pad_id, vocab, g.dtype, wide)
     return jnp.broadcast_to(scaled[:, None, :],
                             (ids.shape[0], ids.shape[1], g.shape[-1]))

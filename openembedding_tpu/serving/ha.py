@@ -489,11 +489,41 @@ class ShardedRoutingClient:
     def shard_count(self) -> int:
         return len(self.groups)
 
-    def lookup(self, sign: str, variable: Any, indices) -> np.ndarray:
+    def lookup(self, sign: str, variable: Any, indices, *,
+               wide: bool = False) -> np.ndarray:
+        """Partition ``indices`` by owner group, fan out, merge by position.
+
+        ``wide=True``: indices are ``[..., 2]`` int32 (lo, hi) pairs (the
+        x64-off 64-bit key encoding, ``hash_table.split64``); the owner is
+        ``joined_id % G`` — the same rule the loader's shard slice and the
+        in-process filter apply, so every pair routes to the group that
+        holds its row.
+        """
         idx = np.asarray(indices)
-        flat = idx.ravel()
         G = self.shard_count
-        owner = flat % G
+        if wide:
+            from .. import hash_table as hash_lib
+            if idx.ndim < 2 or idx.shape[-1] != 2:
+                raise ValueError(
+                    f"wide lookup takes [..., 2] int32 pairs "
+                    f"(hash_table.split64), got shape {idx.shape}")
+            if idx.dtype != np.int32:
+                # nested Python lists arrive int64; the WORD values must
+                # still be int32 (anything bigger is a raw 64-bit id that
+                # belongs in split64, not a pair word)
+                if (idx > np.iinfo(np.int32).max).any() or \
+                        (idx < np.iinfo(np.int32).min).any():
+                    raise ValueError(
+                        "wide lookup pair words exceed int32 — pass "
+                        "hash_table.split64(ids), not raw 64-bit ids")
+                idx = idx.astype(np.int32)
+            flat = np.ascontiguousarray(idx.reshape(-1, 2))
+            owner = hash_lib.join64(flat) % G
+            out_shape = idx.shape[:-1]
+        else:
+            flat = idx.ravel()
+            owner = flat % G
+            out_shape = idx.shape
         rows = None
         for k in range(G):
             sel = np.nonzero(owner == k)[0]
@@ -501,11 +531,12 @@ class ShardedRoutingClient:
                 continue
             part = self.groups[k].lookup(sign, variable, flat[sel])
             if rows is None:
-                rows = np.zeros((flat.size,) + part.shape[1:], part.dtype)
+                rows = np.zeros((flat.shape[0],) + part.shape[1:],
+                                part.dtype)
             rows[sel] = part
         if rows is None:
             rows = np.zeros((0, 0), np.float32)
-        return rows.reshape(idx.shape + rows.shape[1:])
+        return rows.reshape(out_shape + rows.shape[1:])
 
     def create_model(self, model_uri: str, *,
                      model_sign: Optional[str] = None,

@@ -112,9 +112,26 @@ class ServingModel:
                 else self._by_id[int(variable)])
         idx = jnp.asarray(indices)
         if self.shard_slice is not None:
+            # owner rule: id % G on the (joined) 64-bit value — must match
+            # the loader's slice filter (checkpoint._insert_hash_rows) and
+            # the router's partition (ha.ShardedRoutingClient.lookup)
             k, G = self.shard_slice
-            if not self.collection.specs[name].use_hash:
+            spec = self.collection.specs[name]
+            if not spec.use_hash:
                 idx = jnp.where(idx % G == k, idx // G, -1)
+            elif spec.key_dtype == "wide":
+                from .. import hash_table as hash_lib
+                # [.., 2] pairs: owner on the JOINED value, non-owned pairs
+                # masked WHOLE (an elementwise % would test the lo and hi
+                # words independently — corrupting pairs)
+                if idx.ndim < 2 or idx.shape[-1] != 2:
+                    raise ValueError(
+                        f"variable {name!r} takes [..., 2] int32 pair "
+                        f"queries (hash_table.split64), got shape "
+                        f"{idx.shape}")
+                empty = hash_lib.empty_key(jnp.int32)
+                owned = hash_lib.pair_mod(idx, G) == k
+                idx = jnp.where(owned[..., None], idx, empty)
             else:
                 from .. import hash_table as hash_lib
                 empty = hash_lib.empty_key(idx.dtype)

@@ -543,6 +543,79 @@ def test_category_hotswap_array_to_wide_hash(devices8, tmp_path):
     np.testing.assert_array_equal(got_a, want)
 
 
+def test_wide_key_dump_shard_slices(devices8, tmp_path):
+    """Serving shard slices over WIDE-key dumps: each slice holds exactly
+    the keys with ``joined_id % G == k`` (owner on the 64-bit value) —
+    the at-scale combination the reference serves unconditionally
+    (client/Model.cpp:153-186). Also covers the array-dump->wide-hash +
+    slice combination (the slice applies to the int64 ids BEFORE the pair
+    conversion)."""
+    from openembedding_tpu import hash_table as hl
+    mesh = create_mesh(2, 4, devices8)
+    serve_mesh = create_mesh(1, 1, jax.devices()[:1])
+    G = 3
+    # -- wide hash dump, sliced --------------------------------------------
+    coll_w = EmbeddingCollection(
+        (EmbeddingSpec(name="v", input_dim=-1, output_dim=DIM,
+                       hash_capacity=512, key_dtype="wide",
+                       initializer={"category": "constant", "value": 0.0},
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 1.0}),), mesh)
+    states = coll_w.init(jax.random.PRNGKey(0))
+    keys64 = np.concatenate([
+        (3 << 60) + np.arange(1, 17, dtype=np.int64),
+        (3 << 60) + (np.arange(1, 17, dtype=np.int64) << 32)])
+    pairs = jnp.asarray(hl.split64(keys64))
+    g = jnp.broadcast_to(
+        (np.arange(1, 33, dtype=np.float32) / 10.0)[:, None],
+        (32, DIM))
+    states = coll_w.apply_gradients(states, {"v": pairs}, {"v": g},
+                                    batch_sharded=False)
+    want = np.asarray(coll_w.pull(states, {"v": pairs},
+                                  batch_sharded=False, read_only=True)["v"])
+    p = str(tmp_path / "wide")
+    ckpt.save_checkpoint(p, coll_w, states)
+    owners = keys64 % G
+    for k in range(G):
+        coll_k = EmbeddingCollection(
+            (EmbeddingSpec(name="v", input_dim=-1, output_dim=DIM,
+                           hash_capacity=512, key_dtype="wide",
+                           optimizer={"category": "default"}),), serve_mesh)
+        loaded = ckpt.load_checkpoint(p, coll_k, shard_slice=(k, G))
+        got = np.asarray(coll_k.pull(
+            loaded, {"v": pairs}, batch_sharded=False, read_only=True)["v"])
+        # owned keys: exact rows; non-owned: zero rows (absent)
+        np.testing.assert_array_equal(got[owners == k], want[owners == k])
+        np.testing.assert_array_equal(got[owners != k], 0.0)
+        # the slice holds exactly its share of live rows
+        assert int(jax.device_get(loaded["v"].num_used())) \
+            == int((owners == k).sum())
+
+    # -- array dump -> wide hash table, sliced (slice before pair split) ----
+    coll_a = EmbeddingCollection(
+        (EmbeddingSpec(name="v", input_dim=VOCAB, output_dim=DIM,
+                       initializer={"category": "normal", "stddev": 1.0},
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 0.5}),), mesh)
+    st_a = coll_a.init(jax.random.PRNGKey(4))
+    pa = str(tmp_path / "arr")
+    ckpt.save_checkpoint(pa, coll_a, st_a)
+    allv = np.arange(VOCAB, dtype=np.int64)
+    want_a = np.asarray(
+        coll_a.pull(st_a, {"v": jnp.arange(VOCAB, dtype=jnp.int32)},
+                    batch_sharded=False)["v"])
+    coll_k = EmbeddingCollection(
+        (EmbeddingSpec(name="v", input_dim=-1, output_dim=DIM,
+                       hash_capacity=4 * VOCAB, key_dtype="wide",
+                       optimizer={"category": "default"}),), serve_mesh)
+    loaded = ckpt.load_checkpoint(pa, coll_k, shard_slice=(1, G))
+    ap = jnp.asarray(hl.split64(allv))
+    got = np.asarray(coll_k.pull(loaded, {"v": ap}, batch_sharded=False,
+                                 read_only=True)["v"])
+    np.testing.assert_array_equal(got[allv % G == 1], want_a[allv % G == 1])
+    np.testing.assert_array_equal(got[allv % G != 1], 0.0)
+
+
 def test_hash_key_width_migration(devices8, tmp_path):
     """int32-key hash dumps load into key_dtype='wide' variables (key-space
     migration) and wide dumps refuse narrow tables when keys overflow."""

@@ -183,6 +183,22 @@ def test_bucket_layout_and_rounding():
         ht.table_layout(1000, ht.DEFAULT_MAX_PROBES)
 
 
+def test_pair_mod_matches_int64_mod():
+    """pair_mod (the x64-off wide-key shard-owner rule) equals int64
+    ``id % g`` for every sign/magnitude — the loader, in-process filter,
+    and router all rely on this equivalence."""
+    rng = np.random.RandomState(0)
+    ids = np.concatenate([
+        rng.randint(-2**62, 2**62, 5000).astype(np.int64),
+        np.array([0, 1, -1, 2**62 - 1, -2**62, (3 << 60) + (5 << 32)])])
+    pairs = jnp.asarray(ht.split64(ids))
+    for g in (1, 2, 3, 7, 16, 1000, 32767):
+        np.testing.assert_array_equal(
+            np.asarray(ht.pair_mod(pairs, g)), ids % g)
+    with pytest.raises(ValueError, match="shard count"):
+        ht.pair_mod(pairs, 1 << 15)
+
+
 def test_pallas_probe_gather_parity():
     """Fused Pallas probe+gather (interpret mode) matches find_rows+take.
 

@@ -112,6 +112,60 @@ def test_hash_sequence_feature(devices8):
     assert int(jax.device_get(states["h"].num_used())) == 3
 
 
+def test_wide_key_sequence_feature(devices8):
+    """Pooling over WIDE (64-bit pair) hash keys: a DIN-style behavior
+    history addressing the full 2^62 space in an x64-off process —
+    reference RaggedTensor lookups over input_dim=-1 hash variables
+    (exb.py:315-321 + 231-233). Padding is the (EMPTY, EMPTY) pair."""
+    from openembedding_tpu import hash_table as hl
+    mesh = create_mesh(2, 4, devices8)
+    spec = EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
+                         hash_capacity=512, pooling="mean",
+                         key_dtype="wide",
+                         initializer={"category": "constant", "value": 0.5})
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    big = 3 << 60
+    ids = jnp.asarray(ragged.pad_ragged_wide(
+        [[big + 1, big + 2], [big + 3], []], max_len=2))
+    assert ids.shape == (3, 2, 2)
+    ids = jnp.tile(ids, (4, 1, 1))[:8]
+    rows = np.asarray(coll.pull(states, {"h": ids})["h"])
+    assert rows.shape == (8, DIM)
+    # missing keys -> init rows (0.5); mean over valid slots stays 0.5,
+    # all-padding sequences pool to zeros
+    np.testing.assert_allclose(rows[0], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(rows[2], 0.0)
+    g = jnp.ones((8, DIM), jnp.float32)
+    states = coll.apply_gradients(states, {"h": ids}, {"h": g})
+    assert int(states["h"].insert_failures) == 0
+    assert int(jax.device_get(states["h"].num_used())) == 3
+    # the materialized keys are the true 64-bit ids, not truncations
+    keys = np.asarray(jax.device_get(states["h"].keys))
+    live = keys[keys[:, 1] != hl.empty_key(np.int32)]
+    assert set(hl.join64(live)) == {big + 1, big + 2, big + 3}
+    # gradient parity with the manually expanded raw-lookup update: row 0's
+    # two history slots each got g/2 (mean pooling over 2 valid ids)
+    raw = EmbeddingCollection(
+        (EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
+                       hash_capacity=512, key_dtype="wide",
+                       initializer={"category": "constant", "value": 0.5}),),
+        mesh)
+    s_raw = raw.init(jax.random.PRNGKey(0))
+    lengths = np.maximum((np.asarray(ids)[..., 1]
+                          != hl.empty_key(np.int32)).sum(1), 1)
+    expanded = jnp.broadcast_to(
+        (g / jnp.asarray(lengths, jnp.float32)[:, None])[:, None, :],
+        (8, 2, DIM))
+    s_raw = raw.apply_gradients(s_raw, {"h": ids}, {"h": expanded})
+    got = coll.pull(states, {"h": ids})["h"]
+    want = raw.pull(s_raw, {"h": ids})["h"]
+    # pooled pull of pooled-updated table == pooled manual of raw-updated
+    np.testing.assert_allclose(
+        np.asarray(got),
+        np.asarray(want).sum(1) / lengths[:, None], rtol=1e-5, atol=1e-6)
+
+
 def test_invalid_pooling_rejected_at_construction(devices8):
     mesh = create_mesh(2, 4, devices8)
     with pytest.raises(ValueError, match="avg"):

@@ -93,6 +93,104 @@ def _lookup_retry(fn, deadline_s=60.0):
             time.sleep(0.5)
 
 
+WSIGN = "sharded-wide-1"
+
+
+@pytest.fixture(scope="module")
+def wide_sharded_model(tmp_path_factory):
+    """Checkpoint holding a WIDE (64-bit pair) hash variable with
+    row-distinguishable values + the expected rows."""
+    from openembedding_tpu import hash_table as hl
+    path = str(tmp_path_factory.mktemp("wsharded") / "model")
+    mesh = create_mesh(1, 1, jax.devices()[:1])
+    spec = EmbeddingSpec(name="wh", input_dim=-1, output_dim=DIM,
+                         hash_capacity=512, key_dtype="wide",
+                         initializer={"category": "constant", "value": 0.0},
+                         optimizer={"category": "sgd", "learning_rate": 1.0})
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(7))
+    # 2^62-scale keys, some differing ONLY in the hi word — with G=3 the
+    # owner depends on both words (2^32 % 3 == 1), so routing must join
+    keys64 = np.concatenate([
+        (3 << 60) + np.arange(1, 21, dtype=np.int64),
+        (3 << 60) + (np.arange(1, 21, dtype=np.int64) << 32)])
+    pairs = jnp.asarray(hl.split64(keys64))
+    rows = coll.pull(states, {"wh": pairs}, batch_sharded=False)
+    g = jnp.broadcast_to((np.arange(1, 41, dtype=np.float32) / 100.0)
+                         [:, None], rows["wh"].shape)
+    states = coll.apply_gradients(states, {"wh": pairs}, {"wh": g},
+                                  batch_sharded=False)
+    ckpt.save_checkpoint(path, coll, states, model_sign=WSIGN)
+    want = np.asarray(coll.pull(states, {"wh": pairs}, batch_sharded=False,
+                                read_only=True)["wh"])
+    return path, keys64, want
+
+
+def test_wide_key_shard_groups(wide_sharded_model):
+    """Shard-sliced serving of a WIDE-key model: G=3 groups each load the
+    slice ``joined_id % 3 == k`` of a 2^62-key-space dump; the router
+    partitions pair queries by the same joined-owner rule and merges —
+    the at-scale combination (full 64-bit key space AND model larger than
+    one process; reference places ANY model sharded,
+    client/Model.cpp:153-186)."""
+    from openembedding_tpu import hash_table as hl
+    path, keys64, want = wide_sharded_model
+    G = 3
+    ports = [_free_port() for _ in range(G)]
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    procs = {}
+    try:
+        for k in range(G):
+            procs[k] = ha.spawn_replica(
+                ports[k], load=[f"{WSIGN}={path}"],
+                shard_index=k, shard_count=G)
+        for k in range(G):
+            assert ha.wait_ready(eps[k], sign=WSIGN, timeout=180.0), \
+                _tail(procs[k])
+
+        router = ha.ShardedRoutingClient([[e] for e in eps], timeout=15.0)
+        pairs = hl.split64(keys64)
+        got = _lookup_retry(
+            lambda: router.lookup(WSIGN, "wh", pairs, wide=True))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+        # batch-shaped pair queries keep their leading shape
+        got2 = _lookup_retry(lambda: router.lookup(
+            WSIGN, "wh", pairs.reshape(8, 5, 2), wide=True))
+        np.testing.assert_allclose(got2, want.reshape(8, 5, DIM),
+                                   rtol=1e-6, atol=1e-7)
+
+        # every group holds a nonempty slice, and each process holds ONLY
+        # its slice: probing group k directly with a non-owned pair gives
+        # a zero row (the in-process joined-owner filter)
+        owners = keys64 % G
+        assert set(owners.tolist()) == set(range(G))
+        for k in range(G):
+            other = np.nonzero(owners != k)[0][0]
+            solo = ha.RoutingClient([eps[k]], timeout=15.0)
+            direct = _lookup_retry(
+                lambda: solo.lookup(WSIGN, "wh", pairs[[other]]))
+            np.testing.assert_array_equal(direct, 0.0)
+            mine = np.nonzero(owners == k)[0][0]
+            direct = _lookup_retry(
+                lambda: solo.lookup(WSIGN, "wh", pairs[[mine]]))
+            np.testing.assert_allclose(direct, want[[mine]], rtol=1e-6,
+                                       atol=1e-7)
+
+        # kill one group: ITS keys fail (outage, not silent zeros);
+        # the surviving groups keep serving theirs
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait()
+        dead = np.nonzero(owners == 0)[0]
+        live = np.nonzero(owners != 0)[0]
+        with pytest.raises(ConnectionError):
+            router.lookup(WSIGN, "wh", pairs[dead[:1]], wide=True)
+        got3 = _lookup_retry(
+            lambda: router.lookup(WSIGN, "wh", pairs[live], wide=True))
+        np.testing.assert_allclose(got3, want[live], rtol=1e-6, atol=1e-7)
+    finally:
+        _cleanup(procs)
+
+
 def test_shard_groups_with_replicas(sharded_model):
     path, want_emb, want_hsh = sharded_model
     G, R = 2, 2
