@@ -549,6 +549,76 @@ def run_auc_criteo(name, config, *, steps, warmup):
     }
 
 
+def run_serving_lookup(name, config, *, steps, warmup):
+    """Serving data-plane latency: binary (the default) vs JSON lookup on a
+    live replica daemon — quantifies why the routed plane is packed bytes
+    (the reference's zero-copy RpcView, server/RpcView.h:63-105). The
+    replica is a CPU child process (no device involvement)."""
+    import shutil
+    import socket
+    import tempfile
+    import jax
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as ckpt
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.serving import ha
+
+    mesh = create_mesh(1, 1, jax.devices()[:1])
+    dim, batch = config["dim"], config["batch"]
+    specs = (EmbeddingSpec(name="emb", input_dim=config["vocab"],
+                           output_dim=dim,
+                           initializer={"category": "normal",
+                                        "stddev": 1.0}),)
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    d = tempfile.mkdtemp(prefix="bench_serving_")
+    proc = None
+    try:
+        ckpt.save_checkpoint(d, coll, states, model_sign="bench-serve-1")
+        del states
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = ha.spawn_replica(port, load=[f"bench-serve-1={d}"])
+        ep = f"127.0.0.1:{port}"
+        if not ha.wait_ready(ep, sign="bench-serve-1", timeout=300.0):
+            raise RuntimeError("bench replica failed to become ready")
+        router = ha.RoutingClient([ep], timeout=60.0)
+        rng = np.random.RandomState(0)
+        idx = rng.randint(0, config["vocab"], batch).astype(np.int32)
+        out = {}
+        for mode, fn in (("bin", router.lookup_bin),
+                         ("json", router.lookup_json)):
+            fn("bench-serve-1", "emb", idx)  # warm (compile + route)
+            times = []
+            for _ in range(max(5, min(steps, 30))):
+                t0 = time.perf_counter()
+                fn("bench-serve-1", "emb", idx)
+                times.append(time.perf_counter() - t0)
+            out[f"{mode}_ms"] = round(_median(times) * 1e3, 2)
+        return {
+            "metric": f"{name}",
+            "value": out["bin_ms"],
+            "unit": "ms/lookup_batch",
+            "vs_baseline": round(out["json_ms"]
+                                 / max(out["bin_ms"], 1e-9), 2),
+            **out,
+            "batch": batch,
+            "dim": dim,
+            "config": dict(config),
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            # CPU child (tunnel env scrubbed at spawn) — safe to terminate
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+                proc.wait()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def run_ckpt_local(name, config, *, steps, warmup):
     """Checkpoint throughput measured where the disk is: a CPU-backend
     subprocess on THIS host writes/reads a local dump, so the tunneled
@@ -670,11 +740,16 @@ CONFIGS = {
     # tunneled device->host link is not the thing being measured)
     "ckpt_local_2gb": {"kind": "ckpt_local", "vocab": 1 << 25, "dim": 8,
                        "devices": 4},
+    # serving data plane: binary (default) vs JSON lookup latency against a
+    # live replica daemon; value = binary ms, vs_baseline = json/bin ratio
+    "serving_lookup": {"kind": "serving_lookup", "vocab": 1 << 16,
+                       "dim": 64, "batch": 4096},
 }
 HEADLINE = "deepfm_dim9"
 RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
            "hash_probe": run_hash_probe,
-           "auc": run_auc_criteo, "ckpt_local": run_ckpt_local}
+           "auc": run_auc_criteo, "ckpt_local": run_ckpt_local,
+           "serving_lookup": run_serving_lookup}
 
 
 def _device_watchdog(timeout_s: int = 300) -> None:

@@ -408,7 +408,14 @@ class RoutingClient:
     # -- serving API -------------------------------------------------------
     def lookup(self, sign: str, variable: Any, indices) -> np.ndarray:
         """Read-only pull with replica failover (never fails while one
-        replica lives — the chaos-test invariant)."""
+        replica lives — the chaos-test invariant). Rides the BINARY
+        protocol — the default data plane (the reference's serving plane is
+        zero-copy binary throughout, server/RpcView.h:63-105); see
+        :meth:`lookup_json` for the debug-friendly JSON twin."""
+        return self.lookup_bin(sign, variable, indices)
+
+    def lookup_json(self, sign: str, variable: Any, indices) -> np.ndarray:
+        """JSON-marshalled pull (human-readable wire, for debugging)."""
         out = self._failover(
             "POST", f"/models/{sign}/lookup",
             {"variable": variable,
@@ -416,13 +423,15 @@ class RoutingClient:
         return np.asarray(out["rows"], dtype=np.float32)
 
     def lookup_bin(self, sign: str, variable: Any, indices) -> np.ndarray:
-        """Binary-protocol pull: packed ids out, packed f32 rows back — the
-        serving-grade data plane (no JSON list marshalling; the reference's
-        zero-copy RpcView role, server/RpcView.h). Same failover rotation
-        as :meth:`lookup`."""
+        """Binary-protocol pull: packed ids out, packed f32 rows back — no
+        JSON list marshalling (the reference's zero-copy RpcView role,
+        server/RpcView.h). The request header carries the index SHAPE, so
+        wide [n, 2] pair queries and multi-dim batch shapes reconstruct
+        exactly server-side. Same failover rotation as :meth:`lookup`."""
         idx = np.ascontiguousarray(np.asarray(indices))
         head = json.dumps({"variable": variable,
-                           "dtype": idx.dtype.name}).encode() + b"\n"
+                           "dtype": idx.dtype.name,
+                           "shape": list(idx.shape)}).encode() + b"\n"
         body = head + idx.tobytes()
         order = list(self.endpoints)
         start = random.randrange(len(order))
@@ -435,7 +444,7 @@ class RoutingClient:
                 nl = raw.index(b"\n")
                 h = json.loads(raw[:nl])
                 return np.frombuffer(raw[nl + 1:], np.float32).reshape(
-                    h["n"], h["dim"])
+                    h["shape"])
             except urllib.error.HTTPError as e:
                 if e.code in (409, 503):
                     last_err = e

@@ -183,18 +183,21 @@ def make_handler(registry: ModelRegistry, peers=None):
                 if m:
                     # serving-grade data plane: packed ids in, packed f32
                     # rows out — no JSON list marshalling (the reference's
-                    # zero-copy RpcView role, server/RpcView.h)
+                    # zero-copy RpcView role, server/RpcView.h). The header
+                    # carries the index SHAPE: wide [n, 2] pair queries and
+                    # multi-dim batches reconstruct exactly (a flat view
+                    # would misread pairs as ids)
                     n = int(self.headers.get("Content-Length", 0))
                     raw = self.rfile.read(n)
                     nl = raw.index(b"\n")
                     head = json.loads(raw[:nl])
-                    idx = np.frombuffer(raw[nl + 1:],
-                                        dtype=np.dtype(head["dtype"]))
+                    idx = np.frombuffer(
+                        raw[nl + 1:],
+                        dtype=np.dtype(head["dtype"])).reshape(head["shape"])
                     model = registry.find_model(m.group(1))
                     rows = np.asarray(model.lookup(head["variable"], idx),
                                       dtype=np.float32)
-                    hdr = json.dumps({"n": int(rows.shape[0]),
-                                      "dim": int(rows.shape[1])}
+                    hdr = json.dumps({"shape": list(rows.shape)}
                                      ).encode() + b"\n"
                     payload = hdr + rows.tobytes()
                     self.send_response(200)

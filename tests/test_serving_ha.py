@@ -164,8 +164,10 @@ def _tail(proc, n=20):
 
 
 def test_binary_lookup_parity(model_dir):
-    """lookup_bin (packed-bytes data plane) returns the same rows as the
-    JSON lookup — the serving-grade protocol, reference RpcView role."""
+    """The binary plane (now the DEFAULT: lookup == lookup_bin) returns
+    the same rows as the JSON debug path, and its shape header round-trips
+    multi-dim batch queries exactly — the serving-grade protocol,
+    reference zero-copy RpcView (server/RpcView.h:63-105)."""
     port = _free_port()
     proc = ha.spawn_replica(port, load=[f"{SIGN}={model_dir}"])
     try:
@@ -173,9 +175,19 @@ def test_binary_lookup_parity(model_dir):
         assert ha.wait_ready(ep, sign=SIGN), _tail(proc)
         router = ha.RoutingClient([ep], timeout=15.0)
         idx = np.asarray([1, 7, 63], np.int32)
-        a = router.lookup(SIGN, "emb", idx)
+        a = router.lookup_json(SIGN, "emb", idx)
         b = router.lookup_bin(SIGN, "emb", idx)
+        c = router.lookup(SIGN, "emb", idx)  # default == binary
         np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(b, c)
+        # multi-dim batch shape survives the wire (the header carries it;
+        # a flat view would silently collapse [2, 3] to [6])
+        m = router.lookup(SIGN, "emb", idx.reshape(1, 3).repeat(2, 0))
+        assert m.shape == (2, 3, a.shape[-1])
+        np.testing.assert_array_equal(m[0], a)
+        # int64 ids keep their width end-to-end (dtype rides the header)
+        d = router.lookup(SIGN, "emb", np.asarray([1, 7, 63], np.int64))
+        np.testing.assert_array_equal(d, a)
     finally:
         proc.kill()
 
