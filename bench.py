@@ -44,7 +44,8 @@ def build(config, mesh):
             features, -1 if config.get("hash") else config["vocab"],
             config["dim"],
             optimizer={"category": "adagrad", "learning_rate": 0.01},
-            hash_capacity=config.get("hash_capacity", 1 << 22))
+            hash_capacity=config.get("hash_capacity", 1 << 22),
+            key_dtype=config.get("key_dtype", "wide"))
     else:
         specs = deepctr.make_feature_specs(
             features, config["vocab"], config["dim"],
@@ -489,11 +490,15 @@ def run_hash_probe(name, config, *, steps, warmup):
 
 
 def run_auc_criteo(name, config, *, steps, warmup):
-    """AUC on REAL Criteo rows (the reference's own example fixture) —
+    """HELD-OUT AUC on REAL Criteo rows (the reference's example fixture) —
     proves the data path + optimizer semantics end-to-end, not just on
     synthetic zipf. Reference flow: test/benchmark/criteo_deepctr.py AUC.
-    Uses ``CRITEO_DATA`` when set (a preprocess-CLI sample); falls back to
-    the reference's checked-in 100-row train100.csv."""
+    Uses ``CRITEO_DATA`` when set (point it at the largest preprocess-CLI
+    sample available); falls back to the reference's checked-in 100-row
+    train100.csv. Rows are split 70/30 train/eval; ``value`` is the EVAL
+    AUC (train AUC rides alongside — on the 100-row fixture the eval split
+    is ~30 rows, so treat the number as an end-to-end smoke signal; the
+    cross-plane statement lives in ``plane_parity``)."""
     import os
     import jax
     import optax
@@ -507,7 +512,21 @@ def run_auc_criteo(name, config, *, steps, warmup):
     path = os.environ.get("CRITEO_DATA",
                           "/root/reference/examples/train100.csv")
     batch = config["batch"]
-    rows = list(criteo.read_criteo_csv(path, batch_size=batch))
+    rows = list(criteo.read_criteo_csv(path, batch_size=1))
+    n_eval = max(1, int(len(rows) * config.get("eval_frac", 0.3)))
+    train_rows, eval_rows = rows[:-n_eval], rows[-n_eval:]
+
+    def rebatch(rws, bsz):
+        out = []
+        for lo in range(0, len(rws), bsz):
+            sub = rws[lo:lo + bsz]
+            out.append({
+                "label": np.concatenate([r["label"] for r in sub]),
+                "dense": np.concatenate([r["dense"] for r in sub]),
+                "sparse": {k: np.concatenate([r["sparse"][k] for r in sub])
+                           for k in sub[0]["sparse"]}})
+        return out
+
     features = tuple(criteo.SPARSE_NAMES)
     specs, mapper = make_fused_specs(
         features, -1, config["dim"],
@@ -519,7 +538,9 @@ def run_auc_criteo(name, config, *, steps, warmup):
     coll = EmbeddingCollection(specs, mesh)
     trainer = Trainer(deepctr.build_model("deepfm", features), coll,
                       optax.adagrad(0.05))
-    batches = [mapper.fuse_batch(b) for b in rows]
+    batches = [mapper.fuse_batch(b) for b in rebatch(train_rows, batch)]
+    eval_batches = [mapper.fuse_batch(b)
+                    for b in rebatch(eval_rows, batch)]
     state = trainer.init(jax.random.PRNGKey(0),
                          trainer.shard_batch(batches[0]))
     n_seen = 0
@@ -530,21 +551,149 @@ def run_auc_criteo(name, config, *, steps, warmup):
             n_seen += int(np.asarray(b["label"]).shape[0])
     jax.block_until_ready(m["loss"])
     dt = time.perf_counter() - t0
-    # in-sample AUC over the fixture (the reference example reports
-    # training AUC the same way on this file)
-    auc = StreamingAUC()
-    for b in batches:
-        scores = trainer.eval_step(state, b)
-        auc.update(b["label"], np.asarray(scores))
-    a = float(auc.result())
+
+    def auc_over(bs):
+        auc = StreamingAUC()
+        for b in bs:
+            scores = trainer.eval_step(state, b)
+            auc.update(b["label"], np.asarray(scores))
+        return float(auc.result())
+
+    eval_auc = auc_over(eval_batches)
+    train_auc = auc_over(batches)
     return {
         "metric": f"{name}_{platform}{n_dev}",
-        "value": round(a, 4),
-        "unit": "auc",
-        "vs_baseline": round(a / 0.5, 3),
+        "value": round(eval_auc, 4),
+        "unit": "eval_auc",
+        "vs_baseline": round(eval_auc / 0.5, 3),
+        "train_auc": round(train_auc, 4),
+        "train_rows": len(train_rows),
+        "eval_rows": len(eval_rows),
         "examples_per_sec": round(n_seen / dt, 1),
-        "rows": int(sum(np.asarray(b["label"]).shape[0] for b in batches)),
         "data": path,
+        "config": dict(config),
+    }
+
+
+def run_plane_parity(name, config, *, steps, warmup):
+    """Cross-plane AUC/loss parity: a2a, psum, hybrid (sparse_as_dense),
+    and offload planes trained on IDENTICAL data + seeds must agree — the
+    strongest correctness statement this single-chip environment can make
+    (the reference's analogue: its one-node vs N-node AUC agreement,
+    documents/en/benchmark.md). SGD + constant init end-to-end, so the
+    planes are exactly comparable (random init folds PRNGs per shard and
+    would differ across layouts by construction). ``value`` is the max
+    pairwise held-out-AUC spread (0 = exact)."""
+    import jax
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                                   Trainer)
+    from openembedding_tpu.hybrid import split_sparse_dense
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu import EmbeddingVariableMeta
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.utils.observability import StreamingAUC
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    batch, dim, vocab = config["batch"], config["dim"], config["vocab"]
+    n_steps = config.get("train_steps", 40)
+    feats = ("uid", "item")
+    # linear-only model (LogisticRegression): one lr drives both the
+    # sparse rows and the (absent) dense net, so every plane — including
+    # hybrid, whose embeddings live inside the dense optimizer — trains
+    # under identical dynamics
+    names = tuple(f + ":linear" for f in feats)
+    rng = np.random.RandomState(0)
+
+    def make_batch():
+        uid = rng.randint(0, vocab, batch).astype(np.int32)
+        item = rng.randint(0, vocab, batch).astype(np.int32)
+        # learnable structure with MAIN effects (zero-init embeddings sit
+        # on the symmetric saddle of pure-interaction labels)
+        label = (((uid % 3 == 0) | (item % 2 == 0))
+                 .astype(np.float32))
+        return {"label": label, "dense": None,
+                "sparse": {"uid:linear": uid, "item:linear": item}}
+
+    train = [make_batch() for _ in range(n_steps)]
+    held = [make_batch() for _ in range(4)]
+    # one lr serves dense (bias: full-scale grads, stable while lr < ~8
+    # for the logistic curvature) and sparse (per-row grads are 1/B-scaled,
+    # so ids need many sightings — the config sizes vocab/steps for ~60)
+    lr = config.get("lr", 5.0)
+    opt = {"category": "sgd", "learning_rate": lr}
+    init = {"category": "constant", "value": 0.0}
+
+    def eval_auc(trainer, state):
+        auc = StreamingAUC()
+        for b in held:
+            state = trainer.prepare_offload(state, b)
+            auc.update(b["label"],
+                       np.asarray(trainer.eval_step(state, b)))
+        return float(auc.result())
+
+    def bounded_specs(plane):
+        return tuple(
+            EmbeddingSpec(name=n, input_dim=vocab, output_dim=1,
+                          optimizer=opt, initializer=init, plane=plane)
+            for n in names)
+
+    results = {}
+    for plane_name in config.get("planes",
+                                 ("a2a", "psum", "hybrid", "offload")):
+        mesh = create_mesh(1, n_dev)
+        offload = None
+        sparse_as_dense = None
+        if plane_name in ("a2a", "psum"):
+            coll = EmbeddingCollection(bounded_specs(plane_name), mesh)
+        elif plane_name == "hybrid":
+            sharded, dense_kept = split_sparse_dense(
+                bounded_specs("a2a"), sparse_as_dense_size=vocab + 1)
+            assert not sharded  # everything small enough to keep dense
+            coll = EmbeddingCollection((), mesh)
+            sparse_as_dense = dense_kept
+        else:  # offload tier over the same bounded id space
+            offload = {}
+            spec_list = []
+            for n in names:
+                t = ShardedOffloadedTable(
+                    n, EmbeddingVariableMeta(embedding_dim=1,
+                                             vocabulary_size=vocab),
+                    opt, init, vocab=vocab,
+                    cache_capacity=1 << 14, mesh=mesh)
+                offload[n] = t
+                spec_list.append(t.embedding_spec())
+            coll = EmbeddingCollection(tuple(spec_list), mesh)
+        trainer = Trainer(deepctr.LogisticRegression(feature_names=feats),
+                          coll, optax.sgd(lr),
+                          sparse_as_dense=sparse_as_dense,
+                          offload=offload)
+        state = trainer.init(jax.random.PRNGKey(7),
+                             trainer.shard_batch(train[0]))
+        losses = []
+        for b in train:
+            state, m = trainer.train_step(state, b)
+            losses.append(float(m["loss"]))
+        results[plane_name] = {
+            "final_loss": round(losses[-1], 6),
+            "eval_auc": round(eval_auc(trainer, state), 5),
+        }
+        del state
+        gc.collect()
+        jax.clear_caches()
+
+    aucs = [r["eval_auc"] for r in results.values()]
+    losses = [r["final_loss"] for r in results.values()]
+    spread = max(aucs) - min(aucs)
+    return {
+        "metric": f"{name}_{platform}{n_dev}",
+        "value": round(spread, 5),
+        "unit": "max_auc_spread",
+        "vs_baseline": 1.0 if spread < config.get("tol", 0.01) else 0.0,
+        "loss_spread": round(max(losses) - min(losses), 6),
+        "per_plane": results,
         "config": dict(config),
     }
 
@@ -709,9 +858,15 @@ CONFIGS = {
     # link-bound; the per-GB rate extrapolates
     "ckpt_dim9": {"model": "deepfm", "dim": 9, "vocab": 1 << 16,
                   "batch": 4096, "checkpoint": True},
+    # hash variables at the DEFAULT (wide, 2^62-capable) key space ...
     "deepfm_dim9_hash": {"model": "deepfm", "dim": 9, "vocab": 1 << 22,
                          "batch": 4096, "zipf": True, "hash": True,
                          "hash_capacity": 1 << 23},
+    # ... vs the int32 opt-in — quantifies what the wide default costs
+    "deepfm_dim9_hash_int32": {"model": "deepfm", "dim": 9, "vocab": 1 << 22,
+                               "batch": 4096, "zipf": True, "hash": True,
+                               "hash_capacity": 1 << 23,
+                               "key_dtype": "int32"},
     "deepfm_dim9_per_feature": {"model": "deepfm", "dim": 9,
                                 "vocab": 1 << 18, "batch": 4096,
                                 "fused": False},
@@ -734,8 +889,14 @@ CONFIGS = {
     # holds); value = XLA probe us, vs_baseline = roofline ratio
     "hash_probe_dim128": {"kind": "hash_probe", "capacity": 1 << 22,
                           "dim": 128, "batch": 32768},
-    # AUC on real Criteo rows (reference fixture or $CRITEO_DATA)
-    "auc_criteo": {"kind": "auc", "dim": 9, "batch": 50, "epochs": 20},
+    # held-out AUC on real Criteo rows (reference fixture or $CRITEO_DATA)
+    "auc_criteo": {"kind": "auc", "dim": 9, "batch": 32, "epochs": 20},
+    # cross-plane AUC/loss agreement on identical data+seeds (a2a vs psum
+    # vs hybrid vs offload); value = max pairwise eval-AUC spread. Vocab is
+    # sized so each id recurs ~60x over the run — the label structure is
+    # learnable and AUC comparisons carry signal, not init noise
+    "plane_parity": {"kind": "plane_parity", "dim": 8, "vocab": 200,
+                     "batch": 64, "train_steps": 200},
     # checkpoint IO measured on local disk via a CPU subprocess (the
     # tunneled device->host link is not the thing being measured)
     "ckpt_local_2gb": {"kind": "ckpt_local", "vocab": 1 << 25, "dim": 8,
@@ -749,7 +910,8 @@ HEADLINE = "deepfm_dim9"
 RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
            "hash_probe": run_hash_probe,
            "auc": run_auc_criteo, "ckpt_local": run_ckpt_local,
-           "serving_lookup": run_serving_lookup}
+           "serving_lookup": run_serving_lookup,
+           "plane_parity": run_plane_parity}
 
 
 def _device_watchdog(timeout_s: int = 300) -> None:
@@ -782,15 +944,86 @@ def _device_watchdog(timeout_s: int = 300) -> None:
         os._exit(1)
 
 
+def run_suite_isolated(names, steps, timeout_s=3600):
+    """Run every config in its OWN child process (``bench.py --configs
+    <name>``), one at a time.
+
+    Round 3's single-process suite let configs poison each other: a 9 GB
+    state leaked HBM pressure into the next config's numbers, and one
+    wedged config killed the rest of the matrix. A child per config gives
+    every measurement a fresh backend AND a fresh HBM arena, so numbers
+    can neither perturb nor block their successors.
+
+    Teardown is STRICTLY graceful: a device-attached child must never be
+    killed mid-operation (a SIGKILL during a device call wedges the
+    tunnel/chip for every later config). On timeout the child is LEFT
+    RUNNING, its config recorded as an error, and the remaining device
+    configs are skipped (they could not claim the device anyway) — an
+    honest partial suite instead of a wedged chip.
+    """
+    import os
+    import subprocess
+    import sys
+    results = []
+    hung = False
+    for name in names:
+        if hung:
+            results.append({"metric": name,
+                            "error": "skipped: device held by an earlier "
+                                     "hung config (left unkilled to avoid "
+                                     "wedging the chip)"})
+            continue
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--configs", name]
+        if steps:
+            cmd += ["--steps", str(steps)]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+            line = next((ln for ln in reversed(out.strip().splitlines())
+                         if ln.startswith("{")), None)
+            if line is not None:
+                r = json.loads(line)
+            else:
+                r = {"metric": name,
+                     "error": f"no JSON output (rc={proc.returncode}): "
+                              f"{err[-300:]}"}
+        except subprocess.TimeoutExpired:
+            hung = True
+            r = {"metric": name,
+                 "error": f"config exceeded {timeout_s}s; child left "
+                          "running (never kill a device-attached process "
+                          "mid-op)"}
+        except json.JSONDecodeError as e:
+            r = {"metric": name, "error": f"unparseable child output: {e}"}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    return results
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--suite", action="store_true",
-                   help="run every config (one JSON line each + "
-                        "bench_suite.json); default runs the headline only")
+                   help="run every config, each in its own subprocess "
+                        "(one JSON line each + bench_suite.json); default "
+                        "runs the headline only")
     p.add_argument("--configs", default="",
-                   help="comma-separated subset of configs to run")
+                   help="comma-separated subset of configs to run "
+                        "IN-PROCESS (the per-config child entry point)")
     p.add_argument("--steps", type=int, default=0, help="0 = auto")
+    p.add_argument("--timeout", type=int, default=3600,
+                   help="per-config wall clock in --suite mode")
     args = p.parse_args(argv)
+
+    if args.suite:
+        # the parent stays OFF the device entirely — only children claim
+        # it, so a wedged child cannot take the suite driver down with it
+        results = run_suite_isolated(list(CONFIGS), args.steps,
+                                     args.timeout)
+        with open("bench_suite.json", "w") as f:
+            json.dump(results, f, indent=2)
+        return 1 if any("error" in r for r in results) else 0
 
     _device_watchdog()
     import jax
@@ -800,8 +1033,6 @@ def main(argv=None):
 
     if args.configs:
         names = [n.strip() for n in args.configs.split(",") if n.strip()]
-    elif args.suite:
-        names = list(CONFIGS)
     else:
         names = [HEADLINE]
 
@@ -816,19 +1047,15 @@ def main(argv=None):
             r = {"metric": name, "error": f"{type(e).__name__}: {e}"}
         finally:
             # drop every compiled program + cached table reference between
-            # configs: a 9 GB bigvocab state pinned by a program cache OOMs
-            # every config after it on a 16 GB chip
+            # configs (multi-config in-process runs only)
             gc.collect()
             jax.clear_caches()
             gc.collect()
         results.append(r)
-        if args.suite or args.configs:
+        if args.configs:
             print(json.dumps(r), flush=True)
-    if not (args.suite or args.configs):
+    if not args.configs:
         print(json.dumps(results[0]))
-    if args.suite:
-        with open("bench_suite.json", "w") as f:
-            json.dump(results, f, indent=2)
     # a failed config must fail the invocation — a driver/CI gating on the
     # exit status should not see a silent benchmark regression
     return 1 if any("error" in r for r in results) else 0

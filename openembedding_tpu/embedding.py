@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh
 
 from .meta import (EmbeddingVariableMeta, ModelMeta, ModelVariableMeta,
@@ -62,10 +63,16 @@ class EmbeddingSpec:
     num_shards: int = -1             # -1 => one shard per device (a2a plane)
     hash_capacity: int = 2**20       # reserve_items for hash variables
     layout: str = "mod"              # array-table row layout
-    key_dtype: str = "int32"         # hash key storage; "wide" = [.., 2]
-                                     # int32 (lo, hi) pairs = full 64-bit
-                                     # space with x64 OFF (ids via
-                                     # hash_table.split64); "int64" needs
+    key_dtype: Optional[str] = None  # hash key storage; None resolves to
+                                     # "wide" for hash variables — [.., 2]
+                                     # int32 (lo, hi) pairs = the full
+                                     # 64-bit space with x64 OFF (the
+                                     # reference's default 2^63 key space,
+                                     # Meta.h:44-46; pair queries via
+                                     # hash_table.split64, plain int32/
+                                     # int64 id columns widened on device).
+                                     # "int32" is the explicit optimization
+                                     # for small key spaces; "int64" needs
                                      # the global x64 flag
     plane: str = "a2a"               # "a2a" owner-routed | "psum" baseline
     a2a_capacity: int = 0            # per-destination bucket rows; 0 = auto
@@ -74,6 +81,13 @@ class EmbeddingSpec:
                                      # inputs become [B, L] padded id matrices
                                      # (ragged.py; reference RaggedTensor
                                      # lookups, exb.py:315-321)
+
+    def __post_init__(self):
+        if self.key_dtype is None:
+            # out-of-box hash variables hold the reference's full hashed
+            # key space (2^62 ids) — int32 (2^31 ids) is opt-in
+            object.__setattr__(self, "key_dtype",
+                               "wide" if self.input_dim == -1 else "int32")
 
     @property
     def use_hash(self) -> bool:
@@ -233,6 +247,7 @@ class EmbeddingCollection:
         rows = {}
         for name, idx in inputs.items():
             spec = self.specs[name]
+            idx = self._widen(spec, idx)
             if spec.use_hash:
                 r = sh.pull_sharded(
                     states[name], idx,
@@ -256,6 +271,49 @@ class EmbeddingCollection:
     def _pool_vocab(self, spec: EmbeddingSpec) -> Optional[int]:
         return None if spec.use_hash else spec.input_dim
 
+    def _widen(self, spec: EmbeddingSpec, idx) -> jnp.ndarray:
+        """Bridge plain id columns onto wide (pair-keyed) tables.
+
+        Wide tables take ``[..., 2]`` pairs; a NARROW integer input
+        (flat ``[B]`` ids, or a ``[B, L]`` padded matrix for pooled
+        features) is widened so int32/int64 pipelines run unchanged
+        against the default wide key space. HOST int64 columns are split
+        on host (``hash_table.split64``) BEFORE any jnp conversion — with
+        x64 off ``jnp.asarray`` would silently truncate them to int32 and
+        address the wrong rows; device arrays widen on device
+        (``hash_table.widen_ids``). Inputs already shaped as pairs pass
+        through. Ambiguity rule: a trailing dim of 2 IS a pair axis (for
+        pooled specs only at ndim >= 3, since their ``[B, L=2]`` matrices
+        are sequences) — feed genuinely 2-wide narrow shapes through
+        ``split64`` instead.
+        """
+        if not spec.use_hash or spec.key_dtype != "wide":
+            return idx
+        from . import hash_table as hash_lib
+        pair_ndim = 3 if spec.pooling else 2
+        if not isinstance(idx, jax.Array):
+            arr = np.asarray(idx)
+            is_pairs = arr.ndim >= pair_ndim and arr.shape[-1] == 2
+            if arr.dtype.kind in "iu" and arr.dtype.itemsize == 8:
+                if is_pairs:
+                    # 64-bit-typed pair WORDS: values must fit int32 (a
+                    # raw 64-bit id belongs in split64, not a pair word)
+                    if arr.size and (arr.max() > np.iinfo(np.int32).max
+                                     or arr.min() < np.iinfo(np.int32).min):
+                        raise ValueError(
+                            f"embedding {spec.name!r}: pair words exceed "
+                            "int32 — pass hash_table.split64(ids), not "
+                            "raw 64-bit ids shaped as pairs")
+                    return jnp.asarray(arr.astype(np.int32))
+                # host split keeps full 64-bit width with x64 OFF; the
+                # int64 sentinel (INT64_MIN) splits into the EMPTY band,
+                # staying invalid by the hi-word rule
+                return jnp.asarray(hash_lib.split64(arr))
+            idx = jnp.asarray(arr)
+        if idx.ndim >= pair_ndim and idx.shape[-1] == 2:
+            return idx
+        return hash_lib.widen_ids(idx)
+
     def apply_gradients(self, states: Dict[str, Any],
                         inputs: Dict[str, jnp.ndarray],
                         row_grads: Dict[str, jnp.ndarray],
@@ -268,22 +326,23 @@ class EmbeddingCollection:
         new_states = dict(states)
         for name, g in row_grads.items():
             spec = self.specs[name]
+            idx_in = self._widen(spec, inputs[name])
             if spec.pooling:
                 # pooled features carry [B, dim] grads; expand with the
                 # pooling VJP so each valid slot updates like a raw lookup
                 g = ragged.expand_pooled_grads(
-                    g, inputs[name], spec.pooling, ragged.pad_id_for(spec),
+                    g, idx_in, spec.pooling, ragged.pad_id_for(spec),
                     self._pool_vocab(spec),
                     wide=spec.key_dtype == "wide")
             if spec.use_hash:
                 new_states[name] = sh.apply_gradients_sharded(
                     states[name], self._optimizers[name],
-                    self._initializers[name], inputs[name], g,
+                    self._initializers[name], idx_in, g,
                     mesh=self.mesh, spec=self._shardings[name],
                     batch_sharded=batch_sharded)
             else:
                 new_states[name] = st.apply_gradients_sharded(
-                    states[name], self._optimizers[name], inputs[name], g,
+                    states[name], self._optimizers[name], idx_in, g,
                     mesh=self.mesh, spec=self._shardings[name],
                     batch_sharded=batch_sharded)
         return new_states

@@ -46,9 +46,10 @@ class FusedMapper:
     vocab_sizes: Tuple[int, ...]        # -1 everywhere => hash fusion
     name: str = FUSED_NAME
     need_linear: bool = True
-    key_dtype: str = "int32"            # hash fusion: "wide" = [B, F, 2]
-                                        # pair keys, full 64-bit space
-                                        # with x64 OFF
+    key_dtype: str = "wide"             # hash fusion default: [B, F, 2]
+                                        # pair keys, full 64-bit space with
+                                        # x64 OFF; "int32" opts into the
+                                        # 31-bit mixed space
 
     @property
     def use_hash(self) -> bool:
@@ -127,7 +128,7 @@ def make_fused_specs(feature_names: Sequence[str],
                      optimizer: Any = None,
                      initializer: Any = None,
                      hash_capacity: int = 2**20,
-                     key_dtype: str = "int32",
+                     key_dtype: str = "wide",
                      num_shards: int = -1,
                      plane: str = "a2a",
                      a2a_capacity: int = 0,

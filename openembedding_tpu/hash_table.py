@@ -96,6 +96,33 @@ def join64(pairs: np.ndarray) -> np.ndarray:
     return (hi << np.int64(32)) | lo.astype(np.int64)
 
 
+def widen_ids(ids: jnp.ndarray) -> jnp.ndarray:
+    """Narrow integer ids (any shape) -> ``[..., 2]`` int32 (lo, hi) pairs.
+
+    The device-side bridge that lets WIDE tables (the default hash key
+    space) accept plain int32/int64 id columns: each id becomes the pair
+    encoding of its sign-extended 64-bit value, so a pipeline feeding
+    int32 ids and one feeding ``split64`` pairs address the same rows.
+    The narrow dtype's own invalid sentinel (its minimum value — the
+    framework-wide EMPTY/padding id) maps to the EMPTY pair, preserving
+    the invalid-id contract across the widening.
+    """
+    ids = jnp.asarray(ids)
+    empty = jnp.int32(empty_key(jnp.int32))
+    if ids.dtype.itemsize == 8:
+        lo = (ids & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32).astype(
+            jnp.int32)
+        hi = (ids >> jnp.int64(32)).astype(jnp.int32)
+        invalid = ids == jnp.iinfo(jnp.int64).min
+    else:
+        ids = ids.astype(jnp.int32)
+        lo = ids
+        hi = ids >> jnp.int32(31)      # arithmetic: 0 or -1 (sign extend)
+        invalid = ids == empty
+    pair = jnp.stack([lo, hi], axis=-1)
+    return jnp.where(invalid[..., None], empty, pair)
+
+
 def pair_mod(pairs: jnp.ndarray, g: int) -> jnp.ndarray:
     """``join64(pairs) mod g`` computed in 32-bit words (x64-off safe).
 

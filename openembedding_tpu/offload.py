@@ -464,7 +464,11 @@ class ShardedOffloadedTable:
             name=self.name, input_dim=-1, output_dim=self.meta.embedding_dim,
             dtype=self.meta.datatype, optimizer=self._optimizer_config,
             initializer=self._initializer_config,
-            hash_capacity=self.cache_capacity)
+            hash_capacity=self.cache_capacity,
+            # the cache is keyed by BOUNDED host-store row ids ([0, vocab));
+            # int32 keys are the right optimization here, not the wide
+            # default (which would mismatch this table's own insert plane)
+            key_dtype="int32")
         return EmbeddingSpec(**{**base, **kw})
 
     def create_cache(self, rng: Optional[jax.Array] = None):

@@ -111,6 +111,9 @@ class ServingModel:
         name = (variable if isinstance(variable, str)
                 else self._by_id[int(variable)])
         idx = jnp.asarray(indices)
+        # narrow id columns address wide tables via the same widening
+        # bridge the training pull uses (collection._widen)
+        idx = self.collection._widen(self.collection.specs[name], idx)
         if self.shard_slice is not None:
             # owner rule: id % G on the (joined) 64-bit value — must match
             # the loader's slice filter (checkpoint._insert_hash_rows) and

@@ -406,3 +406,99 @@ def test_a2a_wide_keys_exact_under_skew(devices8):
     want = hash_lib.pull(single, pairs, None)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     np.testing.assert_allclose(np.asarray(got), -1.0, rtol=1e-6)
+
+
+# --- compiled-HLO ICI contract ----------------------------------------------
+
+def _lower_pull(mesh, plane, *, vocab=1 << 16, dim=16, batch=1024,
+                use_hash=False):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from openembedding_tpu.parallel.mesh import DATA_AXIS
+    if use_hash:
+        spec = EmbeddingSpec(name="t", input_dim=-1, output_dim=dim,
+                             hash_capacity=vocab, plane=plane)
+    else:
+        spec = EmbeddingSpec(name="t", input_dim=vocab, output_dim=dim,
+                             plane=plane)
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+
+    def pull_fn(states, idx):
+        return coll.pull(states, {"t": idx})["t"]
+
+    idx = jax.device_put(jnp.zeros((batch,), jnp.int32),
+                         NamedSharding(mesh, P(DATA_AXIS)))
+    # rows stay batch-sharded over the data axis (the training step's
+    # layout) — a replicated output would force an artifact gather
+    compiled = jax.jit(
+        pull_fn, out_shardings=NamedSharding(mesh, P(DATA_AXIS))
+    ).lower(states, idx).compile()
+    return compiled.as_text()
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (1, 8)])
+@pytest.mark.parametrize("use_hash", [False, True])
+def test_a2a_pull_ici_contract(devices8, mesh_shape, use_hash):
+    """The compiled a2a pull program's ICI contract: the owner exchange is
+    an all-to-all, and NO all-gather beyond the O(batch_slice * dim) row
+    re-assembly exists — per-device bytes O(slack * slice * dim), never
+    O(global_batch * dim) or O(table). Guarded in the COMPILED HLO so a
+    sharding-annotation regression (XLA re-materializing tables or the
+    global batch) fails loudly. Reference analogue: the exchange-not-
+    broadcast design of EmbeddingPullOperator.cpp:60-112."""
+    from openembedding_tpu.utils import hlocheck
+    B, dim = 1024, 16
+    mesh = create_mesh(*mesh_shape, devices8)
+    txt = _lower_pull(mesh, "a2a", dim=dim, batch=B, use_hash=use_hash)
+    summary = hlocheck.check_a2a_pull_hlo(
+        txt, batch_slice=B // mesh_shape[0], dim=dim)
+    assert summary["all-to-all"][0] >= 1
+
+    # the psum baseline CARRIES the O(batch_slice * dim) broadcast-style
+    # signature the a2a bound excludes — proves the bound is meaningful
+    txt_psum = _lower_pull(mesh, "psum", dim=dim, batch=B,
+                           use_hash=use_hash)
+    psum_summary = hlocheck.summarize(txt_psum)
+    assert "all-to-all" not in psum_summary
+    big = [b for op, b, _largest in hlocheck.collect_collectives(txt_psum)
+           if op in ("all-reduce", "all-gather")
+           and b >= (B // mesh_shape[0]) * dim * 4]
+    assert big, f"psum plane lost its broadcast signature: {psum_summary}"
+
+
+def test_a2a_pull_ici_contract_16dev():
+    """Same contract on a 16-device virtual mesh (a child process: this
+    process's backend is pinned to 8 devices) — the scaling regime the
+    plane exists for."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 16)
+import sys
+sys.path.insert(0, {root!r})
+sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+import jax.numpy as jnp
+from openembedding_tpu.parallel.mesh import create_mesh
+from openembedding_tpu.utils import hlocheck
+import test_alltoall as t
+for shape in ((4, 4), (2, 8)):
+    mesh = create_mesh(*shape)
+    for use_hash in (False, True):
+        txt = t._lower_pull(mesh, "a2a", dim=16, batch=2048,
+                            use_hash=use_hash)
+        s = hlocheck.check_a2a_pull_hlo(txt, batch_slice=2048 // shape[0],
+                                        dim=16)
+        print(shape, use_hash, dict(s))
+print("ok")
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "ok" in out.stdout

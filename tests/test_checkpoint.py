@@ -623,7 +623,7 @@ def test_hash_key_width_migration(devices8, tmp_path):
     mesh = create_mesh(2, 4, devices8)
     n32 = EmbeddingCollection(
         (EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
-                       hash_capacity=1024,
+                       hash_capacity=1024, key_dtype="int32",
                        initializer={"category": "constant", "value": 0.0},
                        optimizer={"category": "sgd",
                                   "learning_rate": 1.0}),), mesh)
@@ -676,7 +676,7 @@ def test_int64_dump_empty_band_refused(devices8, tmp_path):
     # reuse a real int32 dump's layout, then rewrite keys as int64
     n32 = EmbeddingCollection(
         (EmbeddingSpec(name="h", input_dim=-1, output_dim=DIM,
-                       hash_capacity=512,
+                       hash_capacity=512, key_dtype="int32",
                        optimizer={"category": "sgd",
                                   "learning_rate": 1.0}),), mesh)
     s = n32.init(jax.random.PRNGKey(0))

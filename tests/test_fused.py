@@ -32,12 +32,24 @@ def test_mapper_bounded_offsets():
 
 
 def test_mapper_hash_disjoint():
+    from openembedding_tpu import hash_table as hl
+    # DEFAULT hash fusion is wide: [B, F, 2] pair keys = the interleaved
+    # full 64-bit space, exactly key*F+f with no truncation
     m = FusedMapper(FEATURES, (-1, -1, -1))
-    assert m.use_hash
+    assert m.use_hash and m.key_dtype == "wide"
     sparse = {f: np.array([123, 456], dtype=np.int32) for f in FEATURES}
     fused = m.fuse(sparse)["fields"]
+    assert fused.shape == (2, 3, 2)
+    joined = hl.join64(fused)
     # same raw key in different features maps to distinct fused keys
-    assert len(set(fused[0].tolist())) == 3
+    assert len(set(joined[0].tolist())) == 3
+    np.testing.assert_array_equal(joined[0], 123 * 3 + np.arange(3))
+
+    # int32 opt-in: mixed into 31 bits, still disjoint per feature
+    m32 = FusedMapper(FEATURES, (-1, -1, -1), key_dtype="int32")
+    fused32 = m32.fuse(sparse)["fields"]
+    assert fused32.dtype == np.int32 and fused32.shape == (2, 3)
+    assert len(set(fused32[0].tolist())) == 3
 
 
 def test_mixed_hash_bounded_rejected():

@@ -183,6 +183,29 @@ def test_bucket_layout_and_rounding():
         ht.table_layout(1000, ht.DEFAULT_MAX_PROBES)
 
 
+def test_widen_ids_matches_split64():
+    """widen_ids (the narrow->wide bridge every default-keyed lookup rides)
+    must agree with the host split64 encoding for every sign, and map the
+    narrow dtype's sentinel to the EMPTY pair (the invalid contract)."""
+    ids32 = np.array([0, 1, -1, 7, -2**31 + 1, 2**31 - 1], np.int32)
+    got = np.asarray(ht.widen_ids(jnp.asarray(ids32)))
+    np.testing.assert_array_equal(got, ht.split64(ids32.astype(np.int64)))
+    # int32 sentinel -> EMPTY pair (both words)
+    s = np.asarray(ht.widen_ids(jnp.asarray([np.iinfo(np.int32).min],
+                                            np.int32)))
+    np.testing.assert_array_equal(s, ht.empty_key(jnp.int32))
+    # shape is preserved with a trailing pair axis
+    m = np.asarray(ht.widen_ids(jnp.asarray(ids32.reshape(2, 3))))
+    assert m.shape == (2, 3, 2)
+    # device int64 branch (x64 on): full width + int64 sentinel -> EMPTY
+    import jax
+    with jax.enable_x64(True):
+        ids64 = np.array([2**33 + 7, -5, np.iinfo(np.int64).min], np.int64)
+        got64 = np.asarray(ht.widen_ids(jnp.asarray(ids64)))
+    np.testing.assert_array_equal(got64[:2], ht.split64(ids64[:2]))
+    np.testing.assert_array_equal(got64[2], ht.empty_key(jnp.int32))
+
+
 def test_pair_mod_matches_int64_mod():
     """pair_mod (the x64-off wide-key shard-owner rule) equals int64
     ``id % g`` for every sign/magnitude — the loader, in-process filter,

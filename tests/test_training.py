@@ -104,9 +104,12 @@ def test_mixed_array_and_hash(devices8):
 
 
 def test_int64_keys_require_int64_table(devices8):
+    """int64 queries against an EXPLICIT int32-keyed table must refuse,
+    not alias mod 2^32; the DEFAULT (wide) table accepts them at full
+    width — even from a host int64 column with x64 OFF."""
     mesh = create_mesh(1, 8, devices8)
     specs = (EmbeddingSpec(name="h", input_dim=-1, output_dim=4,
-                           hash_capacity=64),)
+                           hash_capacity=64, key_dtype="int32"),)
     coll = EmbeddingCollection(specs, mesh)
     states = coll.init()
     big = np.array([2**33 + 7], dtype=np.int64)
@@ -114,8 +117,30 @@ def test_int64_keys_require_int64_table(devices8):
     # table ever sees the key, so the aliasing guard only engages under x64
     with jax.enable_x64(True):
         with pytest.raises(ValueError, match="key_dtype"):
-            # int64 queries against an int32-keyed table must refuse, not alias
             coll.pull(states, {"h": jnp.asarray(big)}, batch_sharded=False)
+
+    # the wide DEFAULT holds the full key: a host int64 column splits on
+    # host (x64 off) and addresses the same row as explicit split64 pairs
+    from openembedding_tpu import hash_table as hl
+    wcoll = EmbeddingCollection(
+        (EmbeddingSpec(name="h", input_dim=-1, output_dim=4,
+                       hash_capacity=64,
+                       initializer={"category": "normal", "stddev": 1.0},
+                       optimizer={"category": "sgd",
+                                  "learning_rate": 1.0}),), mesh)
+    assert wcoll.specs["h"].key_dtype == "wide"
+    ws = wcoll.init()
+    ws = wcoll.apply_gradients(ws, {"h": big},
+                               {"h": jnp.ones((1, 4), jnp.float32)},
+                               batch_sharded=False)
+    keys = np.asarray(jax.device_get(ws["h"].keys))
+    live = keys[keys[..., 1] != hl.empty_key(np.int32)]
+    assert set(hl.join64(live.reshape(-1, 2))) == {2**33 + 7}  # not 7!
+    via_col = wcoll.pull(ws, {"h": big}, batch_sharded=False)["h"]
+    via_pairs = wcoll.pull(ws, {"h": jnp.asarray(hl.split64(big))},
+                           batch_sharded=False)["h"]
+    np.testing.assert_array_equal(np.asarray(via_col),
+                                  np.asarray(via_pairs))
 
 
 def test_collection_meta_and_duplicate_names(devices8):
