@@ -34,55 +34,85 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-ROWS_PER_STEP = 8  # queries in flight per grid step
+ROWS_PER_STEP = 16  # queries in flight per grid step
 
 
 def _probe_gather_kernel(bkt_ref, qkeys_ref, tkeys_ref, weights_ref,
                          rows_ref, hit_ref, kscratch, rscratch, ksem, rsem,
-                         *, chain: int, bucket: int, empty: int):
+                         *, chain: int, bucket: int, empty: int,
+                         nsteps: int):
+    """Double-buffered probe: key-chain DMAs for grid step i+1 are issued
+    while step i computes, so the per-query DMA latency (the measured
+    bottleneck of the single-buffered version: 2 serial DMAs per query
+    issued from the scalar core) hides behind the compare/row phase.
+    Buffer parity is resolved with static indices under even/odd
+    ``pl.when`` branches (dynamic scratch/semaphore indices don't lower).
+    """
     i = pl.program_id(0)
     R = ROWS_PER_STEP
 
-    def key_copy(r):
-        b = bkt_ref[i * R + r]
+    def key_copy(step, r, buf):
+        b = bkt_ref[step * R + r]
         return pltpu.make_async_copy(
             tkeys_ref.at[pl.dslice(b, chain), :],
-            kscratch.at[pl.dslice(r * chain, chain), :], ksem.at[r])
+            kscratch.at[pl.dslice((buf * R + r) * chain, chain), :],
+            ksem.at[buf * R + r])
 
-    for r in range(R):
-        key_copy(r).start()
+    parity = jax.lax.rem(i, 2)
 
-    hits = []
-    for r in range(R):
-        key_copy(r).wait()
-        q = qkeys_ref[i * R + r]
-        window = kscratch[pl.dslice(r * chain, chain), :]  # [chain, bucket]
-        match = window == q
-        # unique keys: at most one slot matches -> sum IS the flat offset
-        iota = jax.lax.broadcasted_iota(jnp.int32, (chain, bucket), 1) + \
-            jax.lax.broadcasted_iota(jnp.int32, (chain, bucket), 0) * bucket
-        off = jnp.sum(jnp.where(match, iota, 0))
-        nhit = jnp.sum(match.astype(jnp.int32))
-        hit = (nhit > 0) & (q != empty)
-        hits.append(hit)
-        b = bkt_ref[i * R + r]
-        row = jnp.where(hit, b * bucket + off, 0)
-        pltpu.make_async_copy(
-            weights_ref.at[pl.dslice(row, 1), :],
-            rscratch.at[pl.dslice(r, 1), :], rsem.at[r]).start()
+    @pl.when(i == 0)
+    def _():  # prime the pipeline: this step's own chains
+        for r in range(R):
+            key_copy(i, r, 0).start()
 
-    for r in range(R):
-        # wait on the row DMA (same byte count; only the semaphore matters)
-        pltpu.make_async_copy(
-            weights_ref.at[pl.dslice(0, 1), :],
-            rscratch.at[pl.dslice(r, 1), :], rsem.at[r]).wait()
-        rows_ref[pl.dslice(r, 1), :] = jnp.where(
-            hits[r], rscratch[pl.dslice(r, 1), :],
-            jnp.zeros_like(rscratch[pl.dslice(r, 1), :]))
+    @pl.when(i + 1 < nsteps)
+    def _():  # prefetch the NEXT step's chains into the other buffer
+        for buf in (0, 1):  # static-index twin branches
+            @pl.when(parity == buf)
+            def _(buf=buf):
+                for r in range(R):
+                    key_copy(i + 1, r, 1 - buf).start()
 
-    # scalar stores to VMEM are disallowed: write the hit column vectorized
-    hit_ref[:, :] = jnp.stack(
-        [h.astype(jnp.int32) for h in hits]).reshape(R, 1)
+    def body(buf):
+        hits = []
+        for r in range(R):
+            key_copy(i, r, buf).wait()
+            q = qkeys_ref[i * R + r]
+            window = kscratch[
+                pl.dslice((buf * R + r) * chain, chain), :]
+            match = window == q
+            # unique keys: at most one slot matches -> sum IS the offset
+            iota = jax.lax.broadcasted_iota(
+                jnp.int32, (chain, bucket), 1) + \
+                jax.lax.broadcasted_iota(
+                    jnp.int32, (chain, bucket), 0) * bucket
+            off = jnp.sum(jnp.where(match, iota, 0))
+            nhit = jnp.sum(match.astype(jnp.int32))
+            hit = (nhit > 0) & (q != empty)
+            hits.append(hit)
+            b = bkt_ref[i * R + r]
+            row = jnp.where(hit, b * bucket + off, 0)
+            pltpu.make_async_copy(
+                weights_ref.at[pl.dslice(row, 1), :],
+                rscratch.at[pl.dslice(r, 1), :], rsem.at[r]).start()
+
+        for r in range(R):
+            # wait on the row DMA (same byte count; only the sem matters)
+            pltpu.make_async_copy(
+                weights_ref.at[pl.dslice(0, 1), :],
+                rscratch.at[pl.dslice(r, 1), :], rsem.at[r]).wait()
+            rows_ref[pl.dslice(r, 1), :] = jnp.where(
+                hits[r], rscratch[pl.dslice(r, 1), :],
+                jnp.zeros_like(rscratch[pl.dslice(r, 1), :]))
+
+        # scalar stores to VMEM are disallowed: write hits vectorized
+        hit_ref[:, :] = jnp.stack(
+            [h.astype(jnp.int32) for h in hits]).reshape(R, 1)
+
+    for buf in (0, 1):
+        @pl.when(parity == buf)
+        def _(buf=buf):
+            body(buf)
 
 
 @functools.partial(jax.jit,
@@ -124,9 +154,10 @@ def probe_gather(table_keys: jnp.ndarray, weights: jnp.ndarray,
         qk = jnp.pad(qk, (0, npad - n), constant_values=empty)
     keys2d = table_keys.reshape(capacity // bucket, bucket)
 
+    nsteps = npad // ROWS_PER_STEP
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(npad // ROWS_PER_STEP,),
+        grid=(nsteps,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY),   # keys in HBM
                   pl.BlockSpec(memory_space=pl.ANY)],  # weights in HBM
         out_specs=[pl.BlockSpec((ROWS_PER_STEP, dim),
@@ -134,15 +165,18 @@ def probe_gather(table_keys: jnp.ndarray, weights: jnp.ndarray,
                    pl.BlockSpec((ROWS_PER_STEP, 1),
                                 lambda i, s, q: (i, 0))],
         scratch_shapes=[
-            pltpu.VMEM((ROWS_PER_STEP * chain, bucket), table_keys.dtype),
+            # x2: double-buffered key staging (this step + the prefetched
+            # next step); scratch persists across sequential grid steps
+            pltpu.VMEM((2 * ROWS_PER_STEP * chain, bucket),
+                       table_keys.dtype),
             pltpu.VMEM((ROWS_PER_STEP, dim), weights.dtype),
-            pltpu.SemaphoreType.DMA((ROWS_PER_STEP,)),
+            pltpu.SemaphoreType.DMA((2 * ROWS_PER_STEP,)),
             pltpu.SemaphoreType.DMA((ROWS_PER_STEP,)),
         ],
     )
     rows, hit = pl.pallas_call(
         functools.partial(_probe_gather_kernel, chain=chain, bucket=bucket,
-                          empty=empty),
+                          empty=empty, nsteps=nsteps),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((npad, dim), weights.dtype),
                    jax.ShapeDtypeStruct((npad, 1), jnp.int32)],
