@@ -288,14 +288,16 @@ def run_offload(name, config, *, steps, warmup):
         # PIPELINE with next_batch: batch N+1's host gather overlaps the
         # device step (the prepare/step overlap this tier is built around)
         timed = [make_batch() for _ in range(steps)] + [None]
+        uniqs = [np.unique(b["sparse"]["uid"]) for b in timed[:-1]]
         t0 = time.perf_counter()
         for i in range(steps):
-            b = timed[i]
-            uniq = np.unique(b["sparse"]["uid"])
-            was_resident = int(table._resident[uniq].sum())
+            # residency must be read in sequence (prepare mutates it), but
+            # the uniq sets were precomputed outside the timed loop
+            was_resident = int(table._resident[uniqs[i]].sum())
             hits += was_resident
-            misses += uniq.size - was_resident
-            state, m = trainer.train_step(state, b, next_batch=timed[i + 1])
+            misses += uniqs[i].size - was_resident
+            state, m = trainer.train_step(state, timed[i],
+                                          next_batch=timed[i + 1])
         jax.block_until_ready(m["loss"])
         dt = time.perf_counter() - t0
         t0 = time.perf_counter()
