@@ -213,6 +213,21 @@ def run_checkpoint(coll, state):
     }
 
 
+def _zipf_uid_batch_maker(rng, batch, vocab, zipf_a):
+    """Shared synthetic stream for the offload benches: zipf-skewed uid over
+    the full store (hot head caches, long tail streams through host) + a
+    bounded ctx feature."""
+    def make_batch():
+        z = rng.zipf(zipf_a, size=batch)
+        uid = ((z * 2654435761) % vocab).astype(np.int32)
+        ctx = rng.randint(0, 100_000, batch).astype(np.int32)
+        return {"label": (rng.rand(batch) > 0.75).astype(np.float32),
+                "dense": rng.randn(batch, 13).astype(np.float32),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+    return make_batch
+
+
 def run_offload(name, config, *, steps, warmup):
     """North-star-scale offload config: host store >> HBM through the
     Trainer (the reference's PMem bar: DRAM-like throughput on a 500 GB
@@ -266,16 +281,8 @@ def run_offload(name, config, *, steps, warmup):
                           offload={"uid": table, "uid:linear": lin})
 
         rng = np.random.RandomState(0)
-        def make_batch():
-            # zipf-skewed ids over the full store: hot head caches, long
-            # tail streams through host
-            z = rng.zipf(config.get("zipf_a", 1.08), size=batch)
-            uid = ((z * 2654435761) % vocab).astype(np.int32)
-            ctx = rng.randint(0, 100_000, batch).astype(np.int32)
-            return {"label": (rng.rand(batch) > 0.75).astype(np.float32),
-                    "dense": rng.randn(batch, 13).astype(np.float32),
-                    "sparse": {"uid": uid, "uid:linear": uid,
-                               "ctx": ctx, "ctx:linear": ctx}}
+        make_batch = _zipf_uid_batch_maker(rng, batch, vocab,
+                                           config.get("zipf_a", 1.08))
         state = trainer.init(jax.random.PRNGKey(0),
                              trainer.shard_batch(make_batch()))
         hits = misses = 0
@@ -376,16 +383,8 @@ def run_offload_sweep(name, config, *, steps, warmup):
     trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
                       coll, optax.adagrad(0.01))
     rng = np.random.RandomState(0)
-
-    def make_batch():
-        z = rng.zipf(config.get("zipf_a", 1.08), size=batch)
-        uid = ((z * 2654435761) % hbm_vocab).astype(np.int32)
-        ctx = rng.randint(0, 100_000, batch).astype(np.int32)
-        return {"label": (rng.rand(batch) > 0.75).astype(np.float32),
-                "dense": rng.randn(batch, 13).astype(np.float32),
-                "sparse": {"uid": uid, "uid:linear": uid,
-                           "ctx": ctx, "ctx:linear": ctx}}
-
+    make_batch = _zipf_uid_batch_maker(rng, batch, hbm_vocab,
+                                       config.get("zipf_a", 1.08))
     batches = [make_batch() for _ in range(8)]
     state = trainer.init(jax.random.PRNGKey(0),
                          trainer.shard_batch(batches[0]))

@@ -641,25 +641,22 @@ class ShardedOffloadedTable:
         # non-draining: only counters older than the check depth are read,
         # so the steady-state pipeline pays no per-step device round trip
         self.check_overflow(drain=False)
+        self._last_touch[prep.uniq] = self.work_id
         if prep.needs_evict:
             budget = int(self.occupancy_threshold * self.cache_capacity)
-            self._last_touch[prep.uniq] = self.work_id
             cache = self._evict(cache, protect=prep.uniq, budget=budget,
                                 incoming=prep.missing.size)
+            # re-gather AFTER eviction made host rows current
             missing = prep.uniq[~self._resident[prep.uniq]]
-            if missing.size == 0:
-                return cache
-            cache = self._insert_from_host(cache, missing)
-            self._resident[missing] = True
-            self._resident_count += int(missing.size)
+            rows, slot_rows = self._gather_host(missing)
+        else:
+            missing, rows, slot_rows = prep.missing, prep.rows, \
+                prep.slot_rows
+        if missing.size == 0:
             return cache
-        self._last_touch[prep.uniq] = self.work_id
-        if prep.missing.size == 0:
-            return cache
-        cache = self._insert_rows(cache, prep.missing, prep.rows,
-                                  prep.slot_rows)
-        self._resident[prep.missing] = True
-        self._resident_count += int(prep.missing.size)
+        cache = self._insert_rows(cache, missing, rows, slot_rows)
+        self._resident[missing] = True
+        self._resident_count += int(missing.size)
         return cache
 
     def prepare(self, cache, ids):

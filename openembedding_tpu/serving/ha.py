@@ -375,14 +375,17 @@ class RoutingClient:
             payload = r.read()
         return json.loads(payload) if payload else None
 
-    def _failover(self, method: str, path: str, body=None) -> Any:
+    def _rotate(self, attempt) -> Any:
+        """Shared failover rotation: start at a random replica (load
+        spreading), rotate on dead/busy replicas, raise only when every
+        replica failed — the reference's pick_one_replica + retry."""
         order = list(self.endpoints)
         start = random.randrange(len(order))
         order = order[start:] + order[:start]
         last_err: Optional[Exception] = None
         for ep in order:
             try:
-                return self._request(ep, method, path, body)
+                return attempt(ep)
             # NOTE: HTTPError subclasses URLError — it must be caught first,
             # else every 404 would read as a dead replica
             except urllib.error.HTTPError as e:
@@ -397,6 +400,10 @@ class RoutingClient:
                 last_err = e
         raise ConnectionError(
             f"no live replica among {self.endpoints}: {last_err}")
+
+    def _failover(self, method: str, path: str, body=None) -> Any:
+        return self._rotate(
+            lambda ep: self._request(ep, method, path, body))
 
     def _request_bin(self, endpoint: str, path: str, body: bytes) -> bytes:
         req = urllib.request.Request(
@@ -433,28 +440,15 @@ class RoutingClient:
                            "dtype": idx.dtype.name,
                            "shape": list(idx.shape)}).encode() + b"\n"
         body = head + idx.tobytes()
-        order = list(self.endpoints)
-        start = random.randrange(len(order))
-        order = order[start:] + order[:start]
-        last_err: Optional[Exception] = None
-        for ep in order:
-            try:
-                raw = self._request_bin(
-                    ep, f"/models/{sign}/lookup_bin", body)
-                nl = raw.index(b"\n")
-                h = json.loads(raw[:nl])
-                return np.frombuffer(raw[nl + 1:], np.float32).reshape(
-                    h["shape"])
-            except urllib.error.HTTPError as e:
-                if e.code in (409, 503):
-                    last_err = e
-                    continue
-                raise
-            except (urllib.error.URLError, http.client.HTTPException,
-                    ConnectionError, OSError, TimeoutError) as e:
-                last_err = e
-        raise ConnectionError(
-            f"no live replica among {self.endpoints}: {last_err}")
+
+        def attempt(ep):
+            raw = self._request_bin(ep, f"/models/{sign}/lookup_bin", body)
+            nl = raw.index(b"\n")
+            h = json.loads(raw[:nl])
+            return np.frombuffer(raw[nl + 1:], np.float32).reshape(
+                h["shape"])
+
+        return self._rotate(attempt)
 
     def create_model(self, model_uri: str, *,
                      model_sign: Optional[str] = None,
