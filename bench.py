@@ -285,10 +285,22 @@ def run_offload(name, config, *, steps, warmup):
                                            config.get("zipf_a", 1.08))
         state = trainer.init(jax.random.PRNGKey(0),
                              trainer.shard_batch(make_batch()))
+        # instrument the host half of prepare: with the lookahead pipeline
+        # step time should approach max(host prepare, device step), not
+        # their sum — prepare_ms vs step_ms in the result shows which
+        prep_times = []
+        for t in (table, lin):
+            def timed_hp(ids, _orig=t.host_prepare):
+                t0 = time.perf_counter()
+                out = _orig(ids)
+                prep_times.append(time.perf_counter() - t0)
+                return out
+            t.host_prepare = timed_hp
         hits = misses = 0
         for i in range(warmup):
             state, m = trainer.train_step(state, make_batch())
         jax.block_until_ready(m["loss"])
+        prep_times.clear()
         # fresh zipf batches every step: the long tail keeps missing, the
         # hot head keeps hitting — the steady-state cache economics.
         # Pre-generate so batch synthesis is outside the timed loop, and
@@ -327,6 +339,10 @@ def run_offload(name, config, *, steps, warmup):
             "vs_baseline": round(eps / n_dev / REF_PER_CHIP, 3),
             "per_chip": round(eps / n_dev, 1),
             "step_ms": round(1000 * dt / steps, 3),
+            # host-prepare wall time per step (both tables, runs on the
+            # lookahead thread): overlapped when step_ms ~= max(this,
+            # device time) rather than their sum
+            "prepare_ms": round(1000 * sum(prep_times) / max(steps, 1), 3),
             "host_store_gb": round(store_gb, 2),
             "cache_rows": cache,
             "cache_hit_rate": round(hits / max(hits + misses, 1), 4),
