@@ -1049,9 +1049,12 @@ def main(argv=None):
     if args.suite:
         # the parent stays OFF the device entirely — only children claim
         # it, so a wedged child cannot take the suite driver down with it
+        import os
         results = run_suite_isolated(list(CONFIGS), args.steps,
                                      args.timeout)
-        with open("bench_suite.json", "w") as f:
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "bench_suite.json")
+        with open(out, "w") as f:
             json.dump(results, f, indent=2)
         return 1 if any("error" in r for r in results) else 0
 
