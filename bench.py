@@ -758,15 +758,21 @@ def run_serving_lookup(name, config, *, steps, warmup):
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
-        proc = ha.spawn_replica(port, load=[f"bench-serve-1={d}"])
+        # message_compress=zlib server-side: raw clients still get raw
+        # bytes (codec only applies when advertised), so one daemon
+        # serves all three modes
+        proc = ha.spawn_replica(port, load=[f"bench-serve-1={d}"],
+                                compress="zlib")
         ep = f"127.0.0.1:{port}"
         if not ha.wait_ready(ep, sign="bench-serve-1", timeout=300.0):
             raise RuntimeError("bench replica failed to become ready")
         router = ha.RoutingClient([ep], timeout=60.0)
+        zrouter = ha.RoutingClient([ep], timeout=60.0, compress="zlib")
         rng = np.random.RandomState(0)
         idx = rng.randint(0, config["vocab"], batch).astype(np.int32)
         out = {}
         for mode, fn in (("bin", router.lookup_bin),
+                         ("bin_zlib", zrouter.lookup_bin),
                          ("json", router.lookup_json)):
             fn("bench-serve-1", "emb", idx)  # warm (compile + route)
             times = []
@@ -775,6 +781,13 @@ def run_serving_lookup(name, config, *, steps, warmup):
                 fn("bench-serve-1", "emb", idx)
                 times.append(time.perf_counter() - t0)
             out[f"{mode}_ms"] = round(_median(times) * 1e3, 2)
+        # bytes on the wire per response (localhost hides the bandwidth
+        # win; the ratio is the WAN story — reference RpcView.h:63-105)
+        from openembedding_tpu.utils import compress as compress_lib
+        rows = np.asarray(router.lookup_bin("bench-serve-1", "emb", idx))
+        out["resp_bytes_raw"] = int(rows.nbytes)
+        out["resp_bytes_zlib"] = len(
+            compress_lib.compress("zlib", rows.tobytes()))
         return {
             "metric": f"{name}",
             "value": out["bin_ms"],
@@ -974,6 +987,83 @@ def _device_watchdog(timeout_s: int = 300) -> None:
         os._exit(1)
 
 
+def _probe_device_child(timeout_s=300):
+    """Probe device health in a CHILD process (``bench.py --probe``).
+
+    The child itself bounds backend init with ``_device_watchdog`` and
+    self-exits — the parent never signals it, so a wedged tunnel cannot
+    be made worse by the probe (killing a process mid-device-init is what
+    wedges the chip in the first place). Returns ``(ok, note)``.
+    """
+    import os
+    import subprocess
+    import sys
+    cmd = [sys.executable, os.path.abspath(__file__), "--probe",
+           "--probe-timeout", str(timeout_s)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s + 120)
+    except subprocess.TimeoutExpired:
+        return False, f"probe child unresponsive past {timeout_s + 120}s"
+    line = next((ln for ln in reversed(proc.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode == 0 and line:
+        try:
+            j = json.loads(line)
+            return True, (f"init {j.get('init_s', '?')}s, "
+                          f"{j.get('n_devices', '?')} device(s), "
+                          f"platform={j.get('platform', '?')}")
+        except json.JSONDecodeError:
+            pass
+    if line:
+        try:
+            return False, json.loads(line).get("error", line)[:300]
+        except json.JSONDecodeError:
+            pass
+    return False, f"probe rc={proc.returncode}: {proc.stderr[-300:]}"
+
+
+def _utcnow():
+    import datetime
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _attempts_path():
+    import os
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_attempts.json")
+
+
+def wait_device_healthy(retry_for_s, interval_s, probe_timeout_s=300):
+    """Probe the device on a retry loop until healthy or the window ends.
+
+    This environment's tunnel wedges transiently (hours-scale, clears
+    server-side); a single failed probe must not erase a whole round's
+    measurements. Every attempt is recorded with a timestamp+outcome in
+    ``bench_attempts.json`` so a final failure is documented, not silent.
+    Returns True when a probe succeeds.
+    """
+    attempts = []
+    deadline = time.time() + max(retry_for_s, 0)
+    n = 0
+    while True:
+        n += 1
+        ok, note = _probe_device_child(probe_timeout_s)
+        attempts.append({"attempt": n, "ts": _utcnow(), "ok": ok,
+                         "note": note})
+        with open(_attempts_path(), "w") as f:
+            json.dump(attempts, f, indent=2)
+        print(json.dumps({"probe": n, "ok": ok, "note": note}),
+              flush=True)
+        if ok:
+            return True
+        remaining = deadline - time.time()
+        if remaining < interval_s:
+            return False
+        time.sleep(interval_s)
+
+
 def run_suite_isolated(names, steps, timeout_s=3600):
     """Run every config in its OWN child process (``bench.py --configs
     <name>``), one at a time.
@@ -1027,9 +1117,40 @@ def run_suite_isolated(names, steps, timeout_s=3600):
                           "mid-op)"}
         except json.JSONDecodeError as e:
             r = {"metric": name, "error": f"unparseable child output: {e}"}
+        r.setdefault("ts", _utcnow())
         results.append(r)
         print(json.dumps(r), flush=True)
     return results
+
+
+def _headline_from_suite(max_age_h: float = 11.0):
+    """The headline entry from this machine's last ``--suite`` run, or
+    None if absent/errored/older than ``max_age_h`` hours. Used only as a
+    clearly-labeled fallback when the tunnel is wedged at report time —
+    the age gate is SHORTER than a round (~12 h), so a previous round's
+    number can never be passed off as this round's."""
+    import datetime
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_suite.json")
+    try:
+        with open(path) as f:
+            suite = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    for r in suite:
+        if r.get("metric") == HEADLINE and "error" not in r \
+                and "ts" in r and r.get("value"):
+            try:
+                ts = datetime.datetime.fromisoformat(r["ts"])
+                age = datetime.datetime.now(
+                    datetime.timezone.utc) - ts
+            except ValueError:
+                return None
+            if age > datetime.timedelta(hours=max_age_h):
+                return None
+            return dict(r)
+    return None
 
 
 def main(argv=None):
@@ -1044,12 +1165,46 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=0, help="0 = auto")
     p.add_argument("--timeout", type=int, default=3600,
                    help="per-config wall clock in --suite mode")
+    p.add_argument("--probe", action="store_true",
+                   help="bounded device-health probe (child entry point "
+                        "for the retry loop); prints one JSON line")
+    p.add_argument("--probe-timeout", type=int, default=300)
+    p.add_argument("--retry-for", type=int, default=0,
+                   help="in --suite mode, keep probing a wedged device "
+                        "for this many seconds before giving up "
+                        "(attempts logged to bench_attempts.json)")
+    p.add_argument("--retry-interval", type=int, default=1200,
+                   help="seconds between health probes while retrying")
     args = p.parse_args(argv)
+
+    if args.probe:
+        t0 = time.time()
+        _device_watchdog(args.probe_timeout)   # hard-exits on failure
+        import jax
+        devs = jax.devices()
+        print(json.dumps({"ok": True, "init_s": round(time.time() - t0, 1),
+                          "n_devices": len(devs),
+                          "platform": devs[0].platform}), flush=True)
+        return 0
 
     if args.suite:
         # the parent stays OFF the device entirely — only children claim
         # it, so a wedged child cannot take the suite driver down with it
         import os
+        if not wait_device_healthy(args.retry_for, args.retry_interval,
+                                   args.probe_timeout):
+            out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_suite.json")
+            err = {"metric": "device_init_failed", "value": 0.0,
+                   "unit": "error", "vs_baseline": 0.0, "ts": _utcnow(),
+                   "error": "device unhealthy for the whole retry window;"
+                            " per-attempt log in bench_attempts.json"}
+            # never clobber a healthy suite file with a wedge report
+            if not os.path.exists(out):
+                with open(out, "w") as f:
+                    json.dump([err], f, indent=2)
+            print(json.dumps(err), flush=True)
+            return 1
         results = run_suite_isolated(list(CONFIGS), args.steps,
                                      args.timeout)
         out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -1057,6 +1212,31 @@ def main(argv=None):
         with open(out, "w") as f:
             json.dump(results, f, indent=2)
         return 1 if any("error" in r for r in results) else 0
+
+    if not args.configs:
+        # headline mode (the driver's end-of-round invocation): a wedged
+        # tunnel at report time must not erase a measurement captured
+        # earlier in the round — fall back to this round's suite entry,
+        # clearly labeled with its capture timestamp.
+        ok, note = _probe_device_child(args.probe_timeout)
+        if not ok:
+            fallback = _headline_from_suite()
+            if fallback is not None:
+                fallback["note"] = ("device wedged at report time "
+                                    f"({note}); value was measured live "
+                                    "on this chip at "
+                                    + fallback.get("ts", "?")
+                                    + " — per-attempt probe log in "
+                                      "bench_attempts.json")
+                print(json.dumps(fallback), flush=True)
+                return 0
+            print(json.dumps({
+                "metric": "device_init_failed", "value": 0.0,
+                "unit": "error", "vs_baseline": 0.0,
+                "error": f"tunnel unhealthy ({note}) and no suite "
+                         "measurement exists to fall back on"}),
+                flush=True)
+            return 1
 
     _device_watchdog()
     import jax

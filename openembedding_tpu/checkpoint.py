@@ -136,7 +136,8 @@ def save_checkpoint(path: str,
                     *,
                     dense_state: Any = None,
                     include_optimizer: bool = True,
-                    model_sign: str = "") -> None:
+                    model_sign: str = "",
+                    compress: str = "") -> None:
     """Dump all embedding variables (+ optional dense pytree) under ``path``.
 
     Works single- or multi-host: with N > 1 processes each host streams its
@@ -150,7 +151,17 @@ def save_checkpoint(path: str,
     writes are purely SEQUENTIAL streams — the reference's piped
     hadoop shard files (EmbeddingShardFile.h:57-63). Local paths keep the
     memmapped logical-order format.
+
+    ``compress``: codec for the block streams (``""``/``"zlib"``/
+    ``"zstd"`` — the reference's ``server.message_compress`` knob applied
+    to its shard-file streams, client/EnvConfig.cpp:27-34). Compressed
+    dumps use the keyed part format with ``.npyz`` framed streams; every
+    Python load path reads them transparently, but the native mmap
+    serving library (``native/oe_serving.cc``) needs raw ``.npy`` — keep
+    serving dumps uncompressed.
     """
+    from .utils import compress as compress_lib
+    compress = compress_lib.check(compress)
     nproc = jax.process_count()
     rank = jax.process_index()
     remote = fs.is_remote(path)
@@ -187,13 +198,17 @@ def save_checkpoint(path: str,
         state = states[name]
         vid = collection.variable_id(name)
         vdir = fs.join(path, _var_dir(vid, name))
-        part = f"part{rank}_" if (nproc > 1 or remote) else ""
+        part = f"part{rank}_" if (nproc > 1 or remote or compress) else ""
         if spec.use_hash:
-            _save_hash_var(vdir, state, include_optimizer, part=part)
-        elif nproc > 1 or remote:
+            _save_hash_var(vdir, state, include_optimizer, part=part,
+                           compress=compress)
+        elif nproc > 1 or remote or compress:
+            # compressed dumps ride the sequential part format — framed
+            # streams have no memmap representation
             _save_array_var_part(vdir, rank, state,
                                  collection.sharding_spec(name),
-                                 spec.input_dim, include_optimizer)
+                                 spec.input_dim, include_optimizer,
+                                 compress=compress)
         else:
             _save_array_var(vdir, state, collection.sharding_spec(name),
                             spec.input_dim, include_optimizer)
@@ -269,9 +284,18 @@ def _save_array_var(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
         del mm
 
 
+def _seq_writer(path_npy: str, dtype, shape, compress: str = ""):
+    """Sequential block writer: raw ``.npy`` or, with a codec, the framed
+    compressed ``.npyz`` container (``fs.NpyzWriter``)."""
+    if compress:
+        return fs.NpyzWriter(path_npy + "z", dtype, shape, compress)
+    return fs.NpyWriter(path_npy, dtype, shape)
+
+
 def _save_array_var_part(vdir: str, rank: int, state,
                          sspec: st.ShardingSpec, vocab: int,
-                         include_optimizer: bool) -> None:
+                         include_optimizer: bool,
+                         compress: str = "") -> None:
     """Multi-host / remote dump of one bounded variable: this process
     streams ITS addressable shards into keyed part files
     ``part<rank>_{ids,weights,slot_*}.npy`` (logical ids + rows) — the
@@ -291,13 +315,13 @@ def _save_array_var_part(vdir: str, rank: int, state,
         _, nv = _logical_slice(sspec, vocab, s.index[0].start or 0,
                                s.data.shape[0])
         nv_total += nv
-    with fs.NpyWriter(fs.join(vdir, f"part{rank}_ids.npy"),
-                      np.int64, (nv_total,)) as ids_w:
+    with _seq_writer(fs.join(vdir, f"part{rank}_ids.npy"),
+                     np.int64, (nv_total,), compress) as ids_w:
         for i, (fname, arr) in enumerate(targets.items()):
-            with fs.NpyWriter(
+            with _seq_writer(
                     fs.join(vdir, f"part{rank}_{fname}.npy"),
                     np.dtype(arr.dtype),
-                    (nv_total,) + arr.shape[1:]) as w:
+                    (nv_total,) + arr.shape[1:], compress) as w:
                 off = 0
                 for phys_start, block in _iter_shard_blocks(arr):
                     sl, nv = _logical_slice(sspec, vocab, phys_start,
@@ -313,7 +337,7 @@ def _save_array_var_part(vdir: str, rank: int, state,
 
 
 def _save_hash_var(vdir: str, state, include_optimizer: bool,
-                   part: str = "") -> None:
+                   part: str = "", compress: str = "") -> None:
     """Stream one hash variable's live rows to ``<vdir>/<part>*.npy``.
 
     Pass 1 counts live rows per addressable shard on-device; pass 2 streams
@@ -337,8 +361,9 @@ def _save_hash_var(vdir: str, state, include_optimizer: bool,
     with ExitStack() as stack:
         writers = {
             fname: stack.enter_context(
-                fs.NpyWriter(fs.join(vdir, part + fname + ".npy"),
-                             np.dtype(arr.dtype), (total,) + arr.shape[1:]))
+                _seq_writer(fs.join(vdir, part + fname + ".npy"),
+                            np.dtype(arr.dtype), (total,) + arr.shape[1:],
+                            compress))
             for fname, arr in targets.items()
         }
         offset = 0
@@ -392,29 +417,48 @@ class _NpyDirReader:
         self._vdir = vdir
         self._prefix = prefix
         self._remote = fs.is_remote(vdir)
-        self._names = {f[len(prefix):-4] for f in fs.listdir(vdir)
-                       if f.endswith(".npy") and f.startswith(prefix)
-                       and (prefix or not f.startswith("part"))}
+        # name -> file suffix: raw ".npy" (memmap-able locally) or the
+        # compressed framed ".npyz" container (stream-only everywhere)
+        self._suffix: Dict[str, str] = {}
+        for f in fs.listdir(vdir):
+            sfx = ".npy" if f.endswith(".npy") else \
+                ".npyz" if f.endswith(".npyz") else None
+            if sfx and f.startswith(prefix) \
+                    and (prefix or not f.startswith("part")):
+                self._suffix[f[len(prefix):-len(sfx)]] = sfx
+        self._names = set(self._suffix)
 
     def __contains__(self, name: str) -> bool:
         return name in self._names
 
+    @property
+    def streaming(self) -> bool:
+        """True when this part has no random-access representation
+        (remote URI or compressed frames) — loaders must take the
+        sequential ``rows``/``chunks`` path."""
+        return self._remote or ".npyz" in self._suffix.values()
+
     def _path(self, name: str) -> str:
         if name not in self._names:
             raise KeyError(name)
-        return fs.join(self._vdir, self._prefix + name + ".npy")
+        return fs.join(self._vdir, self._prefix + name + self._suffix[name])
 
     def __getitem__(self, name: str):
-        if self._remote:
-            raise TypeError("remote readers stream; use rows()/chunks()")
+        if self._remote or self._suffix.get(name) == ".npyz":
+            raise TypeError(
+                "remote/compressed readers stream; use rows()/chunks()")
         return np.load(self._path(name), mmap_mode="r")
 
     def rows(self, name: str) -> int:
+        if self._suffix.get(name) == ".npyz":
+            return fs.npyz_shape(self._path(name))[1][0]
         if self._remote:
             return fs.npy_shape(self._path(name))[1][0]
         return self[name].shape[0]
 
     def chunks(self, name: str, size: int):
+        if self._suffix.get(name) == ".npyz":
+            return fs.iter_npyz_chunks(self._path(name), size)
         if self._remote:
             return fs.iter_npy_chunks(self._path(name), size)
         arr = self[name]
@@ -734,7 +778,8 @@ def load_checkpoint(path: str,
             out[name] = _load_array_var_stream(
                 data, spec, sspec, optimizer, collection.mesh, with_opt,
                 from_hash=True, shard_slice=shard_slice)
-        elif fs.is_remote(path) or shard_slice is not None:
+        elif fs.is_remote(path) or shard_slice is not None \
+                or any(getattr(r, "streaming", False) for r in data):
             out[name] = _load_array_var_stream(
                 data, spec, sspec, optimizer, collection.mesh, with_opt,
                 shard_slice=shard_slice)

@@ -233,7 +233,8 @@ class EmbeddingCollection:
     # --- data plane --------------------------------------------------------
     def pull(self, states: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
              *, batch_sharded: bool = True,
-             read_only: bool = False) -> Dict[str, jnp.ndarray]:
+             read_only: bool = False,
+             serving_rows: bool = False) -> Dict[str, jnp.ndarray]:
         """Lookup rows for every (present) input column.
 
         ``inputs``: name -> integer indices of any shape; returns name ->
@@ -243,11 +244,17 @@ class EmbeddingCollection:
         custom PullWeights gradient (exb.py:89-97). ``read_only`` selects the
         serving contract: unknown hash keys return zeros instead of init rows
         (reference EmbeddingPullOperator read_only get_weights path).
+        ``serving_rows`` selects the ROW contract of the serving data plane:
+        one row per index (pair), no pooling, and any trailing dim of 2 on a
+        wide spec IS a pair axis — the shape a routing client fans out is
+        always a flat pair list, never a ``[B, L=2]`` sequence (a pooled
+        spec's training-side heuristic would misread it).
         """
         rows = {}
         for name, idx in inputs.items():
             spec = self.specs[name]
-            idx = self._widen(spec, idx)
+            idx = self._widen(spec, idx,
+                              pair_ndim=2 if serving_rows else None)
             if spec.use_hash:
                 r = sh.pull_sharded(
                     states[name], idx,
@@ -258,7 +265,7 @@ class EmbeddingCollection:
                 r = st.pull_sharded(
                     states[name], idx, mesh=self.mesh,
                     spec=self._shardings[name], batch_sharded=batch_sharded)
-            if spec.pooling:
+            if spec.pooling and not serving_rows:
                 # wide sequence features carry [B, L, 2] pair ids; the
                 # combiner counts validity on the hi word (ragged.py)
                 r = ragged.pool_rows(r, idx, spec.pooling,
@@ -271,7 +278,8 @@ class EmbeddingCollection:
     def _pool_vocab(self, spec: EmbeddingSpec) -> Optional[int]:
         return None if spec.use_hash else spec.input_dim
 
-    def _widen(self, spec: EmbeddingSpec, idx) -> jnp.ndarray:
+    def _widen(self, spec: EmbeddingSpec, idx,
+               pair_ndim: Optional[int] = None) -> jnp.ndarray:
         """Bridge plain id columns onto wide (pair-keyed) tables.
 
         Wide tables take ``[..., 2]`` pairs; a NARROW integer input
@@ -285,12 +293,15 @@ class EmbeddingCollection:
         through. Ambiguity rule: a trailing dim of 2 IS a pair axis (for
         pooled specs only at ndim >= 3, since their ``[B, L=2]`` matrices
         are sequences) — feed genuinely 2-wide narrow shapes through
-        ``split64`` instead.
+        ``split64`` instead. Callers with an unambiguous wire contract
+        (the serving row plane, whose queries are always flat pair lists)
+        pass ``pair_ndim=2`` to override the pooled-spec heuristic.
         """
         if not spec.use_hash or spec.key_dtype != "wide":
             return idx
         from . import hash_table as hash_lib
-        pair_ndim = 3 if spec.pooling else 2
+        if pair_ndim is None:
+            pair_ndim = 3 if spec.pooling else 2
         if not isinstance(idx, jax.Array):
             arr = np.asarray(idx)
             is_pairs = arr.ndim >= pair_ndim and arr.shape[-1] == 2

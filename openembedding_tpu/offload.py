@@ -336,6 +336,10 @@ class PreparedBatch:
     ``apply_prepared`` then turns it into device inserts. ``needs_evict``
     marks a batch whose misses would overflow the cache budget — eviction
     rebuilds the cache, so that batch falls back to the synchronous path.
+    ``gen`` stamps the residency GENERATION the prepare was computed
+    against: eviction/restore rebuild the cache and bump the generation,
+    so a stale in-flight prepare is recomputed at apply time instead of
+    inserting rows the rebuild just dropped.
     """
 
     uniq: np.ndarray                      # unique valid batch ids
@@ -343,6 +347,7 @@ class PreparedBatch:
     rows: Optional[np.ndarray]            # host_weights[missing]
     slot_rows: Dict[str, np.ndarray]      # host_slots[*][missing]
     needs_evict: bool = False
+    gen: int = 0                          # residency generation stamp
 
 
 class ShardedOffloadedTable:
@@ -443,6 +448,21 @@ class ShardedOffloadedTable:
 
         self._resident = np.zeros(self.vocab, bool)
         self._resident_count = 0  # kept exact; vocab-sized sums are O(GB)
+        # PLANNED residency: rows an in-flight PreparedBatch will insert at
+        # its apply. Lets a K-deep prepare chain compute batch N+k's misses
+        # against residency-as-of-batch-N+k-1 without waiting for the
+        # device applies; apply/cancel move or clear the marks, eviction
+        # invalidates them wholesale via the generation bump
+        self._planned = np.zeros(self.vocab, bool)
+        self._planned_count = 0
+        self._gen = 0
+        # guards the residency books (_resident/_planned/counts/_gen):
+        # host_prepare runs on the Trainer's lookahead thread WHILE
+        # apply_prepared/_evict mutate the books on the main thread — at
+        # depth K >= 2 some prepare is always mid-flight when an apply
+        # lands, so the read-compute-mark cycle must be atomic against
+        # the apply's planned->resident transfer and eviction's rebuild
+        self._book = threading.RLock()
         self._dirty = np.zeros(self.vocab, bool)
         self._last_touch = np.zeros(self.vocab, np.int64)
         self.work_id = 1
@@ -611,30 +631,82 @@ class ShardedOffloadedTable:
     def host_prepare(self, ids) -> PreparedBatch:
         """Host-only half of :meth:`prepare`: residency math + host gather.
 
-        Mutates NO bookkeeping, so it may run on a background thread while
-        the device executes the previous step (the reference's prefetch
-        issuing pulls ahead, exb_ops.cpp:109-205). Validity contract: the
-        result holds for as long as residency does not change, i.e. until
-        the next ``apply_prepared`` / ``prepare`` / ``restore`` call —
-        the Trainer's pipeline dispatches step N, then host-prepares
-        batch N+1, then applies it before step N+1.
+        Misses are computed against ``resident OR planned``, and the
+        result's own misses are marked PLANNED before returning — so a
+        chain of host_prepares for batches N+1..N+K (each run after the
+        previous one finished, e.g. on the Trainer's serialized lookahead
+        thread) sees exactly the residency each batch will find at its
+        apply, K batches before those applies run (the reference's
+        prefetch ``steps`` budget, exb_ops.cpp:109-205, attr :148-156).
+        Every prepared batch MUST then reach :meth:`apply_prepared` or
+        :meth:`cancel_prepared` (cancel ALL outstanding ones together —
+        later prepares assume earlier ones will insert their rows).
+        NOTE the pipeline's detection lag: a prepared insert that
+        overflows a cache shard surfaces up to ``OVERFLOW_CHECK_DEPTH``
+        batches later (see :meth:`check_overflow`); ``flush``/``persist``/
+        ``finish`` drain the window.
         """
         ids = np.unique(np.asarray(ids).ravel())
         ids = ids[(ids >= 0) & (ids < self.vocab)]
-        missing = ids[~self._resident[ids]]
         budget = int(self.occupancy_threshold * self.cache_capacity)
-        if self._resident_count + missing.size > budget:
-            # eviction rebuilds the cache (synchronous path); don't gather
-            return PreparedBatch(uniq=ids, missing=missing, rows=None,
-                                 slot_rows={}, needs_evict=True)
-        rows, srows = self._gather_host(missing)
-        return PreparedBatch(uniq=ids, missing=missing, rows=rows,
-                             slot_rows=srows)
+        while True:
+            with self._book:
+                gen = self._gen
+                missing = ids[~(self._resident[ids] | self._planned[ids])]
+                if self._resident_count + self._planned_count \
+                        + missing.size > budget:
+                    # eviction rebuilds the cache (sync path); no gather
+                    return PreparedBatch(uniq=ids, missing=missing,
+                                         rows=None, slot_rows={},
+                                         needs_evict=True, gen=gen)
+            # gather OUTSIDE the lock (large memmap reads; safe — missing
+            # rows are neither resident nor planned, so neither writeback
+            # nor eviction touches them)
+            rows, srows = self._gather_host(missing)
+            with self._book:
+                if self._gen != gen:
+                    continue  # evicted under the gather; recompute
+                # mark AFTER the gather succeeded — a failed prepare
+                # leaks nothing
+                self._planned[missing] = True
+                self._planned_count += int(missing.size)
+            return PreparedBatch(uniq=ids, missing=missing, rows=rows,
+                                 slot_rows=srows, gen=gen)
+
+    def cancel_prepared(self, prep: PreparedBatch) -> None:
+        """Release a prepared batch that will never be applied (the
+        Trainer abandoned its lookahead window). Must be called for ALL
+        outstanding prepares — each later prepare's miss set assumed the
+        earlier ones' planned rows."""
+        with self._book:
+            if prep.gen == self._gen and not prep.needs_evict:
+                self._planned[prep.missing] = False
+                self._planned_count -= int(prep.missing.size)
 
     def apply_prepared(self, cache, prep: PreparedBatch):
         """Device half: turn a :class:`PreparedBatch` into cache inserts.
         Falls back to the synchronous evict path when the batch overflows
-        the budget. Returns the updated cache state."""
+        the budget, and recomputes stale prepares (an eviction between
+        prepare and apply rebuilt the cache). Returns the updated cache
+        state."""
+        with self._book:
+            stale = prep.gen != self._gen and not prep.needs_evict
+            if stale:
+                # Residency was rebuilt under this prepare (eviction/
+                # restore bumped the generation): recompute — same uniq,
+                # fresh misses. The recompute must happen IN BATCH ORDER:
+                # a later lookahead prepare may already have re-planned
+                # under the new generation and claimed keys THIS batch
+                # needs resident now (its own apply runs K steps too
+                # late). So, atomically (the RLock is held across the
+                # whole recompute+apply): drop every planned claim, bump
+                # the generation again — later prepares re-recompute at
+                # THEIR applies — and reclaim for this batch first.
+                self._gen += 1
+                self._planned[:] = False
+                self._planned_count = 0
+                return self.apply_prepared(cache,
+                                           self.host_prepare(prep.uniq))
         # join FIRST: the caller's next jitted step may donate (delete) the
         # very cache buffers an in-flight async flush is still reading
         self._join_writeback()
@@ -644,20 +716,48 @@ class ShardedOffloadedTable:
         self._last_touch[prep.uniq] = self.work_id
         if prep.needs_evict:
             budget = int(self.occupancy_threshold * self.cache_capacity)
-            cache = self._evict(cache, protect=prep.uniq, budget=budget,
-                                incoming=prep.missing.size)
-            # re-gather AFTER eviction made host rows current
-            missing = prep.uniq[~self._resident[prep.uniq]]
-            rows, slot_rows = self._gather_host(missing)
+            # ONE atomic section for evict + re-derive + mark: a lookahead
+            # host_prepare recomputing after the generation bump must not
+            # claim (plan) keys this batch is about to insert — it would
+            # re-insert them at ITS apply with pre-update host rows,
+            # clobbering this step's gradient updates
+            with self._book:
+                cache = self._evict(cache, protect=prep.uniq,
+                                    budget=budget,
+                                    incoming=prep.missing.size)
+                # re-gather AFTER eviction made host rows current
+                missing = prep.uniq[~self._resident[prep.uniq]]
+                rows, slot_rows = self._gather_host(missing)
+                self._resident[missing] = True
+                self._resident_count += int(missing.size)
         else:
             missing, rows, slot_rows = prep.missing, prep.rows, \
                 prep.slot_rows
+            with self._book:
+                # transfer planned -> resident atomically: a concurrent
+                # host_prepare must never observe these keys as absent
+                # from both books
+                self._resident[missing] = True
+                self._resident_count += int(missing.size)
+                self._planned[missing] = False
+                self._planned_count -= int(missing.size)
         if missing.size == 0:
             return cache
-        cache = self._insert_rows(cache, missing, rows, slot_rows)
-        self._resident[missing] = True
-        self._resident_count += int(missing.size)
-        return cache
+        try:
+            return self._insert_rows(cache, missing, rows, slot_rows)
+        except BaseException:
+            # unwind the optimistic marks to the pre-apply state: a caller
+            # that survives the error (retry loop) must not find the books
+            # claiming rows the cache never received, and a RETRY of the
+            # same prep must be able to re-run the planned->resident
+            # transfer it came in with
+            with self._book:
+                self._resident[missing] = False
+                self._resident_count -= int(missing.size)
+                if not prep.needs_evict:
+                    self._planned[missing] = True
+                    self._planned_count += int(missing.size)
+            raise
 
     def prepare(self, cache, ids):
         """Make every (unique, valid) batch id cache-resident; returns the
@@ -691,6 +791,11 @@ class ShardedOffloadedTable:
         cache = self.create_cache(jax.random.PRNGKey(int(self.work_id)))
         self._resident[:] = False
         self._resident_count = 0
+        # invalidate every in-flight prepare: their miss sets were computed
+        # against the residency this rebuild just dropped
+        self._gen += 1
+        self._planned[:] = False
+        self._planned_count = 0
         if keep.size:
             cache = self._insert_from_host(cache, np.sort(keep))
             self._resident[keep] = True
@@ -799,8 +904,12 @@ class ShardedOffloadedTable:
         self.work_id = max(self.work_id, max_work + 1)
         self.persisted_work = max_work
         self._batches_since_persist = 0
-        self._resident[:] = False
-        self._resident_count = 0
-        self._dirty[:] = False
-        self._last_touch[:] = 0
+        with self._book:
+            self._resident[:] = False
+            self._resident_count = 0
+            self._gen += 1
+            self._planned[:] = False
+            self._planned_count = 0
+            self._dirty[:] = False
+            self._last_touch[:] = 0
         return self.create_cache(jax.random.PRNGKey(int(self.work_id)))

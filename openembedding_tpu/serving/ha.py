@@ -74,7 +74,11 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--hash_capacity", type=int, default=None)
     p.add_argument("--config", default="",
                    help="EnvConfig JSON file (serving section: port, "
-                        "replica_num, hash_capacity)")
+                        "replica_num, hash_capacity, message_compress)")
+    p.add_argument("--compress", default=None,
+                   help="binary data-plane codec (''|zlib|zstd) — the "
+                        "reference's server.message_compress; overrides "
+                        "the config file")
     args = p.parse_args(argv)
 
     import jax
@@ -87,10 +91,13 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     port = args.port if args.port is not None else cfg.port
     hash_capacity = (args.hash_capacity if args.hash_capacity is not None
                      else cfg.hash_capacity)
+    compress = (args.compress if args.compress is not None
+                else cfg.message_compress)
     mesh = create_mesh(1, len(jax.devices()))
     registry = ModelRegistry(mesh, default_hash_capacity=hash_capacity)
     peers = [e for e in args.peers.split(",") if e]
-    server = ControllerServer(registry, port=port, peers=peers).start()
+    server = ControllerServer(registry, port=port, peers=peers,
+                              compress=compress).start()
     print(f"replica: listening on {server.port}", flush=True)
 
     for item in args.load:
@@ -102,7 +109,7 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
               f"(shard {args.shard_index}/{args.shard_count})", flush=True)
 
     if peers:
-        n = restore_from_peers(registry, peers)
+        n = restore_from_peers(registry, peers, compress=compress)
         print(f"replica: restored {n} model(s) from peers", flush=True)
 
     print("replica: ready", flush=True)
@@ -114,7 +121,7 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
 
 
 def restore_from_peers(registry, peers: Sequence[str],
-                       wait: float = 30.0) -> int:
+                       wait: float = 30.0, compress: str = "") -> int:
     """Re-create every NORMAL model living peers serve (catalog hand-off).
 
     Aggregates the catalogs of ALL live peers (a replica must not pass its
@@ -162,7 +169,8 @@ def restore_from_peers(registry, peers: Sequence[str],
             print(f"replica: dump restore of {sign!r} from {uri!r} failed "
                   f"({e}); streaming rows from peer {ep}", flush=True)
             try:
-                restore_model_from_peer(registry, ep, sign)
+                restore_model_from_peer(registry, ep, sign,
+                                        compress=compress)
                 n += 1
             except Exception as e2:  # noqa: BLE001 — logged, not fatal
                 print(f"replica: peer-row restore of {sign!r} failed: "
@@ -179,15 +187,24 @@ def _np_dtype(name: str):
 
 
 def fetch_rows_page(endpoint: str, sign: str, variable: str, offset: int,
-                    limit: int, timeout: float = 60.0):
-    """One page of the peer-restore row stream: ``(ids, rows, total)``."""
+                    limit: int, timeout: float = 60.0,
+                    compress: str = ""):
+    """One page of the peer-restore row stream: ``(ids, rows, total)``.
+    ``compress`` asks the peer to pack the page body (the requester picks
+    the codec — a restore crossing a WAN-ish link trades CPU for bytes,
+    the reference's compressed RpcView reads, server/RpcView.h:63-105)."""
     url = (f"http://{endpoint}/models/{sign}/rows?variable={variable}"
            f"&offset={offset}&limit={limit}")
+    if compress:
+        url += f"&compress={compress}"
     with urllib.request.urlopen(url, timeout=timeout) as r:
         raw = r.read()
     nl = raw.index(b"\n")
     head = json.loads(raw[:nl])
     body = raw[nl + 1:]
+    if head.get("compress"):
+        from ..utils import compress as compress_lib
+        body = compress_lib.decompress(head["compress"], body)
     n = head["n"]
     ids = np.frombuffer(body[:n * 8], np.int64)
     rows = np.frombuffer(body[n * 8:], _np_dtype(head["dtype"]))
@@ -198,7 +215,8 @@ def fetch_rows_page(endpoint: str, sign: str, variable: str, offset: int,
 
 def restore_model_from_peer(registry, endpoint: str, sign: str, *,
                             page: int = 1 << 16,
-                            timeout: float = 60.0) -> str:
+                            timeout: float = 60.0,
+                            compress: str = "") -> str:
     """Rebuild ``sign`` purely from a LIVING replica's memory.
 
     The dump-less restore path: fetch the peer's ModelMeta, allocate blank
@@ -230,6 +248,22 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
     hash_names = [n for n, s in coll.specs.items() if s.use_hash]
     states = coll.init(jax.random.PRNGKey(0), only=hash_names)
     out = {}
+    codec = compress
+
+    def fetch(vname, off):
+        nonlocal codec
+        try:
+            return fetch_rows_page(endpoint, sign, vname, off, page,
+                                   timeout, compress=codec)
+        except urllib.error.HTTPError as e:
+            if codec and e.code == 404:
+                # pre-upgrade peer: its /rows route has no compress
+                # parameter — downgrade to raw pages for this restore
+                codec = ""
+                return fetch_rows_page(endpoint, sign, vname, off, page,
+                                       timeout)
+            raise
+
     for name, spec in coll.specs.items():
         sspec = coll.sharding_spec(name)
         offset, total = 0, None
@@ -238,8 +272,7 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
             empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
             wide = hash_lib.is_wide(state.keys)
             while total is None or offset < total:
-                ids, rows, total = fetch_rows_page(
-                    endpoint, sign, name, offset, page, timeout)
+                ids, rows, total = fetch(name, offset)
                 offset += page
                 if not ids.size:
                     continue
@@ -268,8 +301,7 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
             weights = st.filled_sharded(coll.mesh, sspec,
                                         (spec.output_dim,), 0.0, dtype)
             while total is None or offset < total:
-                ids, rows, total = fetch_rows_page(
-                    endpoint, sign, name, offset, page, timeout)
+                ids, rows, total = fetch(name, offset)
                 offset += page
                 if not ids.size:
                     continue
@@ -300,10 +332,13 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
                   env: Optional[Dict[str, str]] = None,
                   devices: int = 1,
                   shard_index: int = 0,
-                  shard_count: int = 1) -> subprocess.Popen:
+                  shard_count: int = 1,
+                  compress: str = "") -> subprocess.Popen:
     """Start a replica daemon as a child process (test/driver helper)."""
     cmd = [sys.executable, "-m", "openembedding_tpu.serving.ha",
            "--port", str(port)]
+    if compress:
+        cmd += ["--compress", compress]
     for item in load:
         cmd += ["--load", item]
     if peers:
@@ -358,11 +393,16 @@ class RoutingClient:
     where the master only tracks liveness).
     """
 
-    def __init__(self, endpoints: Sequence[str], timeout: float = 10.0):
+    def __init__(self, endpoints: Sequence[str], timeout: float = 10.0,
+                 compress: str = ""):
         if not endpoints:
             raise ValueError("need at least one replica endpoint")
+        from ..utils import compress as compress_lib
         self.endpoints = list(endpoints)
         self.timeout = timeout
+        # advertised to servers on binary lookups; responses from servers
+        # configured with the same message_compress codec arrive packed
+        self.compress = compress_lib.check(compress)
 
     # -- raw http ----------------------------------------------------------
     def _request(self, endpoint: str, method: str, path: str,
@@ -434,19 +474,32 @@ class RoutingClient:
         JSON list marshalling (the reference's zero-copy RpcView role,
         server/RpcView.h). The request header carries the index SHAPE, so
         wide [n, 2] pair queries and multi-dim batch shapes reconstruct
-        exactly server-side. Same failover rotation as :meth:`lookup`."""
+        exactly server-side. When the client was built with a ``compress``
+        codec it is ADVERTISED here (``accept_compress``); a server
+        configured with the same ``message_compress`` codec compresses the
+        row payload (the reference's compressed pull responses,
+        EmbeddingPullOperator.cpp:149-205). Same failover rotation as
+        :meth:`lookup`."""
         idx = np.ascontiguousarray(np.asarray(indices))
-        head = json.dumps({"variable": variable,
-                           "dtype": idx.dtype.name,
-                           "shape": list(idx.shape)}).encode() + b"\n"
+        req = {"variable": variable, "dtype": idx.dtype.name,
+               "shape": list(idx.shape)}
+        if self.compress:
+            req["accept_compress"] = [self.compress]
+        head = json.dumps(req).encode() + b"\n"
         body = head + idx.tobytes()
 
         def attempt(ep):
             raw = self._request_bin(ep, f"/models/{sign}/lookup_bin", body)
             nl = raw.index(b"\n")
             h = json.loads(raw[:nl])
-            return np.frombuffer(raw[nl + 1:], np.float32).reshape(
-                h["shape"])
+            payload = raw[nl + 1:]
+            if h.get("compress"):
+                from ..utils import compress as compress_lib
+                payload = compress_lib.decompress(h["compress"], payload)
+            # one release of tolerance for rolling upgrades: pre-r4
+            # replicas answered {"n","dim"} instead of {"shape"}
+            shape = h.get("shape") or [int(h["n"]), int(h["dim"])]
+            return np.frombuffer(payload, np.float32).reshape(shape)
 
         return self._rotate(attempt)
 

@@ -107,19 +107,43 @@ class ServingModel:
         return ids, rows, total
 
     def lookup(self, variable: Any, indices) -> jnp.ndarray:
-        """Read-only pull for one variable (by name or variable_id)."""
+        """Read-only pull for one variable (by name or variable_id).
+
+        Shape contract (disambiguates by SEQUENCE AXIS, never by the
+        pooled-spec training heuristic):
+
+        - FLAT queries — narrow ``[n]`` ids or wide ``[n, 2]`` pairs —
+          return ROW semantics: one row per id/pair, never pooled. This
+          is what the routing planes assume (they merge rows back by
+          position after fanning out flat lists,
+          ha.ShardedRoutingClient.lookup); inferring "pairs" from a
+          pooled spec's ndim>=3 rule here would misread the router's
+          ``[n, 2]`` pair lists as ``[B, L=2]`` sequences and pool each
+          32-bit word's row into garbage.
+        - SEQUENCE queries on a pooled spec — narrow ``[B, L]`` or wide
+          ``[B, L, 2]`` — return the training contract: pooled
+          ``[B, dim]``.
+
+        Carve-out: on a WIDE spec, ANY trailing dim of 2 is a pair axis
+        — a genuine narrow length-2 sequence shaped ``[B, 2]`` would be
+        misread as ``[B]`` (lo, hi) pairs. Pad such queries to L != 2
+        with the spec's pad id, or send them as ``[B, L, 2]`` pairs.
+        """
         name = (variable if isinstance(variable, str)
                 else self._by_id[int(variable)])
+        spec = self.collection.specs[name]
         idx = jnp.asarray(indices)
         # narrow id columns address wide tables via the same widening
-        # bridge the training pull uses (collection._widen)
-        idx = self.collection._widen(self.collection.specs[name], idx)
+        # bridge the training pull uses; pair_ndim=2 so the serving wire's
+        # flat pair lists always read as pairs
+        idx = self.collection._widen(spec, idx, pair_ndim=2)
+        seq_ndim = 3 if spec.use_hash and spec.key_dtype == "wide" else 2
+        as_rows = spec.pooling is None or idx.ndim < seq_ndim
         if self.shard_slice is not None:
             # owner rule: id % G on the (joined) 64-bit value — must match
             # the loader's slice filter (checkpoint._insert_hash_rows) and
             # the router's partition (ha.ShardedRoutingClient.lookup)
             k, G = self.shard_slice
-            spec = self.collection.specs[name]
             if not spec.use_hash:
                 idx = jnp.where(idx % G == k, idx // G, -1)
             elif spec.key_dtype == "wide":
@@ -140,7 +164,8 @@ class ServingModel:
                 empty = hash_lib.empty_key(idx.dtype)
                 idx = jnp.where(idx % G == k, idx, empty)
         rows = self.collection.pull(self.states, {name: idx},
-                                    batch_sharded=False, read_only=True)
+                                    batch_sharded=False, read_only=True,
+                                    serving_rows=as_rows)
         return rows[name]
 
 

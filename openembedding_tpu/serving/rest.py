@@ -57,7 +57,14 @@ def probe_nodes(endpoints):
     return out
 
 
-def make_handler(registry: ModelRegistry, peers=None):
+def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
+    """``compress``: codec for binary response bodies (the reference's
+    ``server.message_compress``, client/EnvConfig.cpp:27-34). Lookup
+    responses are compressed only when the CLIENT advertised the codec in
+    its request header (``accept_compress``), so mixed fleets stay
+    compatible; row pages honor the requester's ``&compress=`` choice."""
+    from ..utils import compress as compress_lib
+    compress = compress_lib.check(compress)
     peers = list(peers or [])
 
     class Handler(BaseHTTPRequestHandler):
@@ -119,18 +126,27 @@ def make_handler(registry: ModelRegistry, peers=None):
                             for name in model.collection.specs]})
                 m = re.fullmatch(
                     r"/models/([^/]+)/rows\?variable=([^&]+)"
-                    r"&offset=(\d+)&limit=(\d+)", self.path)
+                    r"&offset=(\d+)&limit=(\d+)(?:&compress=(\w+))?",
+                    self.path)
                 if m:
                     # binary row page (peer restore data plane): one JSON
-                    # header line + raw int64 ids + raw row bytes
+                    # header line + raw int64 ids + raw row bytes; the
+                    # REQUESTER picks the body codec via &compress=
                     model = registry.find_model(m.group(1))
                     ids, rows, total = model.export_rows(
                         m.group(2), int(m.group(3)), int(m.group(4)))
-                    header = json.dumps({
+                    from ..utils import compress as compress_lib
+                    codec = compress_lib.check(m.group(5) or "")
+                    head = {
                         "n": int(ids.shape[0]), "total": int(total),
                         "dim": int(rows.shape[1]) if rows.ndim == 2 else 0,
-                        "dtype": rows.dtype.name}).encode() + b"\n"
-                    payload = header + ids.tobytes() + rows.tobytes()
+                        "dtype": rows.dtype.name}
+                    body = ids.tobytes() + rows.tobytes()
+                    if codec:
+                        head["compress"] = codec
+                        body = compress_lib.compress(codec, body)
+                    header = json.dumps(head).encode() + b"\n"
+                    payload = header + body
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/octet-stream")
@@ -191,15 +207,26 @@ def make_handler(registry: ModelRegistry, peers=None):
                     raw = self.rfile.read(n)
                     nl = raw.index(b"\n")
                     head = json.loads(raw[:nl])
+                    # one release of header tolerance for rolling
+                    # upgrades: pre-r4 clients sent no shape at all in
+                    # the request header (servers then read the id
+                    # buffer flat)
+                    shape = head.get("shape", [-1])
                     idx = np.frombuffer(
                         raw[nl + 1:],
-                        dtype=np.dtype(head["dtype"])).reshape(head["shape"])
+                        dtype=np.dtype(head["dtype"])).reshape(shape)
                     model = registry.find_model(m.group(1))
                     rows = np.asarray(model.lookup(head["variable"], idx),
                                       dtype=np.float32)
-                    hdr = json.dumps({"shape": list(rows.shape)}
-                                     ).encode() + b"\n"
-                    payload = hdr + rows.tobytes()
+                    rhead = {"shape": list(rows.shape)}
+                    body = rows.tobytes()
+                    if compress and compress in head.get(
+                            "accept_compress", ()):
+                        from ..utils import compress as compress_lib
+                        rhead["compress"] = compress
+                        body = compress_lib.compress(compress, body)
+                    hdr = json.dumps(rhead).encode() + b"\n"
+                    payload = hdr + body
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/octet-stream")
@@ -238,9 +265,9 @@ class ControllerServer:
     """Threaded HTTP controller (the masterd+controller daemon analogue)."""
 
     def __init__(self, registry: ModelRegistry, port: int = DEFAULT_PORT,
-                 host: str = "127.0.0.1", peers=None):
-        self.httpd = ThreadingHTTPServer((host, port),
-                                         make_handler(registry, peers))
+                 host: str = "127.0.0.1", peers=None, compress: str = ""):
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_handler(registry, peers, compress=compress))
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)

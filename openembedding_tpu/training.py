@@ -20,6 +20,7 @@ tf.Variables (exb.py:100-104, README "Cache" mode).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from functools import partial
 from typing import Any, Callable, Dict, Optional
 
@@ -63,7 +64,8 @@ class Trainer:
                  dense_optimizer: optax.GradientTransformation,
                  loss_fn: Callable = binary_logloss,
                  sparse_as_dense: Optional[Any] = None,
-                 offload: Optional[Dict[str, Any]] = None):
+                 offload: Optional[Dict[str, Any]] = None,
+                 pipeline_depth: int = 2):
         """``sparse_as_dense``: DenseFeatureSpecs (from
         ``hybrid.split_sparse_dense``) kept as flax params inside the model —
         the reference's "Cache" hybrid. Batch ``sparse`` columns are routed
@@ -74,7 +76,15 @@ class Trainer:
         state lives in ``TrainState.emb`` like any hash variable; the
         Trainer auto-prepares each batch's rows before the jitted step and
         records dirty marks after it (PmemEmbeddingOptimizerVariable.h's
-        pre-touch + work advance)."""
+        pre-touch + work advance).
+
+        ``pipeline_depth``: how many batches of offload host-prepare may
+        run ahead of the device (the reference's prefetch ``steps``
+        budget, exb_ops.cpp:109-205 attr :148-156). Depth K keeps K
+        prepared batches in flight so a host prepare slower than the
+        device step still overlaps across the window; 1 restores the
+        single-lookahead pipeline; results are bit-identical at any
+        depth (the planned-residency chain in offload.host_prepare)."""
         if sparse_as_dense:
             from .hybrid import HybridModel
             module = HybridModel(inner=module,
@@ -103,8 +113,11 @@ class Trainer:
         self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self._train_step = None
         self._eval_step = None
-        # in-flight lookahead prepare: (thread, batch, results, errors)
-        self._prep = None
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        # in-flight lookahead prepares, oldest first; each entry's thread
+        # CHAINS on the previous one, so host_prepare calls run strictly
+        # in batch order (the planned-residency bookkeeping requires it)
+        self._preps: "deque" = deque()
 
     # --- initialization ----------------------------------------------------
     def _split_sparse(self, sparse: Dict[str, Any]):
@@ -193,9 +206,10 @@ class Trainer:
         step — the reference's PrefetchPullWeights issuing pulls ahead of
         the graph (exb_ops.cpp:109-205). The device-insert half is applied
         just before the next step consumes it, so step time approaches
-        max(host prepare, device step) instead of their sum. ``fit`` wires
-        the lookahead automatically; callers driving steps by hand pass
-        ``next_batch`` themselves (or skip it and keep the serial path).
+        max(host prepare, device step) instead of their sum. ``fit`` keeps
+        up to ``pipeline_depth`` prepared batches in flight automatically;
+        callers driving steps by hand pass ``next_batch`` themselves (or
+        skip it and keep the serial path).
         """
         if self._train_step is None:
             self._train_step = self._build_train_step()
@@ -203,20 +217,30 @@ class Trainer:
         state, metrics = self._train_step(state, self.shard_batch(batch))
         for name, table in self.offload.items():
             table.note_update(batch["sparse"][name], uniq=uniqs.get(name))
-        if next_batch is not None and self.offload:
+        if next_batch is not None and self.offload \
+                and not self._prep_started(next_batch):
             self._start_host_prepare(next_batch)
         return state, metrics
 
+    def _prep_started(self, batch) -> bool:
+        return any(e[1] is batch for e in self._preps)
+
     def _start_host_prepare(self, batch) -> None:
-        """Launch the host-only prepare of ``batch`` on a background
+        """Queue the host-only prepare of ``batch`` on a background
         thread (one thread covering every offloaded table, in registration
-        order). Results are picked up — and the thread joined — by the
-        next ``_apply_prepared_offload`` call."""
-        self._join_host_prepare()
+        order). Threads CHAIN: each joins its predecessor before running,
+        so prepares execute strictly in batch order no matter how many
+        are in flight — offload.host_prepare's planned-residency math is
+        only correct under that serialization. Results are picked up — and
+        the thread joined — when ``_apply_prepared_offload`` reaches this
+        batch."""
+        prev = self._preps[-1][0] if self._preps else None
         results: Dict[str, Any] = {}
         err: list = []
 
         def _run():
+            if prev is not None:
+                prev.join()
             try:
                 for name, table in self.offload.items():
                     results[name] = table.host_prepare(
@@ -226,31 +250,46 @@ class Trainer:
 
         t = threading.Thread(target=_run, daemon=True)
         t.start()
-        self._prep = (t, batch, results, err)
+        self._preps.append((t, batch, results, err))
 
-    def _join_host_prepare(self):
-        if self._prep is None:
-            return None
-        t, batch, results, err = self._prep
-        t.join()
-        self._prep = None
-        if err:
-            raise RuntimeError("background offload prepare failed") \
-                from err[0]
-        return batch, results
+    def _cancel_preps(self) -> None:
+        """Abandon every in-flight prepare (the caller is about to step a
+        batch the lookahead window didn't predict, or is unwinding).
+        Cancels oldest-first and covers the WHOLE window — later prepares'
+        miss sets assume the earlier ones' planned inserts."""
+        while self._preps:
+            t, _, results, err = self._preps.popleft()
+            t.join()
+            for name, prep in results.items():
+                self.offload[name].cancel_prepared(prep)
+            # a failed abandoned prepare left no planned marks (offload
+            # marks only after success); nothing further to unwind
 
     def _apply_prepared_offload(self, state: TrainState, batch):
-        """Apply this batch's prepared inserts (from the lookahead thread
-        when it prepared exactly this batch, else synchronously)."""
+        """Apply this batch's prepared inserts (from the lookahead window
+        when its OLDEST entry prepared exactly this batch, else cancel the
+        window and prepare synchronously)."""
         if not self.offload:
             return state, {}
-        prepped = self._join_host_prepare()
+        prepped = None
+        if self._preps and self._preps[0][1] is batch:
+            t, _, results, err = self._preps.popleft()
+            t.join()
+            if err:
+                # release the tables this entry DID prepare, then the rest
+                # of the window (its math built on this entry's marks)
+                for name, prep in results.items():
+                    self.offload[name].cancel_prepared(prep)
+                self._cancel_preps()
+                raise RuntimeError("background offload prepare failed") \
+                    from err[0]
+            prepped = results
+        else:
+            self._cancel_preps()
         emb = dict(state.emb)
         uniqs: Dict[str, Any] = {}
         for name, table in self.offload.items():
-            prep = None
-            if prepped is not None and prepped[0] is batch:
-                prep = prepped[1].get(name)
+            prep = prepped.get(name) if prepped is not None else None
             if prep is None:
                 prep = table.host_prepare(batch["sparse"][name])
             emb[name] = table.apply_prepared(emb[name], prep)
@@ -294,8 +333,9 @@ class Trainer:
             log_fn=print, persist_dir: Optional[str] = None):
         """Simple host loop over an iterable of batches (model.fit analogue).
 
-        Peeks ONE batch ahead so offloaded tables host-prepare batch N+1
-        while the device runs step N (see :meth:`train_step`).
+        Keeps up to ``pipeline_depth`` batches of offload host-prepare in
+        flight ahead of the device (see :meth:`train_step` and
+        ``pipeline_depth`` in the constructor).
 
         ``persist_dir``: incremental-persist offloaded tables whenever they
         signal ``should_persist`` — the reference's AutoPersist callback
@@ -307,26 +347,63 @@ class Trainer:
         """
         last = None
         it = iter(batches)
-        batch = next(it, None)
+        # the lookahead window holds the NEXT pipeline_depth batches; the
+        # head of the window is the batch about to step
+        window: deque = deque()
+
+        def refill():
+            while len(window) <= self.pipeline_depth:
+                nxt = next(it, None)
+                if nxt is None:
+                    return
+                window.append(nxt)
+
+        refill()
         i = 0
-        while batch is not None:
-            nxt = next(it, None)
-            state, metrics = self.train_step(state, batch, next_batch=nxt)
-            last = metrics
-            if persist_dir:
-                for name, table in self.offload.items():
-                    if table.should_persist:
-                        info = table.persist(state.emb[name],
-                                             f"{persist_dir}/{name}",
-                                             blocking=False)
-                        if log_every:
-                            log_fn(f"persisted {name}: {info}")
-            if log_every and (i + 1) % log_every == 0:
-                log_fn(f"step {i + 1}: loss={float(metrics['loss']):.5f}")
-            batch = nxt
-            i += 1
+        try:
+            while window:
+                # prepare the whole window through the chain — head
+                # included, so the apply always finds its batch at the
+                # front of the prep queue; during step N the preps for
+                # N+1..N+K are the ones genuinely in flight
+                if self.offload:
+                    for b in window:
+                        if not self._prep_started(b):
+                            self._start_host_prepare(b)
+                batch = window.popleft()
+                refill()
+                state, metrics = self.train_step(state, batch)
+                last = metrics
+                if persist_dir:
+                    for name, table in self.offload.items():
+                        if table.should_persist:
+                            info = table.persist(state.emb[name],
+                                                 f"{persist_dir}/{name}",
+                                                 blocking=False)
+                            if log_every:
+                                log_fn(f"persisted {name}: {info}")
+                if log_every and (i + 1) % log_every == 0:
+                    log_fn(
+                        f"step {i + 1}: loss={float(metrics['loss']):.5f}")
+                i += 1
+        except BaseException:
+            # an exception mid-loop must not mask the pipeline's deferred
+            # errors NOR leave the lookahead/persister threads unjoined —
+            # drain everything, suppressing secondary failures (the
+            # original exception is the story)
+            try:
+                self._cancel_preps()
+            except Exception:  # noqa: BLE001 — unwinding
+                pass
+            for table in self.offload.values():
+                try:
+                    table.finish()
+                except Exception:  # noqa: BLE001 — unwinding
+                    pass
+            raise
         # drain the pipeline: the LAST batch's deferred overflow counter and
         # any in-flight background persist must raise HERE, not be lost
+        self._cancel_preps()
         for table in self.offload.values():
             table.finish()
         return state, last

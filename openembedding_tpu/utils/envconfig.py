@@ -98,6 +98,10 @@ class ServingConfig:
     port: int = 8010
     replica_num: int = 3
     hash_capacity: int = 1 << 20
+    # binary data-plane codec (lookup responses, peer-restore row pages):
+    # ""|zlib|zstd — the reference's server.message_compress
+    # (client/EnvConfig.cpp:27-34)
+    message_compress: str = ""
 
     def __post_init__(self):
         _validate(self)
@@ -107,6 +111,9 @@ _check(ServingConfig, "port", lambda v: 0 <= v < 65536,
        "must be a port number (0 = ephemeral)")
 _check(ServingConfig, "replica_num", lambda v: v >= 1, "must be >= 1")
 _check(ServingConfig, "hash_capacity", lambda v: v > 0, "must be > 0")
+_check(ServingConfig, "message_compress",
+       lambda v: v in ("", "zlib", "zstd"),
+       "must be one of '', 'zlib', 'zstd'")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -276,6 +276,126 @@ class NpyWriter:
             self._f.close()
 
 
+_NPYZ_MAGIC = b"\x93NPYZ1\n"
+
+
+class NpyzWriter:
+    """Compressed twin of :class:`NpyWriter`: the same append-only
+    interface, but blocks are written as independently-compressed FRAMES
+    (``[u64 comp_len][u64 rows][comp bytes]``) after a JSON header line —
+    the reference's compressed shard-file streams
+    (server/RpcView.h:63-105 + EnvConfig ``message_compress``), container
+    edition. Frames decompress one at a time, so neither side ever holds
+    the whole array; readers are strictly sequential
+    (``iter_npyz_chunks``), matching the remote/.part load path.
+    """
+
+    def __init__(self, path: str, dtype, shape: Tuple[int, ...],
+                 codec: str = "zlib"):
+        from . import compress as C
+        self._codec = C.check(codec) or "zlib"
+        self._dtype = np.dtype(dtype)
+        self._shape = tuple(shape)
+        self._written = 0
+        self._f = open_file(path, "wb")
+        head = json.dumps({
+            "codec": self._codec,
+            "descr": np.lib.format.dtype_to_descr(self._dtype),
+            "shape": list(self._shape)}).encode() + b"\n"
+        self._f.write(_NPYZ_MAGIC + head)
+
+    def write(self, block: np.ndarray) -> None:
+        from . import compress as C
+        import struct
+        block = np.ascontiguousarray(block, dtype=self._dtype)
+        rows = block.shape[0] if block.ndim else 1
+        if not rows:
+            return
+        comp = C.compress(self._codec, block.tobytes())
+        self._f.write(struct.pack("<QQ", len(comp), rows))
+        self._f.write(comp)
+        self._written += rows
+
+    def close(self) -> None:
+        if self._written != (self._shape[0] if self._shape else 1):
+            self._f.close()
+            raise IOError(
+                f"NpyzWriter: wrote {self._written} rows, header promised "
+                f"{self._shape[0]}")
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is None:
+            self.close()
+        else:  # pragma: no cover - propagate original error
+            self._f.close()
+
+
+def read_npyz_header(f) -> Tuple[str, np.dtype, Tuple[int, ...]]:
+    magic = f.read(len(_NPYZ_MAGIC))
+    if magic != _NPYZ_MAGIC:
+        raise ValueError("not a .npyz stream (bad magic)")
+    line = bytearray()
+    while True:
+        c = f.read(1)
+        if not c:
+            raise IOError("truncated .npyz header")
+        if c == b"\n":
+            break
+        line += c
+    head = json.loads(bytes(line))
+    return (head["codec"], np.dtype(np.lib.format.descr_to_dtype(
+        head["descr"])), tuple(head["shape"]))
+
+
+def npyz_shape(path: str) -> Tuple[np.dtype, Tuple[int, ...]]:
+    with open_file(path, "rb") as f:
+        _, dtype, shape = read_npyz_header(f)
+        return dtype, shape
+
+
+def iter_npyz_chunks(path: str, chunk_rows: int) -> Iterator[np.ndarray]:
+    """Yield C-order row chunks of a .npyz stream, re-buffered to exactly
+    ``chunk_rows`` rows per chunk (except the last) regardless of the
+    writer's frame sizes — the contract ``_aligned_reader_chunks`` needs
+    to walk several fields in lockstep."""
+    from . import compress as C
+    import struct
+    with open_file(path, "rb") as f:
+        codec, dtype, shape = read_npyz_header(f)
+        row_shape = tuple(shape[1:])
+        total = shape[0] if shape else 1
+        pending: list = []
+        pending_rows = 0
+        seen = 0
+        while seen < total:
+            hdr = f.read(16)
+            if len(hdr) != 16:
+                raise IOError(f"truncated .npyz frame header in {path}")
+            comp_len, rows = struct.unpack("<QQ", hdr)
+            comp = f.read(comp_len)
+            if len(comp) != comp_len:
+                raise IOError(f"truncated .npyz frame in {path}")
+            arr = np.frombuffer(C.decompress(codec, comp),
+                                dtype=dtype).reshape((rows,) + row_shape)
+            seen += rows
+            pending.append(arr)
+            pending_rows += rows
+            while pending_rows >= chunk_rows:
+                buf = np.concatenate(pending) if len(pending) > 1 \
+                    else pending[0]
+                yield buf[:chunk_rows]
+                rest = buf[chunk_rows:]
+                pending = [rest] if rest.shape[0] else []
+                pending_rows = rest.shape[0]
+        if pending_rows:
+            yield (np.concatenate(pending) if len(pending) > 1
+                   else pending[0])
+
+
 def read_npy_header(f) -> Tuple[np.dtype, Tuple[int, ...]]:
     version = np.lib.format.read_magic(f)
     if version == (1, 0):

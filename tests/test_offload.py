@@ -293,7 +293,7 @@ class TestPipelinedOffload:
     overlap is a scheduling change, not a numerics change (the reference's
     prefetch_pull_weights contract, exb_ops.cpp:109-205)."""
 
-    def _trainer(self, mesh, vocab=2048, cache=256):
+    def _trainer(self, mesh, vocab=2048, cache=256, depth=2):
         import optax
         from openembedding_tpu import EmbeddingCollection, Trainer
         from openembedding_tpu.models import deepctr
@@ -316,7 +316,8 @@ class TestPipelinedOffload:
         trainer = Trainer(
             deepctr.LogisticRegression(feature_names=("off",)),
             coll, optax.sgd(0.1),
-            offload={"off": table, "off:linear": lin})
+            offload={"off": table, "off:linear": lin},
+            pipeline_depth=depth)
         return trainer, table, lin
 
     def _batches(self, n, vocab=2048, seed=0):
@@ -330,7 +331,13 @@ class TestPipelinedOffload:
                         "sparse": {"off": ids, "off:linear": ids}})
         return out
 
-    def test_pipelined_fit_matches_serial_steps(self, devices8, tmp_path):
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_pipelined_fit_matches_serial_steps(self, devices8, tmp_path,
+                                                depth):
+        """Bit-identical at EVERY lookahead depth: the planned-residency
+        chain must make K prepares in flight equivalent to the serial
+        order (the reference's prefetch ``steps`` budget is likewise a
+        pure scheduling knob, exb_ops.cpp:148-156)."""
         from openembedding_tpu.parallel.mesh import create_mesh
         mesh = create_mesh(2, 4, devices8)
         batches = self._batches(8)
@@ -344,7 +351,7 @@ class TestPipelinedOffload:
         tab_ser.flush(s_ser.emb["off"]); tab_ser._join_writeback()
 
         # pipelined: fit with lookahead + background persist
-        t_pipe, tab_pipe, lin_pipe = self._trainer(mesh)
+        t_pipe, tab_pipe, lin_pipe = self._trainer(mesh, depth=depth)
         s_pipe = t_pipe.init(jax.random.PRNGKey(0),
                              t_pipe.shard_batch(batches[0]))
         s_pipe, m_pipe = t_pipe.fit(s_pipe, batches,
@@ -364,17 +371,22 @@ class TestPipelinedOffload:
         assert tab_r.persisted_work > 0
         assert c.keys.shape[0] == tab_r.cache_capacity
 
-    def test_pipeline_survives_eviction_batches(self, devices8):
+    @pytest.mark.parametrize("depth", [2, 4])
+    def test_pipeline_survives_eviction_batches(self, devices8, depth):
         """A lookahead batch that would overflow the cache falls back to
-        the synchronous evict path mid-pipeline, values staying exact."""
+        the synchronous evict path mid-pipeline, values staying exact —
+        including the generation-bump recompute of the (depth-1) prepares
+        that were in flight when the eviction rebuilt the cache."""
         from openembedding_tpu.parallel.mesh import create_mesh
         mesh = create_mesh(2, 4, devices8)
         batches = self._batches(10, seed=5)
-        t_small, tab_small, _ = self._trainer(mesh, cache=256)  # evicts
+        t_small, tab_small, _ = self._trainer(mesh, cache=256,
+                                              depth=depth)  # evicts
         s = t_small.init(jax.random.PRNGKey(0),
                          t_small.shard_batch(batches[0]))
         s, _ = t_small.fit(s, batches)
         tab_small.flush(s.emb["off"]); tab_small._join_writeback()
+        assert tab_small._gen > 0  # eviction really hit the pipeline
 
         t_big, tab_big, _ = self._trainer(mesh, cache=2048)  # never evicts
         s2 = t_big.init(jax.random.PRNGKey(0),

@@ -252,3 +252,87 @@ def test_shard_groups_with_replicas(sharded_model):
                                    atol=1e-7)
     finally:
         _cleanup(procs)
+
+
+def test_pooled_wide_spec_serves_rows(tmp_path_factory):
+    """Regression (advisor r4): a POOLED wide spec must serve with ROW
+    semantics. The routing plane always fans out flat ``[n, 2]`` pair
+    queries (ShardedRoutingClient.lookup reshapes every wide query to
+    ``[-1, 2]``); the training-side widen heuristic treats pairs on a
+    pooled spec as pairs only at ndim >= 3, so without the serving
+    override those queries were widened to ``[n, 2, 2]``, each 32-bit
+    WORD looked up as an independent key, owner-filtered wrongly, and
+    pooled — silently wrong embeddings. Here: per-pair rows must come
+    back unpooled, shard-filtered by the JOINED id."""
+    from openembedding_tpu import hash_table as hl
+    from openembedding_tpu.serving.registry import ModelRegistry
+
+    path = str(tmp_path_factory.mktemp("pooledwide") / "model")
+    mesh = create_mesh(1, 1, jax.devices()[:1])
+    psign = "pooled-wide-1"
+    spec = EmbeddingSpec(
+        name="seq", input_dim=-1, output_dim=DIM, hash_capacity=512,
+        key_dtype="wide", pooling="mean",
+        initializer={"category": "normal", "stddev": 1.0},
+        optimizer={"category": "sgd", "learning_rate": 1.0})
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(11))
+    # 2^62-scale keys, some differing only in the hi word; materialize
+    # their rows through the POOLED training pull ([B, L, 2] sequences)
+    keys64 = np.concatenate([
+        (3 << 60) + np.arange(1, 13, dtype=np.int64),
+        (3 << 60) + (np.arange(1, 13, dtype=np.int64) << 32)])
+    seq = jnp.asarray(hl.split64(keys64).reshape(4, 6, 2))
+    pooled = coll.pull(states, {"seq": seq}, batch_sharded=False)["seq"]
+    assert pooled.shape == (4, DIM)  # the training contract still pools
+    # rows materialize on the UPDATE (deferred per-key init); pooled specs
+    # push [B, dim] grads which the pooling VJP expands per slot
+    g = jnp.asarray(np.arange(1, 4 * DIM + 1, dtype=np.float32)
+                    .reshape(4, DIM))
+    states = coll.apply_gradients(states, {"seq": seq}, {"seq": g},
+                                  batch_sharded=False)
+    ckpt.save_checkpoint(path, coll, states, model_sign=psign)
+
+    # ground truth per-key rows via a non-pooled twin of the same dump
+    twin = EmbeddingCollection(
+        (EmbeddingSpec(name="seq", input_dim=-1, output_dim=DIM,
+                       hash_capacity=512, key_dtype="wide",
+                       initializer={"category": "constant", "value": 0.0},
+                       optimizer={"category": "sgd", "learning_rate": 1.0}),),
+        mesh)
+    tstates = ckpt.load_checkpoint(path, twin)
+    pairs = hl.split64(keys64)
+    want = np.asarray(twin.pull(tstates, {"seq": jnp.asarray(pairs)},
+                                batch_sharded=False, read_only=True)["seq"])
+    assert float(np.abs(want).max()) > 0  # rows really exist
+
+    # un-sharded serving: flat pair list -> one row per pair, no pooling
+    reg = ModelRegistry(mesh, default_hash_capacity=512)
+    reg.create_model(path, model_sign=psign)
+    got = np.asarray(reg.find_model(psign).lookup("seq", pairs))
+    assert got.shape == (len(keys64), DIM)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    # ... while SEQUENCE-shaped queries ([B, L, 2]) keep the training
+    # contract: pooled [B, dim] (all slots valid here)
+    got_seq = np.asarray(reg.find_model(psign).lookup(
+        "seq", pairs.reshape(4, 6, 2)))
+    np.testing.assert_allclose(
+        got_seq, want.reshape(4, 6, DIM).mean(axis=1), rtol=1e-5,
+        atol=1e-6)
+
+    # shard-sliced serving (G=3 exercises hi-word-dependent owners):
+    # each slice returns ITS rows and zeros elsewhere; slices partition
+    G = 3
+    owners = keys64 % G
+    total = np.zeros_like(want)
+    for k in range(G):
+        regk = ModelRegistry(mesh, default_hash_capacity=512)
+        regk.create_model(path, model_sign=psign,
+                          shard_index=k, shard_count=G)
+        gotk = np.asarray(regk.find_model(psign).lookup("seq", pairs))
+        assert gotk.shape == (len(keys64), DIM)
+        np.testing.assert_allclose(gotk[owners == k], want[owners == k],
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(gotk[owners != k], 0.0)
+        total += gotk
+    np.testing.assert_allclose(total, want, rtol=1e-6, atol=1e-7)
