@@ -463,6 +463,7 @@ class ShardedOffloadedTable:
         # lands, so the read-compute-mark cycle must be atomic against
         # the apply's planned->resident transfer and eviction's rebuild
         self._book = threading.RLock()
+        self.evictions = 0  # lifetime LRU-eviction count (observability)
         self._dirty = np.zeros(self.vocab, bool)
         self._last_touch = np.zeros(self.vocab, np.int64)
         self.work_id = 1
@@ -796,6 +797,7 @@ class ShardedOffloadedTable:
         self._gen += 1
         self._planned[:] = False
         self._planned_count = 0
+        self.evictions += 1
         if keep.size:
             cache = self._insert_from_host(cache, np.sort(keep))
             self._resident[keep] = True

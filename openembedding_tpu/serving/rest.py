@@ -135,7 +135,6 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                     model = registry.find_model(m.group(1))
                     ids, rows, total = model.export_rows(
                         m.group(2), int(m.group(3)), int(m.group(4)))
-                    from ..utils import compress as compress_lib
                     codec = compress_lib.check(m.group(5) or "")
                     head = {
                         "n": int(ids.shape[0]), "total": int(total),
@@ -222,7 +221,6 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                     body = rows.tobytes()
                     if compress and compress in head.get(
                             "accept_compress", ()):
-                        from ..utils import compress as compress_lib
                         rhead["compress"] = compress
                         body = compress_lib.compress(compress, body)
                     hdr = json.dumps(rhead).encode() + b"\n"

@@ -225,6 +225,19 @@ class Trainer:
     def _prep_started(self, batch) -> bool:
         return any(e[1] is batch for e in self._preps)
 
+    def prefetch(self, batches) -> None:
+        """Queue offload host-prepares for upcoming batches — the current
+        batch plus up to ``pipeline_depth`` ahead (``fit`` does this
+        automatically; hand-driven loops call it before each
+        ``train_step``, mirroring the reference's explicit prefetch op,
+        exb_ops.cpp:109-205). Order matters: pass batches in the order
+        they will be stepped, starting with the batch about to run."""
+        if not self.offload:
+            return
+        for b in list(batches)[: self.pipeline_depth + 1]:
+            if b is not None and not self._prep_started(b):
+                self._start_host_prepare(b)
+
     def _start_host_prepare(self, batch) -> None:
         """Queue the host-only prepare of ``batch`` on a background
         thread (one thread covering every offloaded table, in registration

@@ -111,9 +111,14 @@ _check(ServingConfig, "port", lambda v: 0 <= v < 65536,
        "must be a port number (0 = ephemeral)")
 _check(ServingConfig, "replica_num", lambda v: v >= 1, "must be >= 1")
 _check(ServingConfig, "hash_capacity", lambda v: v > 0, "must be > 0")
-_check(ServingConfig, "message_compress",
-       lambda v: v in ("", "zlib", "zstd"),
-       "must be one of '', 'zlib', 'zstd'")
+def _compress_ok(v) -> bool:
+    from . import compress as compress_lib
+    compress_lib.check(v)   # raises with the known-codec list + zstd gate
+    return True
+
+
+_check(ServingConfig, "message_compress", _compress_ok,
+       "must be a known, available codec ('', 'zlib', 'zstd')")
 
 
 @dataclasses.dataclass(frozen=True)

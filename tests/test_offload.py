@@ -509,3 +509,146 @@ def test_persist_restore_remote_uri(tmp_path):
     t2 = make_table()
     t2.restore(uri)
     np.testing.assert_allclose(t2.host_weights, t.host_weights, rtol=1e-6)
+
+
+_PIPELINE_KILL_CHILD = r"""
+import sys
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np
+import optax
+from openembedding_tpu import (EmbeddingCollection, EmbeddingVariableMeta,
+                               Trainer)
+from openembedding_tpu.models import deepctr
+from openembedding_tpu.offload import ShardedOffloadedTable
+from openembedding_tpu.parallel.mesh import create_mesh
+
+mesh = create_mesh(2, 4)
+table = ShardedOffloadedTable(
+    "off", EmbeddingVariableMeta(embedding_dim=1, vocabulary_size=2048),
+    {{"category": "adagrad", "learning_rate": 0.1}},
+    {{"category": "constant", "value": 0.25}},
+    vocab=2048, cache_capacity=256, mesh=mesh, persist_pending_window=2)
+coll = EmbeddingCollection((table.embedding_spec(name="off:linear"),),
+                           mesh)
+trainer = Trainer(deepctr.LogisticRegression(feature_names=("off",)),
+                  coll, optax.sgd(0.1), offload={{"off:linear": table}},
+                  pipeline_depth=3)
+rng = np.random.RandomState(11)
+batches = []
+for i in range(40):
+    lo = (i * 300) % 1600
+    ids = rng.randint(lo, lo + 400, 64).astype(np.int32)
+    batches.append({{"label": (ids % 2).astype(np.float32), "dense": None,
+                   "sparse": {{"off:linear": ids}}}})
+state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(batches[0]))
+trainer.fit(state, batches, log_every=1, persist_dir={pdir!r})
+print("FINISHED", flush=True)
+"""
+
+
+def test_kill_mid_pipelined_fit_resume_exact(tmp_path):
+    """SIGKILL a child mid-``fit`` with the WHOLE pipeline in flight —
+    depth-3 lookahead prepares, async writeback, async incremental
+    persist — then restore from the committed chain and RESUME from the
+    committed watermark: the resumed run must land bit-identical to an
+    uninterrupted serial run of the same batches (the reference's
+    restore-and-continue contract around its transactional PMem commits,
+    PmemEmbeddingItemPool.h:236-296)."""
+    import os
+    import signal as signal_mod
+    import subprocess
+    import sys
+    import jax
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, Trainer)
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pdir = str(tmp_path / "p")
+    code = _PIPELINE_KILL_CHILD.format(root=root, pdir=pdir)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    # kill mid-run: after step 15 the depth-3 window is full, the async
+    # persister has fired ~7 times, and writebacks ride evictions
+    killed = False
+    for line in proc.stdout:
+        if line.startswith("step 15:"):
+            proc.send_signal(signal_mod.SIGKILL)
+            killed = True
+            break
+        assert not line.startswith("FINISHED"), "child outran the kill"
+    assert killed, "child died before step 15"
+    proc.wait()
+
+    def make_parts(depth):
+        mesh = create_mesh(2, 4, jax.devices()[:8])
+        from openembedding_tpu import EmbeddingVariableMeta
+        table = ShardedOffloadedTable(
+            "off", EmbeddingVariableMeta(embedding_dim=1,
+                                         vocabulary_size=2048),
+            {"category": "adagrad", "learning_rate": 0.1},
+            {"category": "constant", "value": 0.25},
+            vocab=2048, cache_capacity=256, mesh=mesh,
+            persist_pending_window=2)
+        coll = EmbeddingCollection(
+            (table.embedding_spec(name="off:linear"),), mesh)
+        trainer = Trainer(
+            deepctr.LogisticRegression(feature_names=("off",)),
+            coll, optax.sgd(0.1), offload={"off:linear": table},
+            pipeline_depth=depth)
+        return trainer, table
+
+    rng = np.random.RandomState(11)
+    batches = []
+    for i in range(40):
+        lo = (i * 300) % 1600
+        ids = rng.randint(lo, lo + 400, 64).astype(np.int32)
+        batches.append({"label": (ids % 2).astype(np.float32),
+                        "dense": None, "sparse": {"off:linear": ids}})
+
+    # serial reference: snapshot (host store, params) after every batch
+    t_ref, tab_ref = make_parts(1)
+    s_ref = t_ref.init(jax.random.PRNGKey(0),
+                       t_ref.shard_batch(batches[0]))
+    snaps = {}
+    for b in batches:
+        s_ref, _ = t_ref.train_step(s_ref, b)
+        tab_ref.flush(s_ref.emb["off:linear"])
+        tab_ref._join_writeback()
+        snaps[tab_ref.work_id] = (
+            tab_ref.host_weights.copy(),
+            {k: v.copy() for k, v in tab_ref.host_slots.items()},
+            jax.tree.map(lambda x: np.asarray(x).copy(), s_ref.params))
+
+    # restore: the chain must be consistent at SOME committed watermark
+    t_res, tab_res = make_parts(3)
+    cache = tab_res.restore(os.path.join(pdir, "off:linear"))
+    w = tab_res.persisted_work
+    assert w in snaps and w >= 3, f"watermark {w} not a batch boundary"
+    ref_weights, ref_slots, ref_params = snaps[w]
+    np.testing.assert_array_equal(tab_res.host_weights, ref_weights)
+    for k in ref_slots:
+        np.testing.assert_array_equal(tab_res.host_slots[k], ref_slots[k])
+
+    # resume from the watermark with the reference's dense params: the
+    # continued run must land exactly where the uninterrupted run did
+    s2 = t_res.init(jax.random.PRNGKey(0), t_res.shard_batch(batches[0]))
+    s2 = s2.replace(emb={"off:linear": cache},
+                    params=jax.tree.map(jnp.asarray, ref_params))
+    done = w - 1    # work_id w  <=>  w-1 batches committed
+    s2, _ = t_res.fit(s2, batches[done:])
+    tab_res.flush(s2.emb["off:linear"])
+    tab_res._join_writeback()
+    np.testing.assert_array_equal(tab_res.host_weights,
+                                  tab_ref.host_weights)
+    for k in tab_ref.host_slots:
+        np.testing.assert_array_equal(tab_res.host_slots[k],
+                                      tab_ref.host_slots[k])
