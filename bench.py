@@ -354,6 +354,15 @@ def run_offload(name, config, *, steps, warmup):
             persist_rows = info["rows"]
         finally:
             shutil.rmtree(pdir, ignore_errors=True)
+        if PROFILE_DIR:
+            # traced block OUTSIDE the timed/persist measurements
+            extra = [make_batch() for _ in range(10)]
+            with _maybe_profile(name):
+                for i, b in enumerate(extra):
+                    if not serial:
+                        trainer.prefetch(extra[i:i + 1 + depth])
+                    state, m = trainer.train_step(state, b)
+                jax.block_until_ready(m["loss"])
         eps = steps * batch / dt
         store_gb = sum(
             t.host_weights.nbytes + sum(v.nbytes
@@ -1149,6 +1158,14 @@ def wait_device_healthy(retry_for_s, interval_s, probe_timeout_s=300):
         time.sleep(interval_s)
 
 
+# configs whose VALUE is device-independent (an AUC, a parity spread, a
+# CPU-daemon latency, local-disk GB/s): the suite runs them on the CPU
+# backend — faster, no HBM pollution, and a wedged tunnel cannot erase
+# them (their metric name records the platform)
+DEVICELESS = frozenset({"serving_lookup", "ckpt_local_2gb", "auc_criteo",
+                        "plane_parity"})
+
+
 def run_suite_isolated(names, steps, timeout_s=3600, profile=""):
     """Run every config in its OWN child process (``bench.py --configs
     <name>``), one at a time.
@@ -1172,7 +1189,8 @@ def run_suite_isolated(names, steps, timeout_s=3600, profile=""):
     results = []
     hung = False
     for name in names:
-        if hung:
+        deviceless = name in DEVICELESS
+        if hung and not deviceless:
             results.append({"metric": name,
                             "error": "skipped: device held by an earlier "
                                      "hung config (left unkilled to avoid "
@@ -1184,8 +1202,16 @@ def run_suite_isolated(names, steps, timeout_s=3600, profile=""):
             cmd += ["--steps", str(steps)]
         if profile:
             cmd += ["--profile", profile]
+        env = dict(os.environ)
+        if deviceless:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+            # a CPU child must not register the TPU-tunnel PJRT plugin —
+            # an unhealthy tunnel can hang the import itself
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, text=True)
+                                stderr=subprocess.PIPE, text=True,
+                                env=env)
         try:
             out, err = proc.communicate(timeout=timeout_s)
             line = next((ln for ln in reversed(out.strip().splitlines())
@@ -1264,8 +1290,9 @@ def main(argv=None):
                    help="seconds between health probes while retrying")
     p.add_argument("--profile", default="",
                    help="directory for jax.profiler traces (one block per "
-                        "config; TensorBoard/Perfetto viewable) — the "
-                        "reference benchmark's --profile flag")
+                        "train/offload-throughput config; TensorBoard/"
+                        "Perfetto viewable) — the reference benchmark's "
+                        "--profile flag")
     args = p.parse_args(argv)
     if args.profile:
         global PROFILE_DIR
@@ -1287,17 +1314,31 @@ def main(argv=None):
         import os
         if not wait_device_healthy(args.retry_for, args.retry_interval,
                                    args.probe_timeout):
+            # the DEVICELESS subset still measures (AUC, parity spread,
+            # serving latency, disk IO are platform-independent values) —
+            # a wedge erases the throughput matrix, not the whole story
+            results = run_suite_isolated(
+                [n for n in CONFIGS if n in DEVICELESS], args.steps,
+                args.timeout, profile=args.profile)
+            results += [{
+                "metric": n, "value": 0.0, "unit": "error",
+                "vs_baseline": 0.0, "ts": _utcnow(),
+                "error": "device unhealthy for the whole retry window; "
+                         "per-attempt log in bench_attempts.json"}
+                for n in CONFIGS if n not in DEVICELESS]
             out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_suite.json")
-            err = {"metric": "device_init_failed", "value": 0.0,
-                   "unit": "error", "vs_baseline": 0.0, "ts": _utcnow(),
-                   "error": "device unhealthy for the whole retry window;"
-                            " per-attempt log in bench_attempts.json"}
-            # never clobber a healthy suite file with a wedge report
-            if not os.path.exists(out):
+            # overwrite stale/older suite files (ts fields carry per-entry
+            # provenance) — but never clobber a same-round HEALTHY suite
+            # (fresh timestamped headline) with this wedge-limited one
+            if _headline_from_suite() is None:
                 with open(out, "w") as f:
-                    json.dump([err], f, indent=2)
-            print(json.dumps(err), flush=True)
+                    json.dump(results, f, indent=2)
+            print(json.dumps({"metric": "suite_partial_deviceless",
+                              "value": float(sum(1 for r in results
+                                                 if "error" not in r)),
+                              "unit": "configs", "vs_baseline": 0.0}),
+                  flush=True)
             return 1
         results = run_suite_isolated(list(CONFIGS), args.steps,
                                      args.timeout, profile=args.profile)

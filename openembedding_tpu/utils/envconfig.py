@@ -113,7 +113,10 @@ _check(ServingConfig, "replica_num", lambda v: v >= 1, "must be >= 1")
 _check(ServingConfig, "hash_capacity", lambda v: v > 0, "must be > 0")
 def _compress_ok(v) -> bool:
     from . import compress as compress_lib
-    compress_lib.check(v)   # raises with the known-codec list + zstd gate
+    try:
+        compress_lib.check(v)   # known-codec list + zstd-binding gate
+    except ValueError:
+        return False            # _validate adds the field context
     return True
 
 

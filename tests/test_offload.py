@@ -574,7 +574,10 @@ def test_kill_mid_pipelined_fit_resume_exact(tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("XLA_FLAGS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+    # -u: the child's fit() log lines must stream UNBUFFERED — with the
+    # default block buffering every line arrives only at exit and the
+    # SIGKILL would land on an already-finished child (a vacuous test)
+    proc = subprocess.Popen([sys.executable, "-u", "-c", code], env=env,
                             stdout=subprocess.PIPE, text=True)
     # kill mid-run: after step 15 the depth-3 window is full, the async
     # persister has fired ~7 times, and writebacks ride evictions
@@ -586,7 +589,7 @@ def test_kill_mid_pipelined_fit_resume_exact(tmp_path):
             break
         assert not line.startswith("FINISHED"), "child outran the kill"
     assert killed, "child died before step 15"
-    proc.wait()
+    assert proc.wait() == -9, "child was not killed mid-run"
 
     def make_parts(depth):
         mesh = create_mesh(2, 4, jax.devices()[:8])
@@ -633,6 +636,9 @@ def test_kill_mid_pipelined_fit_resume_exact(tmp_path):
     cache = tab_res.restore(os.path.join(pdir, "off:linear"))
     w = tab_res.persisted_work
     assert w in snaps and w >= 3, f"watermark {w} not a batch boundary"
+    # the kill landed MID-run: there must be committed-but-incomplete
+    # progress, i.e. real batches left for the resume to replay
+    assert w <= 20, f"watermark {w}: child finished before the kill"
     ref_weights, ref_slots, ref_params = snaps[w]
     np.testing.assert_array_equal(tab_res.host_weights, ref_weights)
     for k in ref_slots:
