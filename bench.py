@@ -354,6 +354,7 @@ def run_offload(name, config, *, steps, warmup):
             persist_rows = info["rows"]
         finally:
             shutil.rmtree(pdir, ignore_errors=True)
+        prep_sum = sum(prep_times)   # snapshot BEFORE the profile block
         if PROFILE_DIR:
             # traced block OUTSIDE the timed/persist measurements
             extra = [make_batch() for _ in range(10)]
@@ -378,7 +379,7 @@ def run_offload(name, config, *, steps, warmup):
             # host-prepare wall time per step (both tables, runs on the
             # lookahead thread): overlapped when step_ms ~= max(this,
             # device time) rather than their sum
-            "prepare_ms": round(1000 * sum(prep_times) / max(steps, 1), 3),
+            "prepare_ms": round(1000 * prep_sum / max(steps, 1), 3),
             "mode": "serial" if serial else f"pipelined_k{depth}",
             "host_store_gb": round(store_gb, 2),
             "cache_rows": cache,
@@ -1223,11 +1224,20 @@ def run_suite_isolated(names, steps, timeout_s=3600, profile=""):
                      "error": f"no JSON output (rc={proc.returncode}): "
                               f"{err[-300:]}"}
         except subprocess.TimeoutExpired:
-            hung = True
-            r = {"metric": name,
-                 "error": f"config exceeded {timeout_s}s; child left "
-                          "running (never kill a device-attached process "
-                          "mid-op)"}
+            if deviceless:
+                # a CPU child holds no device claim — safe to kill, and
+                # its hang must not erase the device matrix
+                proc.kill()
+                proc.wait()
+                r = {"metric": name,
+                     "error": f"CPU config exceeded {timeout_s}s; child "
+                              "killed (deviceless)"}
+            else:
+                hung = True
+                r = {"metric": name,
+                     "error": f"config exceeded {timeout_s}s; child left "
+                              "running (never kill a device-attached "
+                              "process mid-op)"}
         except json.JSONDecodeError as e:
             r = {"metric": name, "error": f"unparseable child output: {e}"}
         r.setdefault("ts", _utcnow())

@@ -58,7 +58,8 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
                    work_id: int, persisted_work: int,
                    host_weights: np.ndarray,
                    host_slots: Dict[str, np.ndarray],
-                   host_work_id: np.ndarray) -> Dict[str, Any]:
+                   host_work_id: np.ndarray,
+                   compress: str = "") -> Dict[str, Any]:
     """Shared base/delta checkpoint writer (both offload tiers).
 
     First call writes a base file with every row; later calls write only
@@ -93,20 +94,31 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
         chain = []
     else:
         stale = []
+    # compress="zlib" writes deflate npz members (np.savez_compressed);
+    # np.load reads both forms, so raw and compressed entries can share
+    # one chain and restore needs no changes (the message_compress knob
+    # applied to this plane's cold storage, client/EnvConfig.cpp:27-34).
+    # The npz container is deflate-ONLY — zstd is rejected rather than
+    # silently downgraded
+    from .utils import compress as compress_lib
+    if compress_lib.check(compress) == "zstd":
+        raise ValueError("the persist chain's npz container supports only "
+                         "'' or 'zlib' (deflate); use 'zlib' here")
+    savez = np.savez_compressed if compress else np.savez
     if not chain:
         fname = f"base_{work_id}.npz"
         with fs.open_atomic(fs.join(path, fname)) as f:
-            np.savez(f, ids=np.arange(vocab, dtype=np.int64),
-                     weights=host_weights, work_id=host_work_id,
-                     **{f"slot_{k}": v for k, v in host_slots.items()})
+            savez(f, ids=np.arange(vocab, dtype=np.int64),
+                  weights=host_weights, work_id=host_work_id,
+                  **{f"slot_{k}": v for k, v in host_slots.items()})
         changed = vocab
     else:
         ids = np.nonzero(host_work_id > persisted_work)[0].astype(np.int64)
         fname = f"inc_{work_id}.npz"
         with fs.open_atomic(fs.join(path, fname)) as f:
-            np.savez(f, ids=ids, weights=host_weights[ids],
-                     work_id=host_work_id[ids],
-                     **{f"slot_{k}": v[ids] for k, v in host_slots.items()})
+            savez(f, ids=ids, weights=host_weights[ids],
+                  work_id=host_work_id[ids],
+                  **{f"slot_{k}": v[ids] for k, v in host_slots.items()})
         changed = int(ids.size)
     chain.append({"file": fname, "work_id": work_id})
     # the commit point: before this rename readers see the old chain
@@ -390,6 +402,7 @@ class ShardedOffloadedTable:
                  occupancy_threshold: float = 0.7,
                  keep_fraction: float = 0.5,
                  backing_dir: Optional[str] = None,
+                 persist_compress: str = "",
                  seed: int = 0):
         from .parallel import sharded_hash as sh
         self.name = name
@@ -405,6 +418,15 @@ class ShardedOffloadedTable:
         self.persist_pending_window = persist_pending_window
         self.occupancy_threshold = occupancy_threshold
         self.keep_fraction = keep_fraction
+        from .utils import compress as compress_lib
+        # codec for the incremental persist chain (cold storage; deflate
+        # npz members — np.load reads raw and compressed chains alike).
+        # npz is deflate-only, so zstd is rejected here, not downgraded
+        if compress_lib.check(persist_compress) == "zstd":
+            raise ValueError(
+                "persist_compress supports only '' or 'zlib' (the npz "
+                "container is deflate-only)")
+        self.persist_compress = persist_compress or ""
         self.spec = sh.make_hash_sharding_spec(mesh, cache_capacity)
         dim = meta.embedding_dim
         dtype = np.dtype(table_lib.resolve_dtype(meta))
@@ -876,7 +898,8 @@ class ShardedOffloadedTable:
                 path, vocab=self.vocab, meta=self.meta, work_id=work,
                 persisted_work=persisted,
                 host_weights=self.host_weights, host_slots=self.host_slots,
-                host_work_id=self.host_work_id)
+                host_work_id=self.host_work_id,
+                compress=self.persist_compress)
 
         def _run():
             try:
@@ -885,7 +908,8 @@ class ShardedOffloadedTable:
                     persisted_work=persisted,
                     host_weights=self.host_weights,
                     host_slots=self.host_slots,
-                    host_work_id=self.host_work_id)
+                    host_work_id=self.host_work_id,
+                    compress=self.persist_compress)
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 self._persister_err = e
                 self.persisted_work = persisted

@@ -36,6 +36,17 @@ def _check(cls, field: str, pred: Callable[[Any], bool], msg: str):
     _CHECKS.setdefault(cls, {})[field] = (pred, msg)
 
 
+def _compress_ok(v) -> bool:
+    from . import compress as compress_lib
+    if v not in compress_lib.KNOWN:
+        return False            # _validate adds the field context
+    # a KNOWN codec whose binding is missing (zstd without zstandard)
+    # raises compress.check's specific, actionable message instead of the
+    # generic field error that would name zstd as acceptable
+    compress_lib.check(v)
+    return True
+
+
 def _validate(obj) -> None:
     for field, (pred, msg) in _CHECKS.get(type(obj), {}).items():
         v = getattr(obj, field)
@@ -72,6 +83,8 @@ class OffloadConfig:
     occupancy_threshold: float = 0.7
     persist_pending_window: int = 64
     keep_fraction: float = 0.5
+    # codec for the incremental persist chain ("", zlib, gated zstd)
+    persist_compress: str = ""
 
     def __post_init__(self):
         _validate(self)
@@ -88,6 +101,8 @@ _check(OffloadConfig, "persist_pending_window", lambda v: v > 0,
        "must be > 0")
 _check(OffloadConfig, "keep_fraction", lambda v: 0 <= v < 1,
        "must be in [0, 1)")
+_check(OffloadConfig, "persist_compress", _compress_ok,
+       "must be a known, available codec ('', 'zlib', 'zstd')")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,15 +126,6 @@ _check(ServingConfig, "port", lambda v: 0 <= v < 65536,
        "must be a port number (0 = ephemeral)")
 _check(ServingConfig, "replica_num", lambda v: v >= 1, "must be >= 1")
 _check(ServingConfig, "hash_capacity", lambda v: v > 0, "must be > 0")
-def _compress_ok(v) -> bool:
-    from . import compress as compress_lib
-    try:
-        compress_lib.check(v)   # known-codec list + zstd-binding gate
-    except ValueError:
-        return False            # _validate adds the field context
-    return True
-
-
 _check(ServingConfig, "message_compress", _compress_ok,
        "must be a known, available codec ('', 'zlib', 'zstd')")
 

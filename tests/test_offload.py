@@ -658,3 +658,86 @@ def test_kill_mid_pipelined_fit_resume_exact(tmp_path):
     for k in tab_ref.host_slots:
         np.testing.assert_array_equal(tab_res.host_slots[k],
                                       tab_ref.host_slots[k])
+
+
+def test_hand_driven_prefetch_matches_fit(devices8):
+    """The PUBLIC prefetch API (the bench's hand-driven pattern:
+    ``prefetch(window); train_step(batch)``) is the same pipeline fit
+    wires — bit-identical results."""
+    inst = TestPipelinedOffload()
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    batches = inst._batches(8, seed=9)
+
+    t_fit, tab_fit, _ = inst._trainer(mesh, depth=2)
+    s = t_fit.init(jax.random.PRNGKey(0), t_fit.shard_batch(batches[0]))
+    s, _ = t_fit.fit(s, batches)
+    tab_fit.flush(s.emb["off"]); tab_fit._join_writeback()
+
+    t_hand, tab_hand, _ = inst._trainer(mesh, depth=2)
+    s2 = t_hand.init(jax.random.PRNGKey(0), t_hand.shard_batch(batches[0]))
+    for i in range(len(batches)):
+        t_hand.prefetch(batches[i:i + 3])
+        s2, _ = t_hand.train_step(s2, batches[i])
+    tab_hand.finish()
+    tab_hand.flush(s2.emb["off"]); tab_hand._join_writeback()
+    np.testing.assert_array_equal(tab_fit.host_weights,
+                                  tab_hand.host_weights)
+
+
+def test_persist_compress_chain(tmp_path, devices8):
+    """A zlib persist chain restores identically to a raw one, raw and
+    compressed entries can share a chain, and the compressed files are
+    smaller on compressible (constant-init) stores."""
+    import os
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+
+    def mk(compress):
+        return ShardedOffloadedTable(
+            "t", EmbeddingVariableMeta(embedding_dim=4,
+                                       vocabulary_size=512),
+            {"category": "sgd", "learning_rate": 1.0},
+            {"category": "constant", "value": 0.5},
+            vocab=512, cache_capacity=128, mesh=mesh,
+            persist_compress=compress)
+
+    stores = {}
+    for codec in ("", "zlib"):
+        t = mk(codec)
+        c = t.create_cache()
+        ids = np.arange(0, 40, dtype=np.int32)
+        c = t.prepare(c, ids)
+        t.note_update(ids)
+        c2 = t.prepare(c, np.arange(40, 60, dtype=np.int32))
+        t.note_update(np.arange(40, 60, dtype=np.int32))
+        d = str(tmp_path / f"chain{codec}")
+        t.persist(c2, d)                       # base
+        ids3 = np.arange(60, 70, dtype=np.int32)
+        c3 = t.prepare(c2, ids3)
+        t.note_update(ids3)
+        t.persist(c3, d)                       # delta
+        stores[codec] = d
+
+    # compressed chain is materially smaller (constant-init rows)
+    size = {c: sum(os.path.getsize(os.path.join(d, f))
+                   for f in os.listdir(d)) for c, d in stores.items()}
+    assert size["zlib"] < size[""] * 0.5, size
+
+    r_raw, r_z = mk(""), mk("")
+    r_raw.restore(stores[""])
+    r_z.restore(stores["zlib"])
+    np.testing.assert_array_equal(r_raw.host_weights, r_z.host_weights)
+    assert r_raw.persisted_work == r_z.persisted_work
+
+    # mixed chain: a raw table appends a raw delta onto the zlib chain
+    t2 = mk("")
+    c = t2.restore(stores["zlib"])
+    ids4 = np.arange(70, 80, dtype=np.int32)
+    c = t2.prepare(c, ids4)
+    t2.note_update(ids4)
+    t2.persist(c, stores["zlib"])
+    t3 = mk("zlib")
+    t3.restore(stores["zlib"])
+    assert t3.persisted_work == t2.work_id
