@@ -486,6 +486,9 @@ class ShardedOffloadedTable:
         # the apply's planned->resident transfer and eviction's rebuild
         self._book = threading.RLock()
         self.evictions = 0  # lifetime LRU-eviction count (observability)
+        # prepares/applies redone because an eviction rebuilt residency
+        # under them (the generation protocol's retry paths)
+        self.gen_retries = 0
         self._dirty = np.zeros(self.vocab, bool)
         self._last_touch = np.zeros(self.vocab, np.int64)
         self.work_id = 1
@@ -688,6 +691,7 @@ class ShardedOffloadedTable:
             rows, srows = self._gather_host(missing)
             with self._book:
                 if self._gen != gen:
+                    self.gen_retries += 1
                     continue  # evicted under the gather; recompute
                 # mark AFTER the gather succeeded — a failed prepare
                 # leaks nothing
@@ -728,6 +732,7 @@ class ShardedOffloadedTable:
                 self._gen += 1
                 self._planned[:] = False
                 self._planned_count = 0
+                self.gen_retries += 1
                 return self.apply_prepared(cache,
                                            self.host_prepare(prep.uniq))
         # join FIRST: the caller's next jitted step may donate (delete) the

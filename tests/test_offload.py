@@ -741,3 +741,144 @@ def test_persist_compress_chain(tmp_path, devices8):
     t3 = mk("zlib")
     t3.restore(stores["zlib"])
     assert t3.persisted_work == t2.work_id
+
+
+def test_pipeline_parity_under_timing_fuzz(devices8):
+    """Randomized host-gather delays shift every prepare/apply/evict
+    interleaving; results must stay bit-identical to serial regardless
+    (the planned-residency books + generation protocol, not luck, carry
+    the correctness). Small cache so evictions and stale-generation
+    recomputes fire mid-window."""
+    import time as time_mod
+    inst = TestPipelinedOffload()
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    batches = inst._batches(12, seed=13)
+
+    t_ser, tab_ser, lin_ser = inst._trainer(mesh, cache=256)
+    s = t_ser.init(jax.random.PRNGKey(0), t_ser.shard_batch(batches[0]))
+    for b in batches:
+        s, _ = t_ser.train_step(s, b)
+    tab_ser.flush(s.emb["off"]); tab_ser._join_writeback()
+    lin_ser.flush(s.emb["off:linear"]); lin_ser._join_writeback()
+
+    fuzz = np.random.RandomState(99)
+    t_f, tab_f, lin_f = inst._trainer(mesh, cache=256, depth=4)
+    for t in (tab_f, lin_f):
+        orig = t._gather_host
+
+        def jittery(ids, _orig=orig):
+            time_mod.sleep(float(fuzz.uniform(0, 0.03)))
+            return _orig(ids)
+
+        t._gather_host = jittery
+    s2 = t_f.init(jax.random.PRNGKey(0), t_f.shard_batch(batches[0]))
+    s2, _ = t_f.fit(s2, batches)
+    tab_f.flush(s2.emb["off"]); tab_f._join_writeback()
+    lin_f.flush(s2.emb["off:linear"]); lin_f._join_writeback()
+    assert tab_f.evictions > 0
+    # NOTE: the generation-RETRY paths rarely fire here — the budget check
+    # runs against resident+planned, so once the window overflows, later
+    # prepares degrade to needs_evict instead of gathering at a soon-stale
+    # generation. The deterministic tests below force those paths.
+    np.testing.assert_array_equal(tab_ser.host_weights, tab_f.host_weights)
+    np.testing.assert_array_equal(lin_ser.host_weights, lin_f.host_weights)
+
+
+def _mk_sharded(mesh, vocab=2048, cache=256):
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    return ShardedOffloadedTable(
+        "t", EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=vocab),
+        {"category": "sgd", "learning_rate": 1.0},
+        {"category": "constant", "value": 0.25},
+        vocab=vocab, cache_capacity=cache, mesh=mesh)
+
+
+def test_stale_prepare_recomputed_at_apply(devices8):
+    """A prepare computed before an eviction must be RECOMPUTED at its
+    apply (generation mismatch), in batch-order priority over any
+    lookahead claims — applying it verbatim would insert rows the
+    rebuild dropped and resurrect pre-eviction host values."""
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    t = _mk_sharded(mesh)
+    cache = t.create_cache()
+    # in-budget prepare at generation 0 (planned marks set)
+    ids_a = np.arange(0, 50, dtype=np.int32)
+    prep_a = t.host_prepare(ids_a)
+    assert not prep_a.needs_evict and prep_a.gen == 0
+    # a second prepare overflows the budget -> needs_evict; applying it
+    # FIRST (out of order, table-level API permits it) rebuilds the cache
+    ids_b = np.arange(100, 100 + 160, dtype=np.int32)
+    prep_b = t.host_prepare(ids_b)
+    assert prep_b.needs_evict
+    cache = t.apply_prepared(cache, prep_b)
+    assert t.evictions == 1 and t._gen == 1
+    # prep_a is now stale: its apply must take the recompute path
+    cache = t.apply_prepared(cache, prep_a)
+    assert t.gen_retries >= 1
+    assert bool(t._resident[ids_a].all())
+    # values: cache rows for ids_a equal host rows (insert really landed)
+    from openembedding_tpu.parallel import sharded_hash as sh
+    got = np.asarray(sh.pull_sharded(cache, jnp.asarray(ids_a), None,
+                                     mesh=mesh, spec=t.spec,
+                                     batch_sharded=False))
+    np.testing.assert_array_equal(got, t.host_weights[ids_a])
+
+
+def test_gather_retry_when_evicted_mid_gather(devices8):
+    """An eviction landing while a lookahead gather is in flight must
+    force that host_prepare to retry at the new generation (the torn
+    read would otherwise mark planned rows against dropped residency)."""
+    import threading
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    t = _mk_sharded(mesh)
+    cache = t.create_cache()
+    # sized against budget 0.7*256=179 with keep_fraction 0.5 (keep 89):
+    # 135 warm + 40 prep = 175 fits (the prep GATHERS, parking in the
+    # patch); 135 + 45 big = 180 overflows (big evicts); post-evict
+    # 89 kept + 45 + 40 retried = 174 fits (the retry lands in-budget)
+    warm = np.arange(0, 135, dtype=np.int32)
+    cache = t.prepare(cache, warm)
+    t.note_update(warm)
+
+    in_gather = threading.Event()
+    release = threading.Event()
+    orig = t._gather_host
+    fired = []
+
+    def blocking_gather(ids):
+        if not fired:
+            fired.append(True)
+            in_gather.set()
+            release.wait(timeout=30)
+        return orig(ids)
+
+    t._gather_host = blocking_gather
+    out = {}
+
+    def prep_thread():
+        out["prep"] = t.host_prepare(np.arange(200, 240, dtype=np.int32))
+
+    th = threading.Thread(target=prep_thread)
+    th.start()
+    assert in_gather.wait(timeout=30)
+    # eviction on the main thread while the gather is parked
+    big = t.host_prepare(np.arange(300, 345, dtype=np.int32))
+    assert big.needs_evict
+    ev = threading.Thread(target=lambda: out.update(
+        cache2=t.apply_prepared(cache, big)))
+    ev.start()
+    import time as time_mod
+    time_mod.sleep(0.3)   # let the evict reach (and block on) the book
+    release.set()
+    th.join(timeout=60); ev.join(timeout=60)
+    assert not th.is_alive() and not ev.is_alive()
+    prep = out["prep"]
+    # the parked gather's generation went stale; the retry recomputed at
+    # the post-eviction generation
+    assert t.gen_retries >= 1
+    assert prep.gen == t._gen and not prep.needs_evict
+    t.cancel_prepared(prep)
+    assert t._planned_count == 0
