@@ -56,6 +56,11 @@ def parse_args(argv=None):
     p.add_argument("--data_parallel", type=int, default=1,
                    help="mesh data-axis size")
     p.add_argument("--save", default="", help="checkpoint dir to write")
+    p.add_argument("--save_compress", default="",
+                   help="checkpoint block codec: '' | zlib | zstd-if-"
+                        "installed (framed .npyz streams; Python loads "
+                        "read them transparently — keep '' for dumps the "
+                        "native mmap library serves)")
     p.add_argument("--load", default="", help="checkpoint dir to read")
     p.add_argument("--log_every", type=int, default=20)
     p.add_argument("--config", default="",
@@ -66,6 +71,9 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    from openembedding_tpu.utils import compress as compress_lib
+    compress_lib.check(args.save_compress)  # typo'd codec must fail NOW,
+                                            # not after the training run
 
     import jax
     import optax
@@ -224,7 +232,8 @@ def main(argv=None):
                 dense_state={"params": state.params,
                              "opt_state": state.opt_state,
                              "step": state.step},
-                model_sign=trainer.model_sign(state))
+                model_sign=trainer.model_sign(state),
+                compress=args.save_compress)
         print(f"saved checkpoint to {args.save}")
     if reporter is not None:
         reporter.report()

@@ -32,7 +32,15 @@ def main(argv=None):
                         "1/G slice of every table)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--lookups", type=int, default=5)
+    p.add_argument("--compress", default="",
+                   help="binary data-plane codec ('' | zlib): replicas "
+                        "compress lookup responses for advertising "
+                        "clients and peer-restore row pages (the "
+                        "reference's server.message_compress)")
     args = p.parse_args(argv)
+    from openembedding_tpu.utils import compress as compress_lib
+    compress_lib.check(args.compress)   # fail at parse time, not after
+                                        # replicas spawn + 300s waits
 
     import numpy as np
     import jax
@@ -87,7 +95,8 @@ def main(argv=None):
             for pt in row:
                 procs.append(ha.spawn_replica(
                     pt, load=[f"{sign}={model_dir}"],
-                    shard_index=k, shard_count=args.shards))
+                    shard_index=k, shard_count=args.shards,
+                    compress=args.compress))
         for i, ep in enumerate(ep for row in eps for ep in row):
             if not ha.wait_ready(ep, sign=sign, timeout=300.0):
                 pr = procs[i]
@@ -103,17 +112,20 @@ def main(argv=None):
     else:
         ports = [free_port() for _ in range(args.replicas)]
         flat_eps = eps = [f"127.0.0.1:{pt}" for pt in ports]
-        procs = [ha.spawn_replica(ports[0], load=[f"{sign}={model_dir}"])]
+        procs = [ha.spawn_replica(ports[0], load=[f"{sign}={model_dir}"],
+                                  compress=args.compress)]
         assert ha.wait_ready(eps[0], sign=sign, timeout=300.0), "first replica failed"
         for pt in ports[1:]:
-            procs.append(ha.spawn_replica(pt, peers=[eps[0]]))
+            procs.append(ha.spawn_replica(pt, peers=[eps[0]],
+                                          compress=args.compress))
         for ep in eps[1:]:
             assert ha.wait_ready(ep, sign=sign, timeout=300.0), f"replica {ep} failed"
         print(f"cluster up: {eps}")
 
     try:
-        router = (ha.ShardedRoutingClient(eps) if args.shards > 1
-                  else ha.RoutingClient(eps))
+        router = (ha.ShardedRoutingClient(eps, compress=args.compress)
+                  if args.shards > 1
+                  else ha.RoutingClient(eps, compress=args.compress))
         for n in router.nodes():
             print(f"  node {n['endpoint']}: alive={n['alive']} "
                   f"models={n['models']}")

@@ -535,10 +535,11 @@ class ShardedRoutingClient:
     """
 
     def __init__(self, groups: Sequence[Sequence[str]],
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, compress: str = ""):
         if not groups or any(not g for g in groups):
             raise ValueError("need >= 1 replica endpoint per shard group")
-        self.groups = [RoutingClient(list(g), timeout=timeout)
+        self.groups = [RoutingClient(list(g), timeout=timeout,
+                                     compress=compress)
                        for g in groups]
 
     @property

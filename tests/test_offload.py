@@ -870,8 +870,14 @@ def test_gather_retry_when_evicted_mid_gather(devices8):
     ev = threading.Thread(target=lambda: out.update(
         cache2=t.apply_prepared(cache, big)))
     ev.start()
+    # the evictor never needs the parked gather (the prep thread holds no
+    # lock while parked), so it can run to completion first — POLL for
+    # the generation bump instead of racing a sleep against JIT/IO time
     import time as time_mod
-    time_mod.sleep(0.3)   # let the evict reach (and block on) the book
+    deadline = time_mod.time() + 60
+    while t._gen == 0 and time_mod.time() < deadline:
+        time_mod.sleep(0.01)
+    assert t._gen == 1, "eviction did not complete"
     release.set()
     th.join(timeout=60); ev.join(timeout=60)
     assert not th.is_alive() and not ev.is_alive()
