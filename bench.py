@@ -565,8 +565,11 @@ def _derived_criteo(rows: int, seed: int = 7, noise: float = 0.8) -> str:
     generalization. Deterministic, so the cached file is reusable."""
     import os
     out = f"/tmp/oe_bench_criteo_{rows}_s{seed}_n{noise}.csv"
-    if not (os.path.exists(out)
-            and sum(1 for _ in open(out)) == rows + 1):
+    def _rows_on_disk():
+        with open(out) as f:
+            return sum(1 for _ in f)
+
+    if not (os.path.exists(out) and _rows_on_disk() == rows + 1):
         from openembedding_tpu.data import preprocess
         # default noise 0.8: measured operating point at the full 140k
         # rows x 3 epochs — 0.6 saturates there (eval AUC 0.98); 0.8
@@ -1052,11 +1055,16 @@ RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
            "plane_parity": run_plane_parity}
 
 
-def _device_watchdog(timeout_s: int = 300) -> None:
+def _device_watchdog(timeout_s: int = 300, on_fail: str = "exit"):
     """Bound backend init: a wedged TPU tunnel hangs ``jax.devices()``
     forever inside native code, which would make the bench (and any driver
     timing out on it) produce nothing. Probe from a thread; on timeout,
-    emit one honest JSON error line and hard-exit."""
+    emit one honest JSON error line and hard-exit — or, with
+    ``on_fail="return"``, hand back (ok, reason) so the caller can print
+    a fallback first (it must still ``os._exit``: the hung probe thread
+    is parked in native code and would block interpreter teardown). A
+    SUCCESSFUL probe leaves the backend initialized in-process, so the
+    caller pays no second init."""
     import os
     import threading
     done = threading.Event()
@@ -1076,10 +1084,13 @@ def _device_watchdog(timeout_s: int = 300) -> None:
         reason = err[0] if err else (
             f"backend init exceeded {timeout_s}s — device tunnel "
             "unhealthy; no measurements possible")
+        if on_fail == "return":
+            return False, reason
         print(json.dumps({
             "metric": "device_init_failed", "value": 0.0, "unit": "error",
             "vs_baseline": 0.0, "error": reason}), flush=True)
         os._exit(1)
+    return True, ""
 
 
 def _probe_device_child(timeout_s=300):
@@ -1262,7 +1273,10 @@ def _headline_from_suite(max_age_h: float = 11.0):
     except (OSError, json.JSONDecodeError):
         return None
     for r in suite:
-        if r.get("metric") == HEADLINE and "error" not in r \
+        # a healthy headline entry is named
+        # "<HEADLINE>_examples_per_sec_<platform><n>" (run_config)
+        if str(r.get("metric", "")).startswith(HEADLINE) \
+                and r.get("unit") == "examples/s" and "error" not in r \
                 and "ts" in r and r.get("value"):
             try:
                 ts = datetime.datetime.fromisoformat(r["ts"])
@@ -1359,11 +1373,13 @@ def main(argv=None):
         return 1 if any("error" in r for r in results) else 0
 
     if not args.configs:
-        # headline mode (the driver's end-of-round invocation): a wedged
-        # tunnel at report time must not erase a measurement captured
-        # earlier in the round — fall back to this round's suite entry,
-        # clearly labeled with its capture timestamp.
-        ok, note = _probe_device_child(args.probe_timeout)
+        # headline mode (the driver's end-of-round invocation): ONE
+        # in-process bounded init — on success the backend is live (no
+        # second init); a wedged tunnel at report time must not erase a
+        # measurement captured earlier in the round, so fall back to
+        # this round's suite entry, clearly labeled with its timestamp.
+        import os
+        ok, note = _device_watchdog(args.probe_timeout, on_fail="return")
         if not ok:
             fallback = _headline_from_suite()
             if fallback is not None:
@@ -1374,16 +1390,16 @@ def main(argv=None):
                                     + " — per-attempt probe log in "
                                       "bench_attempts.json")
                 print(json.dumps(fallback), flush=True)
-                return 0
+                os._exit(0)   # a probe thread is parked in native init
             print(json.dumps({
                 "metric": "device_init_failed", "value": 0.0,
                 "unit": "error", "vs_baseline": 0.0,
                 "error": f"tunnel unhealthy ({note}) and no suite "
                          "measurement exists to fall back on"}),
                 flush=True)
-            return 1
-
-    _device_watchdog()
+            os._exit(1)
+    else:
+        _device_watchdog()
     import jax
     platform = jax.devices()[0].platform
     steps = args.steps or (60 if platform != "cpu" else 5)

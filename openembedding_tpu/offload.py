@@ -97,14 +97,10 @@ def _persist_store(path: str, *, vocab: int, meta: EmbeddingVariableMeta,
     # compress="zlib" writes deflate npz members (np.savez_compressed);
     # np.load reads both forms, so raw and compressed entries can share
     # one chain and restore needs no changes (the message_compress knob
-    # applied to this plane's cold storage, client/EnvConfig.cpp:27-34).
-    # The npz container is deflate-ONLY — zstd is rejected rather than
-    # silently downgraded
+    # applied to this plane's cold storage, client/EnvConfig.cpp:27-34)
     from .utils import compress as compress_lib
-    if compress_lib.check(compress) == "zstd":
-        raise ValueError("the persist chain's npz container supports only "
-                         "'' or 'zlib' (deflate); use 'zlib' here")
-    savez = np.savez_compressed if compress else np.savez
+    savez = np.savez_compressed \
+        if compress_lib.check_persist_codec(compress) else np.savez
     if not chain:
         fname = f"base_{work_id}.npz"
         with fs.open_atomic(fs.join(path, fname)) as f:
@@ -420,13 +416,9 @@ class ShardedOffloadedTable:
         self.keep_fraction = keep_fraction
         from .utils import compress as compress_lib
         # codec for the incremental persist chain (cold storage; deflate
-        # npz members — np.load reads raw and compressed chains alike).
-        # npz is deflate-only, so zstd is rejected here, not downgraded
-        if compress_lib.check(persist_compress) == "zstd":
-            raise ValueError(
-                "persist_compress supports only '' or 'zlib' (the npz "
-                "container is deflate-only)")
-        self.persist_compress = persist_compress or ""
+        # npz members — np.load reads raw and compressed chains alike)
+        self.persist_compress = compress_lib.check_persist_codec(
+            persist_compress)
         self.spec = sh.make_hash_sharding_spec(mesh, cache_capacity)
         dim = meta.embedding_dim
         dtype = np.dtype(table_lib.resolve_dtype(meta))
@@ -717,7 +709,11 @@ class ShardedOffloadedTable:
         prepare and apply rebuilt the cache). Returns the updated cache
         state."""
         with self._book:
-            stale = prep.gen != self._gen and not prep.needs_evict
+            # needs_evict prepares are NOT exempt: after the first evict
+            # of an overflow episode, the rest of the lookahead window's
+            # evict-verdicts are stale too — recomputing gives them a
+            # fresh budget check instead of K-1 redundant full rebuilds
+            stale = prep.gen != self._gen
             if stale:
                 # Residency was rebuilt under this prepare (eviction/
                 # restore bumped the generation): recompute — same uniq,

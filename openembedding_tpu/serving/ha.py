@@ -256,9 +256,10 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
             return fetch_rows_page(endpoint, sign, vname, off, page,
                                    timeout, compress=codec)
         except urllib.error.HTTPError as e:
-            if codec and e.code == 404:
-                # pre-upgrade peer: its /rows route has no compress
-                # parameter — downgrade to raw pages for this restore
+            if codec and e.code in (400, 404):
+                # 404: pre-upgrade peer (its /rows route has no compress
+                # parameter); 400: the peer knows the parameter but not
+                # this codec — either way, raw pages restore fine
                 codec = ""
                 return fetch_rows_page(endpoint, sign, vname, off, page,
                                        timeout)
@@ -474,8 +475,12 @@ class RoutingClient:
         JSON list marshalling (the reference's zero-copy RpcView role,
         server/RpcView.h). The request header carries the index SHAPE, so
         wide [n, 2] pair queries and multi-dim batch shapes reconstruct
-        exactly server-side. When the client was built with a ``compress``
-        codec it is ADVERTISED here (``accept_compress``); a server
+        exactly server-side. NOTE the wide-spec shape carve-out
+        (registry.ServingModel.lookup): on a WIDE spec any trailing dim
+        of 2 is a pair axis — send a genuine narrow length-2 sequence as
+        ``[B, L, 2]`` pairs or pad it to L != 2. When the client was
+        built with a ``compress`` codec it is ADVERTISED here
+        (``accept_compress``); a server
         configured with the same ``message_compress`` codec compresses the
         row payload (the reference's compressed pull responses,
         EmbeddingPullOperator.cpp:149-205). Same failover rotation as

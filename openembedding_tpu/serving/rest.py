@@ -168,6 +168,11 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 self._send(404, {"error": "not found"})
             except KeyError as e:
                 self._send(404, {"error": str(e)})
+            except ValueError as e:
+                # e.g. an unknown/unavailable &compress= codec — a CLIENT
+                # error (the peer-restore fetch downgrades on it), not a
+                # replica fault
+                self._send(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001
                 self._send(500, {"error": str(e)})
 

@@ -301,11 +301,30 @@ class Trainer:
             self._cancel_preps()
         emb = dict(state.emb)
         uniqs: Dict[str, Any] = {}
-        for name, table in self.offload.items():
+        names = list(self.offload)
+        for i, name in enumerate(names):
+            table = self.offload[name]
             prep = prepped.get(name) if prepped is not None else None
             if prep is None:
                 prep = table.host_prepare(batch["sparse"][name])
-            emb[name] = table.apply_prepared(emb[name], prep)
+            try:
+                emb[name] = table.apply_prepared(emb[name], prep)
+            except BaseException:
+                # release the NOT-YET-APPLIED preps of this entry (the
+                # raiser's own marks were restored by its unwind or were
+                # never transferred) plus the lookahead window — a caller
+                # that survives the error must not inherit leaked planned
+                # marks that would degrade every later prepare to the
+                # evict path. Applied tables' preps are NOT cancelled
+                # (their marks were already transferred to resident).
+                table.cancel_prepared(prep)
+                if prepped is not None:
+                    for later in names[i + 1:]:
+                        lp = prepped.get(later)
+                        if lp is not None:
+                            self.offload[later].cancel_prepared(lp)
+                self._cancel_preps()
+                raise
             uniqs[name] = prep.uniq
         return state.replace(emb=emb), uniqs
 

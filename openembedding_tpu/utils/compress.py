@@ -49,6 +49,17 @@ def check(codec: str) -> str:
     return codec
 
 
+def check_persist_codec(codec: str) -> str:
+    """Validate a codec for the offload persist chain: its npz container
+    is deflate-only, so zstd is rejected here (loudly, at config/construct
+    time) rather than silently downgraded."""
+    codec = check(codec)
+    if codec == "zstd":
+        raise ValueError("the persist chain's npz container supports only "
+                         "'' or 'zlib' (deflate); use 'zlib' here")
+    return codec
+
+
 def compress(codec: str, data: bytes) -> bytes:
     if not codec:
         return bytes(data)

@@ -101,8 +101,9 @@ _check(OffloadConfig, "persist_pending_window", lambda v: v > 0,
        "must be > 0")
 _check(OffloadConfig, "keep_fraction", lambda v: 0 <= v < 1,
        "must be in [0, 1)")
-_check(OffloadConfig, "persist_compress", _compress_ok,
-       "must be a known, available codec ('', 'zlib', 'zstd')")
+_check(OffloadConfig, "persist_compress", lambda v: v in ("", "zlib"),
+       "must be '' or 'zlib' (the persist chain's npz container is "
+       "deflate-only)")
 
 
 @dataclasses.dataclass(frozen=True)
