@@ -90,6 +90,22 @@ def emb_bytes_per_step(config, batch):
     return gather + update
 
 
+def _hbm_stats():
+    """Device-memory context for a measurement (bytes in use / limit),
+    when the backend exposes it. Localizes OOM-adjacent regressions."""
+    try:
+        import jax
+        st = jax.local_devices()[0].memory_stats() or {}
+        out = {}
+        if "bytes_in_use" in st:
+            out["hbm_in_use_gb"] = round(st["bytes_in_use"] / 1e9, 2)
+        if "bytes_limit" in st:
+            out["hbm_limit_gb"] = round(st["bytes_limit"] / 1e9, 2)
+        return out
+    except Exception:  # noqa: BLE001 — context, never a failure source
+        return {}
+
+
 def _median(xs):
     xs = sorted(xs)
     return xs[len(xs) // 2]
@@ -200,6 +216,7 @@ def run_config(name, config, *, steps, warmup, repeats=5):
         "emb_gbps": round(emb_bytes_per_step(config, batch)
                           / dt_step / 1e9, 2),
         **stage,
+        **_hbm_stats(),
         "config": dict(config),
     }
     if config.get("checkpoint"):
@@ -387,6 +404,7 @@ def run_offload(name, config, *, steps, warmup):
             "alloc_s": round(alloc_s, 1),
             "persist_s": round(persist_s, 2),
             "persist_rows": persist_rows,
+            **_hbm_stats(),
             "config": dict(config),
         }
     finally:
@@ -1274,8 +1292,11 @@ def _headline_from_suite(max_age_h: float = 11.0):
         return None
     for r in suite:
         # a healthy headline entry is named
-        # "<HEADLINE>_examples_per_sec_<platform><n>" (run_config)
-        if str(r.get("metric", "")).startswith(HEADLINE) \
+        # "<HEADLINE>_examples_per_sec_<platform><n>" (run_config);
+        # the full prefix keeps sibling configs (deepfm_dim9_zipf_*,
+        # _hash*, _per_feature) from masquerading as the headline
+        if str(r.get("metric", "")).startswith(
+                HEADLINE + "_examples_per_sec_") \
                 and r.get("unit") == "examples/s" and "error" not in r \
                 and "ts" in r and r.get("value"):
             try:
@@ -1364,7 +1385,12 @@ def main(argv=None):
                               "unit": "configs", "vs_baseline": 0.0}),
                   flush=True)
             return 1
-        results = run_suite_isolated(list(CONFIGS), args.steps,
+        # device configs FIRST: if the chip wedges mid-suite, the
+        # throughput matrix is already captured — the deviceless tail is
+        # immune to the wedge by construction
+        ordered = [n for n in CONFIGS if n not in DEVICELESS] \
+            + [n for n in CONFIGS if n in DEVICELESS]
+        results = run_suite_isolated(ordered, args.steps,
                                      args.timeout, profile=args.profile)
         out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "bench_suite.json")

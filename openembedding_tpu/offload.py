@@ -729,8 +729,15 @@ class ShardedOffloadedTable:
                 self._planned[:] = False
                 self._planned_count = 0
                 self.gen_retries += 1
-                return self.apply_prepared(cache,
-                                           self.host_prepare(prep.uniq))
+                inner = self.host_prepare(prep.uniq)
+                try:
+                    return self.apply_prepared(cache, inner)
+                except BaseException:
+                    # the INNER prep holds the live planned marks (the
+                    # caller only knows the stale outer prep, whose
+                    # cancel is a no-op at the old generation)
+                    self.cancel_prepared(inner)
+                    raise
         # join FIRST: the caller's next jitted step may donate (delete) the
         # very cache buffers an in-flight async flush is still reading
         self._join_writeback()

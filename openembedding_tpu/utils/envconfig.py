@@ -101,7 +101,16 @@ _check(OffloadConfig, "persist_pending_window", lambda v: v > 0,
        "must be > 0")
 _check(OffloadConfig, "keep_fraction", lambda v: 0 <= v < 1,
        "must be in [0, 1)")
-_check(OffloadConfig, "persist_compress", lambda v: v in ("", "zlib"),
+def _persist_codec_ok(v) -> bool:
+    from . import compress as compress_lib
+    try:
+        compress_lib.check_persist_codec(v)   # the one owner of the rule
+    except ValueError:
+        return False
+    return True
+
+
+_check(OffloadConfig, "persist_compress", _persist_codec_ok,
        "must be '' or 'zlib' (the persist chain's npz container is "
        "deflate-only)")
 
