@@ -490,10 +490,10 @@ class ShardedOffloadedTable:
         self._writer_err: Optional[BaseException] = None
         self._persister: Optional[threading.Thread] = None
         self._persister_err: Optional[BaseException] = None
-        # deferred insert_failures readbacks (oldest first): each blocking
-        # read costs a device round trip (tens of ms over a tunneled
-        # link), so the pipeline only drains past OVERFLOW_CHECK_DEPTH
-        self._overflow_pending: list = []
+        # latest cumulative insert_failures copy; read ONLY at join
+        # points (every device read is a synchronous round trip — tens
+        # to ~105 ms over a tunneled link, see check_overflow)
+        self._overflow_latest = None
 
     # --- spec / state creation ---------------------------------------------
     def embedding_spec(self, **kw) -> EmbeddingSpec:
@@ -613,34 +613,42 @@ class ShardedOffloadedTable:
             cache = sh.insert_rows_sharded(
                 cache, jnp.asarray(ck), jnp.asarray(cw), srows,
                 mesh=self.mesh, spec=self.spec)
-        # DEFER the overflow readback: a blocking device_get here would
-        # stall the host until the device caught up — the per-step sync
-        # that serialized the whole tier (r3's 466 ms steps). The counter
-        # is copied into an INDEPENDENT buffer (the jitted step donates
-        # the cache pytree, deleting its buffers) and checked a few steps
-        # later at a join point.
-        self._overflow_pending.append(cache.insert_failures + jnp.int32(0))
+        # DEFER the overflow readback: ``insert_failures`` is CUMULATIVE
+        # (hash_table.py:494, psum-merged across shards,
+        # sharded_hash.py:214), so the latest copy subsumes every earlier
+        # one — keep exactly one independent buffer (the jitted step
+        # donates the cache pytree, deleting its buffers) and read it
+        # ONLY at join points (flush/persist/restore/finish). Any
+        # per-step read — even of a counter copied steps earlier, even
+        # with ``copy_to_host_async`` primed — costs a synchronous device
+        # round trip (~105 ms on a degraded tunnel link); one per table
+        # per step is what serialized the tier in rounds 3-5
+        # (r3's 466 ms and r5's 242 ms offload steps,
+        # tools/offload_diag*.py chase the same stall twice).
+        self._overflow_latest = cache.insert_failures + jnp.int32(0)
         return cache
 
-    OVERFLOW_CHECK_DEPTH = 8
-
     def check_overflow(self, *, drain: bool = True) -> None:
-        """Read deferred insert-overflow counters; raises if any cache
-        insert ever overflowed. ``drain=False`` (the per-step pipeline
-        call) only reads counters older than ``OVERFLOW_CHECK_DEPTH``
-        steps — each read is a device round trip (tens of ms over a
-        tunneled link), so the steady-state pipeline pays one ONLY when
-        it is K steps ahead, and overflow detection lags by at most K
-        batches. Join points (flush/persist/restore/finish) drain fully."""
-        limit = 0 if drain else self.OVERFLOW_CHECK_DEPTH
-        while len(self._overflow_pending) > limit:
-            v = self._overflow_pending.pop(0)
-            if int(jax.device_get(v)) > 0:
-                self._overflow_pending.clear()
-                raise RuntimeError(
-                    f"offloaded table {self.name!r}: HBM cache insert "
-                    "overflow — raise cache_capacity or lower "
-                    "occupancy_threshold")
+        """Check the cache's cumulative insert-overflow counter; raises
+        if any insert since creation ever overflowed a probe window.
+
+        ``drain=False`` is the per-step pipeline call and is FREE: it
+        reads nothing (every device read is a synchronous round trip —
+        ~105 ms over a degraded tunnel link — and one per table per step
+        serialized the whole tier, tools/offload_diag7.py). Detection
+        happens at join points (``flush``/``persist``/``restore``/
+        ``finish``, ``drain=True``), which read the latest cumulative
+        counter once — ``fit(persist_dir=...)`` reaches one every
+        ``persist_pending_window`` batches, and hand-driven loops at
+        ``finish()``."""
+        if not drain or self._overflow_latest is None:
+            return
+        v, self._overflow_latest = self._overflow_latest, None
+        if int(jax.device_get(v)) > 0:
+            raise RuntimeError(
+                f"offloaded table {self.name!r}: HBM cache insert "
+                "overflow — raise cache_capacity or lower "
+                "occupancy_threshold")
 
     def _insert_from_host(self, cache, ids: np.ndarray):
         rows, srows = self._gather_host(ids)
@@ -660,9 +668,10 @@ class ShardedOffloadedTable:
         :meth:`cancel_prepared` (cancel ALL outstanding ones together —
         later prepares assume earlier ones will insert their rows).
         NOTE the pipeline's detection lag: a prepared insert that
-        overflows a cache shard surfaces up to ``OVERFLOW_CHECK_DEPTH``
-        batches later (see :meth:`check_overflow`); ``flush``/``persist``/
-        ``finish`` drain the window.
+        overflows a cache shard surfaces at the next JOIN POINT —
+        ``flush``/``persist``/``restore``/``finish`` (see
+        :meth:`check_overflow`; per-step reads would serialize the
+        pipeline on a device round trip per table).
         """
         ids = np.unique(np.asarray(ids).ravel())
         ids = ids[(ids >= 0) & (ids < self.vocab)]
@@ -741,9 +750,10 @@ class ShardedOffloadedTable:
         # join FIRST: the caller's next jitted step may donate (delete) the
         # very cache buffers an in-flight async flush is still reading
         self._join_writeback()
-        # non-draining: only counters older than the check depth are read,
-        # so the steady-state pipeline pays no per-step device round trip
-        self.check_overflow(drain=False)
+        # deliberately NO overflow read here: the per-step path must not
+        # touch the device (each read is a synchronous round trip that
+        # would re-serialize the tier); detection happens at join points
+        # (see check_overflow)
         self._last_touch[prep.uniq] = self.work_id
         if prep.needs_evict:
             budget = int(self.occupancy_threshold * self.cache_capacity)
@@ -931,7 +941,10 @@ class ShardedOffloadedTable:
         empty cache state (pre-restore cache rows must not write back)."""
         self._join_writeback()
         self._join_persist()
-        self._overflow_pending.clear()  # pre-restore cache is discarded
+        # surface any overflow the discarded cache accumulated — training
+        # before this restore may have run against initializer rows, and
+        # the same cache_capacity would overflow again after it
+        self.check_overflow()
         max_work = _replay_store(
             path, vocab=self.vocab, host_weights=self.host_weights,
             host_slots=self.host_slots, host_work_id=self.host_work_id)

@@ -1,0 +1,58 @@
+"""Merge re-measured config entries into bench_suite.json.
+
+Used when individual configs are re-run (``bench.py --configs NAME``)
+after a suite pass — e.g. entries captured while the device tunnel was
+still recovering from a wedge, or deviceless entries skewed by host CPU
+contention. Each merged entry is stamped with the merge time and a note
+naming what it replaces, so provenance stays explicit.
+
+Usage: python tools/merge_suite.py <lines.jsonl> [note]
+  lines.jsonl: one bench JSON line per re-measured config (``=== name``
+  separator lines and non-JSON noise are ignored).
+"""
+import datetime
+import json
+import os
+import sys
+
+
+def main():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "bench_suite.json")
+    note = sys.argv[2] if len(sys.argv) > 2 else "re-measured"
+    with open(sys.argv[1]) as f:
+        fresh = [json.loads(ln) for ln in f
+                 if ln.strip().startswith("{")]
+    with open(path) as f:
+        suite = json.load(f)
+    now = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    by_config = {}
+    for e in fresh:
+        # metric names carry a platform suffix; key on the config block
+        # (unique per suite entry) so a cpu-rerun can replace a tpu entry
+        by_config[json.dumps(e.get("config", e["metric"]),
+                             sort_keys=True)] = e
+    merged, replaced = [], []
+    for e in suite:
+        k = json.dumps(e.get("config", e.get("metric")), sort_keys=True)
+        if k in by_config:
+            new = by_config.pop(k)
+            new.setdefault("ts", now)
+            new["note"] = f"{note}; replaces entry measured {e.get('ts')}"
+            merged.append(new)
+            replaced.append(new["metric"])
+        else:
+            merged.append(e)
+    for e in by_config.values():  # configs not present before
+        e.setdefault("ts", now)
+        e["note"] = note
+        merged.append(e)
+        replaced.append(e["metric"])
+    with open(path, "w") as f:
+        json.dump(merged, f, indent=2)
+    print(f"replaced/added {len(replaced)}: {replaced}")
+
+
+if __name__ == "__main__":
+    main()

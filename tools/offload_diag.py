@@ -1,0 +1,165 @@
+"""Localize the offload tier's per-step cost on the real chip.
+
+The r5 suite measured offload steps at ~242-335 ms with only ~25 ms of
+host prepare — so the budget is device-side or transfer-side. This
+script times each candidate in isolation on the live backend:
+
+  1. h2d bandwidth (fresh numpy -> device, sizes 64K..8M)
+  2. d2h round-trip latency (tiny counter read, the deferred-overflow op)
+  3. plain train_step on a resident working set (all cache hits, fresh
+     batches each step -- isolates batch-transfer + program cost)
+  4. the same with REUSED batches (isolates whether fresh h2d is the gap)
+  5. insert_rows_sharded alone at the bench's steady-state miss count
+
+Run: python tools/offload_diag.py   (needs the TPU tunnel healthy)
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, n=20, warmup=3):
+    for _ in range(warmup):
+        r = fn()
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"platform={dev.platform}")
+
+    # 1. h2d bandwidth, fresh arrays each call (no buffer reuse)
+    for mb in (0.0625, 0.5, 4.0):
+        nbytes = int(mb * (1 << 20))
+        bufs = [np.random.rand(nbytes // 8).astype(np.float64)
+                for _ in range(8)]
+        i = [0]
+
+        def put():
+            i[0] += 1
+            return jax.device_put(bufs[i[0] % len(bufs)], dev)
+        dt = timeit(put)
+        print(f"h2d {mb:7.4f} MB: {dt*1e3:8.2f} ms  "
+              f"{mb/1024/dt:8.3f} GB/s")
+
+    # 2. d2h round trip on a tiny value
+    c = jnp.int32(7) + 1
+
+    def get():
+        return int(jax.device_get(c))
+    dt = timeit(lambda: jnp.asarray(get()))
+    print(f"d2h tiny round trip: {dt*1e3:.2f} ms")
+
+    # 3/4. offload-shaped train step, all-hit working set
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                                   EmbeddingVariableMeta, Trainer)
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    vocab, cache_cap, dim, batch = 2_000_000, 1 << 22, 8, 4096
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    init = {"category": "constant", "value": 0.01}
+    table = ShardedOffloadedTable(
+        "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                     vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    lin = ShardedOffloadedTable(
+        "uid:linear", EmbeddingVariableMeta(embedding_dim=1,
+                                            vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    specs = (table.embedding_spec(), lin.embedding_spec(),
+             EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                           output_dim=1, optimizer=opt))
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                      coll, optax.adagrad(0.01),
+                      offload={"uid": table, "uid:linear": lin},
+                      pipeline_depth=2)
+    rng = np.random.RandomState(0)
+    hot = rng.randint(0, 200_000, size=(64, batch)).astype(np.int32)
+
+    def mk(i):
+        uid = hot[i % len(hot)]
+        ctx = (uid * 7 % 100_000).astype(np.int32)
+        return {"label": (uid % 4 == 0).astype(np.float32),
+                "dense": np.tile((uid % 13).astype(np.float32)[:, None],
+                                 (1, 13)),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+    state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(mk(0)))
+    # warm the cache with the whole hot set (inserts happen here)
+    for i in range(16):
+        state, m = trainer.train_step(state, mk(i))
+    jax.block_until_ready(m["loss"])
+
+    # fresh batches, all hits (no inserts left in the hot set)
+    fresh = [mk(i) for i in range(16, 48)]
+    t0 = time.perf_counter()
+    for b in fresh:
+        state, m = trainer.train_step(state, b)
+    jax.block_until_ready(m["loss"])
+    per = (time.perf_counter() - t0) / len(fresh)
+    print(f"all-hit step, fresh batches:  {per*1e3:8.2f} ms "
+          f"({batch/per:,.0f} ex/s)")
+
+    # reused batches (same np arrays round robin)
+    reuse = fresh[:4]
+    t0 = time.perf_counter()
+    for i in range(32):
+        state, m = trainer.train_step(state, reuse[i % 4])
+    jax.block_until_ready(m["loss"])
+    per = (time.perf_counter() - t0) / 32
+    print(f"all-hit step, reused batches: {per*1e3:8.2f} ms "
+          f"({batch/per:,.0f} ex/s)")
+
+    # 5. insert cost alone at the bench's steady-state miss count (~1700)
+    from openembedding_tpu import hash_table as hash_lib  # noqa: F401
+    miss = 1700
+    cold = np.arange(1_000_000, 1_000_000 + 64 * miss,
+                     dtype=np.int32).reshape(64, miss)
+    emb = state.emb
+    t0 = time.perf_counter()
+    for i in range(32):
+        ids = cold[i % 64]
+        emb["uid"] = table._insert_from_host(emb["uid"], ids)
+    jax.block_until_ready(emb["uid"].keys)
+    per = (time.perf_counter() - t0) / 32
+    print(f"insert {miss} rows (uid table): {per*1e3:8.2f} ms")
+    table.check_overflow()
+
+    # 6. prepared-batch apply path (insert via apply_prepared, both tables)
+    t0 = time.perf_counter()
+    n = 16
+    for i in range(n):
+        ids = cold[(i + 32) % 64]
+        for t in (table, lin):
+            prep = t.host_prepare(ids)
+            emb[t.name] = t.apply_prepared(emb[t.name], prep)
+    jax.block_until_ready(emb["uid"].keys)
+    per = (time.perf_counter() - t0) / n
+    print(f"host_prepare+apply both tables ({miss} misses): "
+          f"{per*1e3:8.2f} ms")
+    table.check_overflow()
+    lin.check_overflow()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Second-stage offload diagnosis: device-blocked timings per piece.
+
+offload_diag.py showed ~177 ms per prepared-apply cycle while the
+isolated insert loop showed 13 ms — but that loop blocked only at the
+end, so async dispatch hid the device program time. Here every piece is
+block_until_ready'd per call:
+
+  a) the device insert program alone (1700 new rows, uid table)
+  b) the jitted train step, fully-resident batch
+  c) shard_batch h2d alone
+  d) apply_prepared with ZERO misses (pure bookkeeping + overflow read)
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main():
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                                   EmbeddingVariableMeta, Trainer)
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    vocab, cache_cap, dim, batch = 2_000_000, 1 << 22, 8, 4096
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    init = {"category": "constant", "value": 0.01}
+    table = ShardedOffloadedTable(
+        "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                     vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    lin = ShardedOffloadedTable(
+        "uid:linear", EmbeddingVariableMeta(embedding_dim=1,
+                                            vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    specs = (table.embedding_spec(), lin.embedding_spec(),
+             EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                           output_dim=1, optimizer=opt))
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                      coll, optax.adagrad(0.01),
+                      offload={"uid": table, "uid:linear": lin},
+                      pipeline_depth=2)
+    rng = np.random.RandomState(0)
+    uid0 = rng.randint(0, 50_000, batch).astype(np.int32)
+
+    def mk(uid):
+        ctx = (uid * 7 % 100_000).astype(np.int32)
+        return {"label": (uid % 4 == 0).astype(np.float32),
+                "dense": np.tile((uid % 13).astype(np.float32)[:, None],
+                                 (1, 13)),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+    state = trainer.init(jax.random.PRNGKey(0),
+                         trainer.shard_batch(mk(uid0)))
+    # make [0, 50k) resident
+    for i in range(14):
+        state, m = trainer.train_step(
+            state, mk(rng.randint(0, 50_000, batch).astype(np.int32)))
+    jax.block_until_ready(m["loss"])
+    table.check_overflow()
+    lin.check_overflow()
+
+    # a) device insert program alone, blocked per call
+    emb = dict(state.emb)
+    n = 16
+    t0 = time.perf_counter()
+    for i in range(n):
+        ids = np.arange(100_000 + i * 1700, 100_000 + (i + 1) * 1700,
+                        dtype=np.int32)
+        emb["uid"] = table._insert_from_host(emb["uid"], ids)
+        jax.block_until_ready(emb["uid"].keys)
+    per = (time.perf_counter() - t0) / n
+    print(f"a) insert 1700 rows, device-blocked:    {per*1e3:8.2f} ms")
+    table._overflow_latest = None
+
+    # b) jitted step, fully-resident, blocked per call
+    bt = [mk(rng.randint(0, 50_000, batch).astype(np.int32))
+          for _ in range(8)]
+    sb = [trainer.shard_batch(b) for b in bt]
+    t0 = time.perf_counter()
+    for i in range(16):
+        state2, m = trainer._train_step(state, sb[i % 8])
+        jax.block_until_ready(m["loss"])
+    per = (time.perf_counter() - t0) / 16
+    print(f"b) jitted step, presharded, blocked:    {per*1e3:8.2f} ms")
+    # b2) same but pipelined (block only at the end)
+    t0 = time.perf_counter()
+    for i in range(16):
+        state3, m = trainer._train_step(state, sb[i % 8])
+    jax.block_until_ready(m["loss"])
+    per = (time.perf_counter() - t0) / 16
+    print(f"b2) jitted step, presharded, async:     {per*1e3:8.2f} ms")
+
+    # c) shard_batch h2d alone
+    t0 = time.perf_counter()
+    for i in range(16):
+        out = trainer.shard_batch(bt[i % 8])
+        jax.block_until_ready(jax.tree.leaves(out))
+    per = (time.perf_counter() - t0) / 16
+    print(f"c) shard_batch h2d, blocked:            {per*1e3:8.2f} ms")
+
+    # d) apply_prepared with zero misses
+    t0 = time.perf_counter()
+    for i in range(16):
+        prep = table.host_prepare(bt[i % 8]["sparse"]["uid"])
+        emb2 = table.apply_prepared(state.emb["uid"], prep)
+        jax.block_until_ready(jax.tree.leaves(emb2))
+    per = (time.perf_counter() - t0) / 16
+    print(f"d) prepare+apply, zero misses, blocked: {per*1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Third-stage: is the 107 ms insert program genuine device cost or
+per-call recompilation? Print per-iteration times + jax compile logs."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+
+
+def main():
+    from openembedding_tpu import EmbeddingVariableMeta
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    vocab, cache_cap, dim = 2_000_000, 1 << 22, 8
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    init = {"category": "constant", "value": 0.01}
+    table = ShardedOffloadedTable(
+        "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                     vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    cache = table.create_cache()
+    jax.block_until_ready(cache.keys)
+
+    for i in range(12):
+        ids = np.arange(1000 + i * 1700, 1000 + (i + 1) * 1700,
+                        dtype=np.int32)
+        t0 = time.perf_counter()
+        cache = table._insert_from_host(cache, ids)
+        jax.block_until_ready(cache.keys)
+        print(f"iter {i:2d}: {1e3*(time.perf_counter()-t0):8.2f} ms")
+    table._overflow_latest = None
+
+    # same ids resubmitted (all already present -> pure probe, no insert)
+    ids = np.arange(1000, 1000 + 1700, dtype=np.int32)
+    t0 = time.perf_counter()
+    cache = table._insert_from_host(cache, ids)
+    jax.block_until_ready(cache.keys)
+    print(f"resubmit (all present): {1e3*(time.perf_counter()-t0):8.2f} ms")
+    table._overflow_latest = None
+
+
+if __name__ == "__main__":
+    main()

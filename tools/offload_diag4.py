@@ -1,0 +1,95 @@
+"""Fourth stage: reproduce the bench's insert+step alternation through
+the Trainer and log recompiles. Times each phase per iteration."""
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_log_compiles", True)
+logging.basicConfig(level=logging.WARNING)
+logging.getLogger("jax._src.dispatch").setLevel(logging.WARNING)
+
+
+def main():
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                                   EmbeddingVariableMeta, Trainer)
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    vocab, cache_cap, dim, batch = 2_000_000, 1 << 22, 8, 4096
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    init = {"category": "constant", "value": 0.01}
+    table = ShardedOffloadedTable(
+        "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                     vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    lin = ShardedOffloadedTable(
+        "uid:linear", EmbeddingVariableMeta(embedding_dim=1,
+                                            vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    specs = (table.embedding_spec(), lin.embedding_spec(),
+             EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                           output_dim=1, optimizer=opt))
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                      coll, optax.adagrad(0.01),
+                      offload={"uid": table, "uid:linear": lin},
+                      pipeline_depth=2)
+    rng = np.random.RandomState(0)
+
+    def mk(i):
+        # ~1700 new ids per batch on top of a resident hot head
+        hot = rng.randint(0, 30_000, batch - 1700).astype(np.int32)
+        new = np.arange(40_000 + i * 1700, 40_000 + (i + 1) * 1700,
+                        dtype=np.int32)
+        uid = np.concatenate([hot, new])
+        ctx = (uid * 7 % 100_000).astype(np.int32)
+        return {"label": (uid % 4 == 0).astype(np.float32),
+                "dense": np.tile((uid % 13).astype(np.float32)[:, None],
+                                 (1, 13)),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+    state = trainer.init(jax.random.PRNGKey(0),
+                         trainer.shard_batch(mk(0)))
+    for i in range(6):   # warm compiles
+        state, m = trainer.train_step(state, mk(i + 1))
+    jax.block_until_ready(m["loss"])
+    print("--- warmup done; per-phase timing (serial path) ---",
+          flush=True)
+
+    for i in range(8):
+        b = mk(100 + i)
+        t0 = time.perf_counter()
+        state2, uniqs = trainer._apply_prepared_offload(state, b)
+        jax.block_until_ready(
+            jax.tree.leaves(state2.emb["uid"].keys))
+        t1 = time.perf_counter()
+        sb = trainer.shard_batch(b)
+        jax.block_until_ready(jax.tree.leaves(sb))
+        t2 = time.perf_counter()
+        state3, m = trainer._train_step(state2, sb)
+        jax.block_until_ready(m["loss"])
+        t3 = time.perf_counter()
+        for name, t in trainer.offload.items():
+            t.note_update(b["sparse"][name], uniq=uniqs.get(name))
+        t4 = time.perf_counter()
+        state = state3
+        print(f"iter {i}: apply={1e3*(t1-t0):7.2f}  h2d={1e3*(t2-t1):6.2f} "
+              f" step={1e3*(t3-t2):7.2f}  note={1e3*(t4-t3):6.2f} ms",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

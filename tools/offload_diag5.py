@@ -1,0 +1,104 @@
+"""Fifth stage: isolate the ~105 ms per-device-call collapse.
+
+Runs three loops, each 20 iters, printing per-iter times:
+  A) shard_batch h2d of FRESH ~500 KB batches only (no compute)
+  B) jitted train step only, REUSED presharded inputs, fixed state
+     (re-init state each iter is impossible with donation; we rebuild
+     from a kept template via device_put each time -- that cost is
+     reported separately)
+  C) the insert program only, fresh 1700-key chunks (as diag3 but
+     alternating with a 500 KB h2d to mimic the bench's mix)
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+
+
+def main():
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                                   EmbeddingVariableMeta, Trainer)
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    vocab, cache_cap, dim, batch = 2_000_000, 1 << 22, 8, 4096
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    init = {"category": "constant", "value": 0.01}
+    table = ShardedOffloadedTable(
+        "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                     vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    lin = ShardedOffloadedTable(
+        "uid:linear", EmbeddingVariableMeta(embedding_dim=1,
+                                            vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    specs = (table.embedding_spec(), lin.embedding_spec(),
+             EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                           output_dim=1, optimizer=opt))
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                      coll, optax.adagrad(0.01),
+                      offload={"uid": table, "uid:linear": lin},
+                      pipeline_depth=2)
+    rng = np.random.RandomState(0)
+
+    def mk():
+        uid = rng.randint(0, 30_000, batch).astype(np.int32)
+        ctx = (uid * 7 % 100_000).astype(np.int32)
+        return {"label": (uid % 4 == 0).astype(np.float32),
+                "dense": np.tile((uid % 13).astype(np.float32)[:, None],
+                                 (1, 13)),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+    state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(mk()))
+    for i in range(3):
+        state, m = trainer.train_step(state, mk())
+    jax.block_until_ready(m["loss"])
+    table.check_overflow(); lin.check_overflow()
+
+    print("A) fresh-batch h2d only:", flush=True)
+    for i in range(20):
+        b = mk()
+        t0 = time.perf_counter()
+        sb = trainer.shard_batch(b)
+        jax.block_until_ready(jax.tree.leaves(sb))
+        print(f"  {i:2d}: {1e3*(time.perf_counter()-t0):7.2f} ms",
+              flush=True)
+
+    print("B) step only, reused presharded batch:", flush=True)
+    sb = trainer.shard_batch(mk())
+    for i in range(20):
+        t0 = time.perf_counter()
+        state, m = trainer._train_step(state, sb)
+        jax.block_until_ready(m["loss"])
+        print(f"  {i:2d}: {1e3*(time.perf_counter()-t0):7.2f} ms",
+              flush=True)
+
+    print("C) insert only, fresh keys + fresh 500KB h2d:", flush=True)
+    emb = dict(state.emb)
+    for i in range(20):
+        ids = np.arange(50_000 + i * 1700, 50_000 + (i + 1) * 1700,
+                        dtype=np.int32)
+        filler = np.random.rand(4096, 32).astype(np.float32)
+        t0 = time.perf_counter()
+        d = jax.device_put(filler)
+        emb["uid"] = table._insert_from_host(emb["uid"], ids)
+        jax.block_until_ready([d, emb["uid"].keys])
+        print(f"  {i:2d}: {1e3*(time.perf_counter()-t0):7.2f} ms",
+              flush=True)
+    table._overflow_latest = None
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Sixth stage: per-transfer fixed overhead in the tunnel's degraded
+mode. Does N small puts cost ~N x the one-big-put price?
+
+Phase 1 enters the degraded mode the way the trainer does (big sharded
+state + a few donating steps). Then:
+  a) 12 fresh small arrays per iter, one device_put each, block at end
+  b) 1 fresh array of the same total bytes per iter, block at end
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+
+
+def main():
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                                   EmbeddingVariableMeta, Trainer)
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    vocab, cache_cap, dim, batch = 2_000_000, 1 << 22, 8, 4096
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    init = {"category": "constant", "value": 0.01}
+    table = ShardedOffloadedTable(
+        "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                     vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    lin = ShardedOffloadedTable(
+        "uid:linear", EmbeddingVariableMeta(embedding_dim=1,
+                                            vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    specs = (table.embedding_spec(), lin.embedding_spec(),
+             EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                           output_dim=1, optimizer=opt))
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                      coll, optax.adagrad(0.01),
+                      offload={"uid": table, "uid:linear": lin},
+                      pipeline_depth=2)
+    rng = np.random.RandomState(0)
+
+    def mk():
+        uid = rng.randint(0, 30_000, batch).astype(np.int32)
+        ctx = (uid * 7 % 100_000).astype(np.int32)
+        return {"label": (uid % 4 == 0).astype(np.float32),
+                "dense": np.tile((uid % 13).astype(np.float32)[:, None],
+                                 (1, 13)),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+    state = trainer.init(jax.random.PRNGKey(0), trainer.shard_batch(mk()))
+    for i in range(3):
+        state, m = trainer.train_step(state, mk())
+    jax.block_until_ready(m["loss"])
+    table.check_overflow(); lin.check_overflow()
+    print("degraded-mode entered (trainer warm)", flush=True)
+
+    kb = 40  # ~12 arrays x 40 KB = the offload step's transfer profile
+    for label, n_arrays in (("12 x 40KB", 12), ("1 x 480KB", 1),
+                            ("3 x 160KB", 3)):
+        per_bytes = kb * 1024 * 12 // n_arrays
+        times = []
+        for it in range(8):
+            bufs = [np.random.randint(0, 1 << 30, per_bytes // 4)
+                    .astype(np.int32) for _ in range(n_arrays)]
+            t0 = time.perf_counter()
+            out = [jax.device_put(b) for b in bufs]
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        print(f"{label}: median {1e3*times[len(times)//2]:7.2f} ms "
+              f"(min {1e3*times[0]:.2f}, max {1e3*times[-1]:.2f})",
+              flush=True)
+
+    # async pipelining test: 24 puts dispatched, ONE block at the end
+    bufs = [np.random.randint(0, 1 << 30, kb * 256).astype(np.int32)
+            for _ in range(24)]
+    t0 = time.perf_counter()
+    out = [jax.device_put(b) for b in bufs]
+    jax.block_until_ready(out)
+    print(f"24 x 40KB async batch: {1e3*(time.perf_counter()-t0):7.2f} ms "
+          f"total", flush=True)
+
+
+if __name__ == "__main__":
+    main()

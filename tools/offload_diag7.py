@@ -1,0 +1,120 @@
+"""Seventh stage: find the host call that stalls ~105 ms per step in
+the REAL offload loop (no explicit blocks — only the natural ones)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+
+
+def main():
+    import optax
+    from openembedding_tpu import (EmbeddingCollection, EmbeddingSpec,
+                                   EmbeddingVariableMeta, Trainer)
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(1, len(jax.devices()))
+    vocab, cache_cap, dim, batch = 2_000_000, 1 << 22, 8, 4096
+    opt = {"category": "adagrad", "learning_rate": 0.01}
+    init = {"category": "constant", "value": 0.01}
+    table = ShardedOffloadedTable(
+        "uid", EmbeddingVariableMeta(embedding_dim=dim,
+                                     vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    lin = ShardedOffloadedTable(
+        "uid:linear", EmbeddingVariableMeta(embedding_dim=1,
+                                            vocabulary_size=vocab),
+        opt, init, vocab=vocab, cache_capacity=cache_cap, mesh=mesh)
+    specs = (table.embedding_spec(), lin.embedding_spec(),
+             EmbeddingSpec(name="ctx", input_dim=100_000, output_dim=dim,
+                           optimizer=opt),
+             EmbeddingSpec(name="ctx:linear", input_dim=100_000,
+                           output_dim=1, optimizer=opt))
+    coll = EmbeddingCollection(specs, mesh)
+    trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
+                      coll, optax.adagrad(0.01),
+                      offload={"uid": table, "uid:linear": lin},
+                      pipeline_depth=1)
+    rng = np.random.RandomState(0)
+
+    def mk(i):
+        hot = rng.randint(0, 30_000, batch - 1700).astype(np.int32)
+        new = np.arange(40_000 + i * 1700, 40_000 + (i + 1) * 1700,
+                        dtype=np.int32)
+        uid = np.concatenate([hot, new])
+        ctx = (uid * 7 % 100_000).astype(np.int32)
+        return {"label": (uid % 4 == 0).astype(np.float32),
+                "dense": np.tile((uid % 13).astype(np.float32)[:, None],
+                                 (1, 13)),
+                "sparse": {"uid": uid, "uid:linear": uid,
+                           "ctx": ctx, "ctx:linear": ctx}}
+    state = trainer.init(jax.random.PRNGKey(0),
+                         trainer.shard_batch(mk(0)))
+    for i in range(12):  # past the overflow-check depth: steady state
+        state, m = trainer.train_step(state, mk(i + 1))
+    jax.block_until_ready(m["loss"])
+    print("steady state reached; timing host calls (NO explicit blocks)",
+          flush=True)
+
+    timed = [mk(100 + i) for i in range(24)]
+    t_total0 = time.perf_counter()
+    rows = []
+    for i in range(len(timed)):
+        b = timed[i]
+        t0 = time.perf_counter()
+        trainer.prefetch(timed[i:i + 2])
+        t1 = time.perf_counter()
+        state, uniqs = trainer._apply_prepared_offload(state, b)
+        t2 = time.perf_counter()
+        sb = trainer.shard_batch(b)
+        t3 = time.perf_counter()
+        state, m = trainer._train_step(state, sb)
+        t4 = time.perf_counter()
+        for name, t in trainer.offload.items():
+            t.note_update(b["sparse"][name], uniq=uniqs.get(name))
+        t5 = time.perf_counter()
+        rows.append((t1 - t0, t2 - t1, t3 - t2, t4 - t3, t5 - t4))
+    jax.block_until_ready(m["loss"])
+    total = time.perf_counter() - t_total0
+    print("  prefetch   apply    h2d   stepdisp  note  (ms)")
+    for i, r in enumerate(rows):
+        print("  " + "  ".join(f"{1e3*x:7.2f}" for x in r))
+    print(f"TOTAL {1e3*total/len(timed):.2f} ms/step", flush=True)
+
+    # breakdown inside apply_prepared: time host_prepare vs apply for uid
+    import openembedding_tpu.offload as off
+    orig_apply = off.ShardedOffloadedTable.apply_prepared
+    orig_co = off.ShardedOffloadedTable.check_overflow
+
+    def timed_apply(self, cache, prep):
+        t0 = time.perf_counter()
+        out = orig_apply(self, cache, prep)
+        print(f"    apply_prepared[{self.name}]: "
+              f"{1e3*(time.perf_counter()-t0):.2f} ms", flush=True)
+        return out
+
+    def timed_co(self, **kw):
+        t0 = time.perf_counter()
+        out = orig_co(self, **kw)
+        print(f"      check_overflow[{self.name}] drain={kw.get('drain')}"
+              f": {1e3*(time.perf_counter()-t0):.2f} ms", flush=True)
+        return out
+    off.ShardedOffloadedTable.apply_prepared = timed_apply
+    off.ShardedOffloadedTable.check_overflow = timed_co
+    print("--- per-call breakdown, 4 steps ---", flush=True)
+    extra = [mk(200 + i) for i in range(4)]
+    for i, b in enumerate(extra):
+        trainer.prefetch(extra[i:i + 2])
+        state, m = trainer.train_step(state, b)
+    jax.block_until_ready(m["loss"])
+
+
+if __name__ == "__main__":
+    main()
