@@ -317,11 +317,15 @@ def run_offload(name, config, *, steps, warmup):
                                output_dim=1, optimizer=opt))
         coll = EmbeddingCollection(specs, mesh)
         serial = bool(config.get("serial"))
-        depth = int(config.get("depth", 2))
+        # explicit "depth" pins the A/B points; absent, the config
+        # measures the FRAMEWORK default (Trainer.pipeline_depth)
+        kw = {"pipeline_depth": int(config["depth"])} \
+            if "depth" in config else {}
         trainer = Trainer(deepctr.build_model("deepfm", ("uid", "ctx")),
                           coll, optax.adagrad(0.01),
                           offload={"uid": table, "uid:linear": lin},
-                          pipeline_depth=depth)
+                          **kw)
+        depth = trainer.pipeline_depth
 
         rng = np.random.RandomState(0)
         make_batch = _zipf_uid_batch_maker(rng, batch, vocab,
@@ -1179,7 +1183,9 @@ def wait_device_healthy(retry_for_s, interval_s, probe_timeout_s=300):
         attempts = []
     attempts = [e for e in attempts if isinstance(e, dict)]
     deadline = time.time() + max(retry_for_s, 0)
-    n = max((e.get("attempt", 0) for e in attempts), default=0)
+    n = max((e.get("attempt", 0) for e in attempts
+             if isinstance(e.get("attempt", 0), (int, float))), default=0)
+    n = int(n)
     while True:
         n += 1
         ok, note = _probe_device_child(probe_timeout_s)

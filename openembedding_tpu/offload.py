@@ -628,23 +628,26 @@ class ShardedOffloadedTable:
         self._overflow_latest = cache.insert_failures + jnp.int32(0)
         return cache
 
-    def check_overflow(self, *, drain: bool = True) -> None:
-        """Check the cache's cumulative insert-overflow counter; raises
-        if any insert since creation ever overflowed a probe window.
+    def check_overflow(self) -> None:
+        """Read the cache's cumulative insert-overflow counter; raises
+        if any insert since creation (or the last eviction rebuild, which
+        checks before discarding) ever overflowed a probe window.
 
-        ``drain=False`` is the per-step pipeline call and is FREE: it
-        reads nothing (every device read is a synchronous round trip —
-        ~105 ms over a degraded tunnel link — and one per table per step
-        serialized the whole tier, tools/offload_diag7.py). Detection
-        happens at join points (``flush``/``persist``/``restore``/
-        ``finish``, ``drain=True``), which read the latest cumulative
-        counter once — ``fit(persist_dir=...)`` reaches one every
-        ``persist_pending_window`` batches, and hand-driven loops at
-        ``finish()``."""
-        if not drain or self._overflow_latest is None:
+        This is a JOIN-POINT operation — ``flush``/``persist``/
+        ``restore``/``finish``/``_evict`` — and deliberately has no
+        per-step counterpart: every device read is a synchronous round
+        trip (~105 ms over a degraded tunnel link), and one per table per
+        step is what serialized the whole tier in rounds 3-5
+        (tools/offload_diag7.py). ``fit(persist_dir=...)`` reaches a
+        join every ``persist_pending_window`` batches; hand-driven loops
+        at ``finish()``. The counter is cleared only after a SUCCESSFUL
+        read, so a transient device failure does not lose the evidence."""
+        if self._overflow_latest is None:
             return
-        v, self._overflow_latest = self._overflow_latest, None
-        if int(jax.device_get(v)) > 0:
+        v = self._overflow_latest
+        overflowed = int(jax.device_get(v)) > 0   # may raise; keep v
+        self._overflow_latest = None
+        if overflowed:
             raise RuntimeError(
                 f"offloaded table {self.name!r}: HBM cache insert "
                 "overflow — raise cache_capacity or lower "
@@ -814,6 +817,12 @@ class ShardedOffloadedTable:
         survivors, rebuild the cache with them (open-addressing tables
         never delete, so eviction = writeback + rebuild-from-host)."""
         self._join_writeback()
+        # eviction DISCARDS the cache (create_cache zeroes the cumulative
+        # insert_failures) — read the pending overflow evidence first, or
+        # an overflow between the last join point and this rebuild would
+        # vanish; eviction is already a synchronous join, so the device
+        # round trip costs nothing extra here
+        self.check_overflow()
         resident_ids = np.nonzero(self._resident)[0]
         keep_target = max(0, min(int(self.keep_fraction * budget),
                                  budget - incoming))

@@ -65,7 +65,7 @@ class Trainer:
                  loss_fn: Callable = binary_logloss,
                  sparse_as_dense: Optional[Any] = None,
                  offload: Optional[Dict[str, Any]] = None,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 4):
         """``sparse_as_dense``: DenseFeatureSpecs (from
         ``hybrid.split_sparse_dense``) kept as flax params inside the model —
         the reference's "Cache" hybrid. Batch ``sparse`` columns are routed
@@ -84,7 +84,11 @@ class Trainer:
         prepared batches in flight so a host prepare slower than the
         device step still overlaps across the window; 1 restores the
         single-lookahead pipeline; results are bit-identical at any
-        depth (the planned-residency chain in offload.host_prepare)."""
+        depth (the planned-residency chain in offload.host_prepare).
+        Default 4: measured on the offload A/B (bench_suite.json
+        offload_ab_*) K=4 gave 3.3x serial vs K=1's 1.8x — cold host
+        pages amortize across a deeper window; the reference's default
+        budget is deeper still (64)."""
         if sparse_as_dense:
             from .hybrid import HybridModel
             module = HybridModel(inner=module,

@@ -331,6 +331,64 @@ class TestPipelinedOffload:
                         "sparse": {"off": ids, "off:linear": ids}})
         return out
 
+    def test_steady_state_makes_no_per_step_device_reads(self, devices8):
+        """The pipeline's steady state must never block on a device read:
+        one blocking device_get per table per step is what serialized the
+        tier on the tunneled bench chip (each read is a synchronous round
+        trip; rounds 3-5 measured 466/242 ms steps from exactly this —
+        tools/offload_diag7.py). Overflow counters are cumulative on
+        device and may be read ONLY at join points (flush/persist/
+        restore/finish)."""
+        from openembedding_tpu.parallel.mesh import create_mesh
+        mesh = create_mesh(2, 4, devices8)
+        # cache large enough that nothing evicts: eviction is a JOIN
+        # (flush + rebuild) and is allowed to read the device
+        trainer, table, lin = self._trainer(mesh, cache=4096)
+        batches = self._batches(10)
+        state = trainer.init(jax.random.PRNGKey(0),
+                             trainer.shard_batch(batches[0]))
+        # warm past compiles and the first inserts
+        for b in batches[:2]:
+            state, _ = trainer.train_step(state, b)
+
+        # intercept every blocking-read spelling the codebase could use:
+        # jax.device_get, jax.block_until_ready, and np.asarray/int(arr)
+        # (both route through ArrayImpl.__array__)
+        reads = []
+        orig_get, orig_block = jax.device_get, jax.block_until_ready
+        from jax._src import array as _jarray
+        orig_arr = _jarray.ArrayImpl.__array__
+
+        def counting_get(x):
+            reads.append(f"device_get:{type(x).__name__}")
+            return orig_get(x)
+
+        def counting_block(x):
+            reads.append(f"block_until_ready:{type(x).__name__}")
+            return orig_block(x)
+
+        def counting_array(self, *a, **kw):
+            reads.append("ArrayImpl.__array__")
+            return orig_arr(self, *a, **kw)
+
+        jax.device_get = counting_get
+        jax.block_until_ready = counting_block
+        _jarray.ArrayImpl.__array__ = counting_array
+        try:
+            for i, b in enumerate(batches[2:]):
+                nxt = batches[3 + i] if 3 + i < len(batches) else None
+                state, _ = trainer.train_step(state, b, next_batch=nxt)
+        finally:
+            jax.device_get = orig_get
+            jax.block_until_ready = orig_block
+            _jarray.ArrayImpl.__array__ = orig_arr
+        assert reads == [], \
+            f"steady-state step made blocking device reads: {reads}"
+        # the join point DOES read (and drains the overflow counter)
+        table.flush(state.emb["off"])
+        table._join_writeback()
+        table.finish(); lin.finish()
+
     @pytest.mark.parametrize("depth", [1, 2, 4])
     def test_pipelined_fit_matches_serial_steps(self, devices8, tmp_path,
                                                 depth):

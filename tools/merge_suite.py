@@ -27,28 +27,32 @@ def main():
         suite = json.load(f)
     now = datetime.datetime.now(datetime.timezone.utc).isoformat(
         timespec="seconds")
+    def key(e):
+        # metric names carry a platform suffix; key on the config block
+        # (unique per suite entry) so a cpu-rerun can replace a tpu entry;
+        # entries without one (older formats) fall back to the metric name
+        return json.dumps(e.get("config") or e.get("metric", "?"),
+                          sort_keys=True)
+
     by_config = {}
     for e in fresh:
-        # metric names carry a platform suffix; key on the config block
-        # (unique per suite entry) so a cpu-rerun can replace a tpu entry
-        by_config[json.dumps(e.get("config", e["metric"]),
-                             sort_keys=True)] = e
+        by_config[key(e)] = e
     merged, replaced = [], []
     for e in suite:
-        k = json.dumps(e.get("config", e.get("metric")), sort_keys=True)
+        k = key(e)
         if k in by_config:
             new = by_config.pop(k)
             new.setdefault("ts", now)
             new["note"] = f"{note}; replaces entry measured {e.get('ts')}"
             merged.append(new)
-            replaced.append(new["metric"])
+            replaced.append(new.get("metric", "?"))
         else:
             merged.append(e)
     for e in by_config.values():  # configs not present before
         e.setdefault("ts", now)
         e["note"] = note
         merged.append(e)
-        replaced.append(e["metric"])
+        replaced.append(e.get("metric", "?"))
     with open(path, "w") as f:
         json.dump(merged, f, indent=2)
     print(f"replaced/added {len(replaced)}: {replaced}")

@@ -86,20 +86,21 @@ def main():
     print(f"a) insert 1700 rows, device-blocked:    {per*1e3:8.2f} ms")
     table._overflow_latest = None
 
-    # b) jitted step, fully-resident, blocked per call
+    # b) jitted step, fully-resident, blocked per call (the jitted step
+    # donates its state arg, so thread the returned state through)
     bt = [mk(rng.randint(0, 50_000, batch).astype(np.int32))
           for _ in range(8)]
     sb = [trainer.shard_batch(b) for b in bt]
     t0 = time.perf_counter()
     for i in range(16):
-        state2, m = trainer._train_step(state, sb[i % 8])
+        state, m = trainer._train_step(state, sb[i % 8])
         jax.block_until_ready(m["loss"])
     per = (time.perf_counter() - t0) / 16
     print(f"b) jitted step, presharded, blocked:    {per*1e3:8.2f} ms")
     # b2) same but pipelined (block only at the end)
     t0 = time.perf_counter()
     for i in range(16):
-        state3, m = trainer._train_step(state, sb[i % 8])
+        state, m = trainer._train_step(state, sb[i % 8])
     jax.block_until_ready(m["loss"])
     per = (time.perf_counter() - t0) / 16
     print(f"b2) jitted step, presharded, async:     {per*1e3:8.2f} ms")

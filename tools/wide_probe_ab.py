@@ -1,0 +1,85 @@
+"""A/B the wide-pair probe: current two-word compare vs an int64-bitcast
+single-word compare, at the hash-bench shapes, on the live backend.
+
+If the bitcast variant wins >=10% the probe gets the optimization;
+otherwise the ~28% wide-vs-int32 gap is gather-bandwidth (2x key bytes),
+not compare cost, and the README statement stands as measured.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from openembedding_tpu import hash_table as hl  # noqa: E402
+
+
+def find_rows_bitcast(table_keys, query, max_probes=hl.DEFAULT_MAX_PROBES):
+    """find_rows for wide tables with pairs bitcast to int64: the probe
+    gathers the same bytes but matches on ONE word."""
+    query = hl.check_key_dtype(table_keys, query)
+    capacity = table_keys.shape[0]
+    n = query.shape[0]
+    bsz, nb, chain = hl.table_layout(capacity, max_probes)
+    h = hl.probe_starts(query, capacity, max_probes)
+    b0 = h // bsz
+    bkts = b0[:, None] + jnp.arange(chain, dtype=jnp.int32)[None, :]
+    empty = hl.empty_key(table_keys.dtype)
+    t64 = lax.bitcast_convert_type(table_keys, jnp.int64)      # [cap]
+    q64 = lax.bitcast_convert_type(query, jnp.int64)           # [n]
+    probed = jnp.take(t64.reshape(nb, bsz), bkts, axis=0)
+    match = probed.reshape(n, chain * bsz) == q64[:, None]
+    valid = query[:, 1] != empty
+    hit = jnp.any(match, axis=1)
+    first = jnp.argmax(match, axis=1).astype(jnp.int32)
+    slot = h + first
+    return jnp.where(hit & valid, slot, -1)
+
+
+def bench(fn, args, steps=30):
+    f = jax.jit(fn)
+    r = f(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        r = f(*args)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / steps * 1e6
+
+
+def main():
+    cap = hl.round_capacity(1 << 22)
+    batch = 32768
+    rng = np.random.RandomState(0)
+    meta_keys = rng.randint(0, 1 << 62, size=cap, dtype=np.int64)
+    table_keys = jnp.asarray(hl.split64(meta_keys))   # [cap, 2] int32
+    # queries: half present, half absent
+    q64 = np.concatenate([meta_keys[rng.randint(0, cap, batch // 2)],
+                          rng.randint(0, 1 << 62, batch // 2,
+                                      dtype=np.int64)])
+    query = jnp.asarray(hl.split64(q64))
+
+    a = jnp.asarray(np.asarray(
+        jax.jit(hl.find_rows)(table_keys, query)))
+    b = jnp.asarray(np.asarray(
+        jax.jit(find_rows_bitcast)(table_keys, query)))
+    same = bool(jnp.all(a == b))
+    print(f"agreement: {same}")
+    assert same
+
+    us_pair = bench(hl.find_rows, (table_keys, query))
+    us_bit = bench(find_rows_bitcast, (table_keys, query))
+    print(f"two-word compare: {us_pair:8.1f} us/batch")
+    print(f"int64 bitcast:    {us_bit:8.1f} us/batch "
+          f"({us_pair/us_bit:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()
