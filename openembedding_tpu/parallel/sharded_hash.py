@@ -215,6 +215,67 @@ def insert_rows_sharded(state: hash_lib.HashTableState,
 
 
 @functools.lru_cache(maxsize=None)
+def _insert_packed_program(mesh: Mesh, spec: HashShardingSpec,
+                           dim: int, layout: tuple):
+    """Jitted insert taking ONE packed f32 buffer instead of the
+    keys/weights/slots pytree: column 0 carries int32 keys bitcast to
+    f32, columns [1, 1+dim) the weight row, the rest each slot's row
+    (``layout`` = ((name, start_col, n_cols, row_shape), ...), static).
+
+    Rationale: the offload tier ships an insert payload to the device
+    EVERY step; one coalesced transfer replaces 2+len(slots) separate
+    host->device arrays — fewer dispatches on any link, and on the
+    tunneled bench chip per-transfer latency is the measurable cost
+    (tools/offload_diag6.py). The unpack (slice + bitcast) fuses into
+    the insert program."""
+
+    def _insert(tkeys, tweights, tslots, init_rng, packed):
+        local = hash_lib.HashTableState(
+            keys=tkeys, weights=tweights, slots=tslots, init_rng=init_rng,
+            insert_failures=jnp.zeros((), jnp.int32))
+        n = packed.shape[0]
+        k = lax.bitcast_convert_type(packed[:, 0], jnp.int32)
+        w = packed[:, 1:1 + dim]
+        srows = {name: packed[:, s:s + c].reshape((n,) + shape)
+                 for name, s, c, shape in layout}
+        masked = _mask_non_owned(spec, k, _my_shard(mesh, spec))
+        new = hash_lib.insert_rows(local, masked, w, srows or None,
+                                   max_probes=spec.max_probes)
+        failed = lax.psum(new.insert_failures, spec.shard_axes)
+        return new.keys, new.weights, new.slots, failed
+
+    row = spec.row_spec()
+    slot_specs = {name: row for name, _, _, _ in layout}
+    fn = shard_map(_insert, mesh=mesh,
+                   in_specs=(row, row, slot_specs, P(), P()),
+                   out_specs=(row, row, slot_specs, P()),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
+def insert_rows_sharded_packed(state: hash_lib.HashTableState,
+                               packed: jnp.ndarray,
+                               layout: tuple,
+                               *,
+                               mesh: Mesh,
+                               spec: HashShardingSpec
+                               ) -> hash_lib.HashTableState:
+    """:func:`insert_rows_sharded` behavior from ONE packed f32 buffer
+    (int32 keys only — the offload cache's key plane; wide tables use
+    the unpacked path). See :func:`_insert_packed_program`."""
+    if spec.wide:
+        raise ValueError("packed insert supports int32-key tables only")
+    dim = state.weights.shape[-1]
+    fn = _insert_packed_program(mesh, spec, dim, layout)
+    tkeys, tweights, tslots, failed = fn(
+        state.keys, state.weights, state.slots, state.init_rng, packed)
+    return hash_lib.HashTableState(
+        keys=tkeys, weights=tweights, slots=tslots,
+        init_rng=state.init_rng,
+        insert_failures=state.insert_failures + failed)
+
+
+@functools.lru_cache(maxsize=None)
 def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                   dim: int, batch_sharded: bool,
                   record_stats: bool = False):

@@ -331,6 +331,44 @@ class TestPipelinedOffload:
                         "sparse": {"off": ids, "off:linear": ids}})
         return out
 
+    def test_packed_insert_matches_unpacked_fallback(self, devices8):
+        """The one-transfer packed insert (keys bitcast into an f32
+        column) must land bit-identical rows/slots to the generic
+        per-array path — the fallback non-f32 tables take in production
+        must not drift from the default path every f32 test exercises."""
+        from openembedding_tpu.parallel.mesh import create_mesh
+        mesh = create_mesh(2, 4, devices8)
+        batches = self._batches(6)
+
+        t_packed, tab_p, lin_p = self._trainer(mesh, cache=4096)
+        assert tab_p._packed_layout(np.dtype(np.int32)) is not None
+        s_p = t_packed.init(jax.random.PRNGKey(0),
+                            t_packed.shard_batch(batches[0]))
+        for b in batches:
+            s_p, m_p = t_packed.train_step(s_p, b)
+
+        t_plain, tab_u, lin_u = self._trainer(mesh, cache=4096)
+        tab_u._packed_layout = lambda *_a, **_k: None   # force fallback
+        lin_u._packed_layout = lambda *_a, **_k: None
+        s_u = t_plain.init(jax.random.PRNGKey(0),
+                           t_plain.shard_batch(batches[0]))
+        for b in batches:
+            s_u, m_u = t_plain.train_step(s_u, b)
+
+        assert float(m_p["loss"]) == float(m_u["loss"])
+        for name in ("off", "off:linear"):
+            a, b_ = s_p.emb[name], s_u.emb[name]
+            np.testing.assert_array_equal(np.asarray(a.keys),
+                                          np.asarray(b_.keys))
+            np.testing.assert_array_equal(np.asarray(a.weights),
+                                          np.asarray(b_.weights))
+            for sname in a.slots:
+                np.testing.assert_array_equal(
+                    np.asarray(a.slots[sname]),
+                    np.asarray(b_.slots[sname]))
+        for t in (tab_p, lin_p, tab_u, lin_u):
+            t.finish()
+
     def test_steady_state_makes_no_per_step_device_reads(self, devices8):
         """The pipeline's steady state must never block on a device read:
         one blocking device_get per table per step is what serialized the

@@ -55,6 +55,19 @@ def bench(fn, args, steps=30):
 
 
 def main():
+    # Under the framework's x64-off baseline JAX TRUNCATES int64 bitcasts
+    # to int32 (shape preserved, a warning emitted) — the single-word
+    # compare simply does not exist there. Detect and report cleanly;
+    # JAX_ENABLE_X64=1 in the environment runs the actual A/B.
+    probe = lax.bitcast_convert_type(
+        jnp.zeros((2, 2), jnp.int32), jnp.int64)
+    if probe.dtype != jnp.int64 or probe.shape != (2,):
+        print("int64 bitcast unavailable: jax_enable_x64 is off (the "
+              "framework baseline), so JAX truncates the bitcast to "
+              "int32 — the wide pair probe has no single-word-compare "
+              "variant here. Re-run with JAX_ENABLE_X64=1 to measure "
+              "the hypothetical x64 path.")
+        return
     cap = hl.round_capacity(1 << 22)
     batch = 32768
     rng = np.random.RandomState(0)
