@@ -1,5 +1,13 @@
 """Localize the offload tier's per-step cost on the real chip.
 
+HISTORICAL NOTE (kept as the diagnosis trail): the "all-hit" labels in
+steps 3/4 are wrong — the 16-batch warmup covers only ~28% of the
+200k-id hot set, so the "fresh batches" loop still missed ~70% of ids
+and includes insert traffic. The fresh-vs-reused 30x gap it exposed was
+the first signal of the real story (diag5-7): on a degraded tunnel every
+HOST-BLOCKING call costs ~105 ms regardless of payload, and the per-step
+deferred-overflow reads were the tier's per-step blocker.
+
 The r5 suite measured offload steps at ~242-335 ms with only ~25 ms of
 host prepare — so the budget is device-side or transfer-side. This
 script times each candidate in isolation on the live backend:
