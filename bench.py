@@ -45,11 +45,17 @@ def build(config, mesh):
             config["dim"],
             optimizer={"category": "adagrad", "learning_rate": 0.01},
             hash_capacity=config.get("hash_capacity", 1 << 22),
-            key_dtype=config.get("key_dtype", "wide"))
+            key_dtype=config.get("key_dtype", "wide"),
+            plane=config.get("plane", "a2a"),
+            cache_k=config.get("cache_k", 0),
+            cache_refresh_every=config.get("cache_refresh_every", 64))
     else:
         specs = deepctr.make_feature_specs(
             features, config["vocab"], config["dim"],
-            optimizer={"category": "adagrad", "learning_rate": 0.01})
+            optimizer={"category": "adagrad", "learning_rate": 0.01},
+            plane=config.get("plane", "a2a"),
+            cache_k=config.get("cache_k", 0),
+            cache_refresh_every=config.get("cache_refresh_every", 64))
         mapper = None
     coll = EmbeddingCollection(specs, mesh)
     trainer = Trainer(deepctr.build_model(config.get("model", "deepfm"),
@@ -688,6 +694,91 @@ def run_auc_criteo(name, config, *, steps, warmup):
     }
 
 
+def run_cache_ab(name, config, *, steps, warmup):
+    """Cached-vs-uncached A/B on one config: identical data + seeds,
+    ``plane="a2a"`` vs ``plane="a2a+cache"`` (the hot-row replica cache,
+    ``parallel/hot_cache.py``). Reports both planes' examples/s, the
+    speedup, and the cache hit rate / ICI-bytes-saved counters sampled
+    over a few instrumented steps. ``value`` is the CACHED plane's
+    examples/s so ``vs_baseline`` stays comparable with the plain
+    ``deepfm_dim9*`` entries.
+    """
+    import jax
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.utils import observability as obs
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    data_ax = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    mesh = create_mesh(data_ax, n_dev // data_ax)
+    batch = config["batch"]
+    refresh = int(config.get("cache_refresh_every", 32))
+    planes = {}
+    stats = {}
+    for plane in ("a2a", "a2a+cache"):
+        cfg = dict(config, plane=plane)
+        features, coll, trainer, mapper = build(cfg, mesh)
+        batches = make_batches(cfg, features, mapper)
+        state = trainer.init(jax.random.PRNGKey(0),
+                             trainer.shard_batch(batches[0]))
+        # warm long enough that at least one admission refresh has landed
+        # and the post-refresh programs are compiled
+        warm = max(warmup, refresh + 2)
+        for i in range(warm):
+            state, m = trainer.train_step(state, batches[i % len(batches)])
+        jax.block_until_ready(m["loss"])
+        block_eps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(steps):
+                state, m = trainer.train_step(state,
+                                              batches[i % len(batches)])
+            jax.block_until_ready(m["loss"])
+            block_eps.append(steps * batch / (time.perf_counter() - t0))
+        planes[plane] = _median(block_eps)
+        if plane == "a2a+cache":
+            # instrumented sample OUTSIDE the timed blocks, driven through
+            # direct pull/apply calls (the stats gate is part of THOSE
+            # programs' cache keys; the trainer's outer step jit was
+            # compiled with the gate off and would stay silent — the same
+            # contract as the a2a_extra_entries accumulators)
+            obs.GLOBAL.reset()
+            obs.set_evaluate_performance(True)
+            try:
+                sb = trainer.shard_batch(batches[0])
+                inputs = {k2: v for k2, v in sb["sparse"].items()
+                          if k2 in coll.specs}
+                rows = coll.pull(state.emb, inputs)
+                jax.block_until_ready(jax.tree.leaves(rows))
+                emb2 = coll.apply_gradients(state.emb, inputs, rows)
+                jax.block_until_ready(jax.tree.leaves(emb2))
+                jax.effects_barrier()
+                cs = obs.cache_stats()
+                del rows, emb2
+            finally:
+                obs.set_evaluate_performance(False)
+            stats = {
+                "cache_hit_rate": round(cs["cache_hit_rate"], 4),
+                "ici_bytes_saved_per_step":
+                    round(cs["ici_bytes_saved"], 1),
+            }
+        del state
+        gc.collect()
+    eps = planes["a2a+cache"]
+    return {
+        "metric": f"{name}_examples_per_sec_{platform}{n_dev}",
+        "value": round(eps, 1),
+        "unit": "examples/s",
+        "vs_baseline": round(eps / n_dev / REF_PER_CHIP, 3),
+        "per_chip": round(eps / n_dev, 1),
+        "uncached_eps": round(planes["a2a"], 1),
+        "cache_speedup": round(eps / planes["a2a"], 3),
+        **stats,
+        **_hbm_stats(),
+        "config": dict(config),
+    }
+
+
 def run_plane_parity(name, config, *, steps, warmup):
     """Cross-plane AUC/loss parity: a2a, psum, hybrid (sparse_as_dense),
     and offload planes trained on IDENTICAL data + seeds must agree — the
@@ -927,13 +1018,14 @@ def run_ckpt_local(name, config, *, steps, warmup):
     import tempfile
     root = os.path.dirname(os.path.abspath(__file__))
     code = f"""
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", {config.get("devices", 4)})
-import json, shutil, tempfile, time
-import numpy as np
 import sys
 sys.path.insert(0, {root!r})
+import jax
+from openembedding_tpu.utils.jaxcompat import set_num_cpu_devices
+jax.config.update("jax_platforms", "cpu")
+set_num_cpu_devices({config.get("devices", 4)})
+import json, shutil, tempfile, time
+import numpy as np
 from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
 from openembedding_tpu import checkpoint as ckpt
 from openembedding_tpu.parallel.mesh import create_mesh
@@ -998,6 +1090,12 @@ CONFIGS = {
     "deepfm_dim9_zipf_bigvocab": {
         "model": "deepfm", "dim": 9, "vocab": 1 << 22, "batch": 4096,
         "zipf": True},
+    # cached-vs-uncached A/B: the hot-row replica cache on the zipf
+    # headline shape — same data/seeds on plane="a2a" vs "a2a+cache"
+    # (parallel/hot_cache.py); value = cached eps, plus speedup + hit rate
+    "deepfm_dim9_zipf": {"kind": "cache_ab", "model": "deepfm", "dim": 9,
+                         "vocab": 1 << 20, "batch": 4096, "zipf": True,
+                         "cache_k": 4096, "cache_refresh_every": 16},
     "deepfm_dim64": {"model": "deepfm", "dim": 64, "vocab": 1 << 18,
                      "batch": 4096, "zipf": True},
     # checkpoint timing on a deliberately small table: the bench link
@@ -1071,6 +1169,7 @@ CONFIGS = {
 }
 HEADLINE = "deepfm_dim9"
 RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
+           "cache_ab": run_cache_ab,
            "hash_probe": run_hash_probe,
            "auc": run_auc_criteo, "ckpt_local": run_ckpt_local,
            "serving_lookup": run_serving_lookup,

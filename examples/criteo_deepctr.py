@@ -46,9 +46,13 @@ def parse_args(argv=None):
                    help="keep embeddings with vocab <= N as dense data-"
                    "parallel params (the reference's --cache hybrid, "
                    "exb.py:617-632); needs --no-fused")
-    p.add_argument("--plane", default="a2a", choices=["a2a", "psum"],
+    p.add_argument("--plane", default="a2a",
+                   choices=["a2a", "psum", "a2a+cache"],
                    help="sparse data plane: owner-routed all-to-all "
-                   "(default) or the psum/all_gather baseline")
+                   "(default), the psum/all_gather baseline, or a2a plus "
+                   "the hot-row replica cache (parallel/hot_cache.py)")
+    p.add_argument("--cache_k", type=int, default=0,
+                   help="a2a+cache replica rows per variable (0 = default)")
     p.add_argument("--hist_len", type=int, default=0, metavar="L",
                    help="add a DIN-style variable-length behavior-history "
                    "feature (padded to L, mean-pooled; reference "
@@ -107,12 +111,14 @@ def main(argv=None):
                   "big table); ignoring")
         specs, mapper = make_fused_specs(
             features, vocab, args.embedding_dim, optimizer=opt_config,
-            hash_capacity=1 << 22, plane=args.plane, **a2a_kw)
+            hash_capacity=1 << 22, plane=args.plane,
+            cache_k=args.cache_k, **a2a_kw)
         dense_specs = ()
     else:
         specs = deepctr.make_feature_specs(
             features, vocab, args.embedding_dim, optimizer=opt_config,
-            hash_capacity=1 << 22, plane=args.plane, **a2a_kw)
+            hash_capacity=1 << 22, plane=args.plane,
+            cache_k=args.cache_k, **a2a_kw)
         mapper = None
         if args.sparse_as_dense:
             from openembedding_tpu import split_sparse_dense
@@ -131,10 +137,12 @@ def main(argv=None):
         specs = tuple(specs) + (
             EmbeddingSpec(name="hist", input_dim=vocab, output_dim=args.embedding_dim,
                           optimizer=opt_config, pooling="mean",
-                          hash_capacity=1 << 22, plane=args.plane),
+                          hash_capacity=1 << 22, plane=args.plane,
+                          cache_k=args.cache_k),
             EmbeddingSpec(name="hist:linear", input_dim=vocab, output_dim=1,
                           optimizer=opt_config, pooling="sum",
-                          hash_capacity=1 << 22, plane=args.plane))
+                          hash_capacity=1 << 22, plane=args.plane,
+                          cache_k=args.cache_k))
     coll = EmbeddingCollection(specs, mesh)
     model = deepctr.build_model(args.model, features)
     trainer = Trainer(model, coll, optax.adam(args.dense_lr),

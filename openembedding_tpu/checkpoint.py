@@ -47,6 +47,7 @@ from .embedding import EmbeddingCollection
 from .meta import ModelMeta
 from . import hash_table as hash_lib
 from . import table as table_lib
+from .parallel import hot_cache
 from .parallel import sharded_hash as sh
 from .parallel import sharded_table as st
 from .utils import fs
@@ -195,7 +196,9 @@ def save_checkpoint(path: str,
     _sync("ckpt_dirs_ready")
 
     for name, spec in collection.specs.items():
-        state = states[name]
+        # a hot-row replica (a2a+cache plane) is derived state: only the
+        # authoritative table is dumped
+        state = hot_cache.unwrap(states[name])
         vid = collection.variable_id(name)
         vdir = fs.join(path, _var_dir(vid, name))
         part = f"part{rank}_" if (nproc > 1 or remote or compress) else ""
@@ -758,7 +761,7 @@ def load_checkpoint(path: str,
         optimizer = collection.optimizer(name)
         dump_hash = _is_hash_meta(dump_meta[name])
         if spec.use_hash:
-            state = states[name]
+            state = hot_cache.unwrap(states[name])
             total_rows = 0
             for data_part in data:
                 state, n_part = _insert_hash_rows(
@@ -784,9 +787,15 @@ def load_checkpoint(path: str,
                 data, spec, sspec, optimizer, collection.mesh, with_opt,
                 shard_slice=shard_slice)
         else:
+            shardings = collection.state_shardings()[name]
+            if isinstance(shardings, hot_cache.CachedState):
+                shardings = shardings.table
             out[name] = _load_array_var(
-                data, spec, sspec, optimizer,
-                collection.state_shardings()[name], with_opt)
+                data, spec, sspec, optimizer, shardings, with_opt)
+    for name in out:
+        # cached-plane variables come back with a fresh all-pad replica;
+        # the first HotCacheManager refresh re-admits the hot set
+        out[name] = collection.wrap_hot_cache(name, out[name])
     if dense_state_template is not None:
         with fs.open_file(fs.join(path, DENSE_FILE), "rb") as f:
             dense = serialization.from_bytes(dense_state_template, f.read())
@@ -921,6 +930,7 @@ def export_dense(collection: EmbeddingCollection,
                 "cannot be exported densely (reference rejects this too)")
         sspec = collection.sharding_spec(name)
         perm = _logical_perm(sspec)
-        weights = np.asarray(jax.device_get(states[name].weights))[perm]
+        state = hot_cache.unwrap(states[name])
+        weights = np.asarray(jax.device_get(state.weights))[perm]
         out[name] = weights[:spec.input_dim]  # drop padding rows
     return out

@@ -75,8 +75,13 @@ class EmbeddingSpec:
                                      # for small key spaces; "int64" needs
                                      # the global x64 flag
     plane: str = "a2a"               # "a2a" owner-routed | "psum" baseline
+                                     # | "a2a+cache" (a2a + hot-row replica,
+                                     # parallel/hot_cache.py)
     a2a_capacity: int = 0            # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0           # auto bucket = slack * mean
+    cache_k: int = 0                 # hot-row replica slots; 0 = default
+    cache_refresh_every: int = 64    # admission refresh period (steps)
+    cache_decay: float = 0.8         # frequency-sketch decay per refresh
     pooling: Optional[str] = None    # sequence combiner: sum | mean | sqrtn;
                                      # inputs become [B, L] padded id matrices
                                      # (ragged.py; reference RaggedTensor
@@ -139,12 +144,14 @@ class EmbeddingCollection:
                     mesh, total_capacity=spec.hash_capacity,
                     num_shards=spec.num_shards, plane=spec.plane,
                     a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack,
-                    key_width=64 if spec.key_dtype == "wide" else 32)
+                    key_width=64 if spec.key_dtype == "wide" else 32,
+                    cache_k=spec.cache_k)
             else:
                 self._shardings[spec.name] = st.make_sharding_spec(
                     spec.meta(), mesh, num_shards=spec.num_shards,
                     layout=spec.layout, plane=spec.plane,
-                    a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack)
+                    a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack,
+                    cache_k=spec.cache_k)
 
     # --- introspection -----------------------------------------------------
     def variable_id(self, name: str) -> int:
@@ -158,6 +165,24 @@ class EmbeddingCollection:
 
     def sharding_spec(self, name: str):
         return self._shardings[name]
+
+    def cached_names(self) -> tuple:
+        """Variables on the ``"a2a+cache"`` plane (hot-row replica)."""
+        return tuple(name for name, s in self._shardings.items()
+                     if s.is_cached)
+
+    def make_hot_cache_manager(self, name: str):
+        """Admission/refresh driver for one cached variable (the Trainer
+        builds one per ``plane="a2a+cache"`` spec automatically)."""
+        from .parallel import hot_cache
+        spec = self.specs[name]
+        sspec = self._shardings[name]
+        if not sspec.is_cached:
+            raise ValueError(f"{name!r} is not on the a2a+cache plane")
+        return hot_cache.HotCacheManager(
+            mesh=self.mesh, spec=sspec, k=sspec.cache_k,
+            refresh_every=spec.cache_refresh_every,
+            decay=spec.cache_decay)
 
     def model_meta(self, model_sign: str = "", model_uri: str = "") -> ModelMeta:
         variables = [
@@ -209,15 +234,31 @@ class EmbeddingCollection:
                         mesh=self.mesh,
                         spec=self._shardings[name], rng=sub,
                         key_dtype=jnp.int32 if spec.key_dtype == "wide"
-                        else jnp.dtype(spec.key_dtype))
+                        else jnp.dtype(spec.key_dtype),
+                        wrap_cache=False)
                 else:
                     states[name] = st.create_sharded_table(
                         spec.meta(), self._optimizers[name],
                         self._initializers[name], mesh=self.mesh,
-                        spec=self._shardings[name], rng=sub)
+                        spec=self._shardings[name], rng=sub,
+                        wrap_cache=False)
             return states
 
-        return jax.jit(_create_all)(rng)
+        states = jax.jit(_create_all)(rng)
+        # hot-row replicas attach eagerly (all-pad: zero hits until the
+        # first HotCacheManager refresh admits keys)
+        for name in states:
+            states[name] = self.wrap_hot_cache(name, states[name])
+        return states
+
+    def wrap_hot_cache(self, name: str, table_state):
+        """Attach an empty (all-pad) hot-row replica to a bare table state
+        when ``name`` is on the ``"a2a+cache"`` plane; pass-through
+        otherwise. The checkpoint loader and serving restore use this too
+        — the replica is derived state, never checkpointed."""
+        from .parallel import hot_cache
+        return hot_cache.attach_empty(table_state, self._shardings[name],
+                                      self.mesh)
 
     def state_shardings(self) -> Dict[str, Any]:
         """NamedShardings for every state leaf (for jit in/out_shardings)."""

@@ -132,7 +132,10 @@ def make_fused_specs(feature_names: Sequence[str],
                      num_shards: int = -1,
                      plane: str = "a2a",
                      a2a_capacity: int = 0,
-                     a2a_slack: float = 2.0
+                     a2a_slack: float = 2.0,
+                     cache_k: int = 0,
+                     cache_refresh_every: int = 64,
+                     cache_decay: float = 0.8
                      ) -> Tuple[Tuple[EmbeddingSpec, ...], FusedMapper]:
     """Specs + mapper for one fused table over ``feature_names``.
 
@@ -161,7 +164,9 @@ def make_fused_specs(feature_names: Sequence[str],
         dtype=dtype, optimizer=optimizer, initializer=emb_init,
         hash_capacity=hash_capacity, key_dtype=key_dtype,
         num_shards=num_shards, plane=plane,
-        a2a_capacity=a2a_capacity, a2a_slack=a2a_slack)]
+        a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
+        cache_k=cache_k, cache_refresh_every=cache_refresh_every,
+        cache_decay=cache_decay)]
     if need_linear:
         specs.append(EmbeddingSpec(
             name=name + LINEAR_SUFFIX, input_dim=input_dim, output_dim=1,
@@ -169,5 +174,7 @@ def make_fused_specs(feature_names: Sequence[str],
             initializer={"category": "constant", "value": 0.0},
             hash_capacity=hash_capacity, key_dtype=key_dtype,
             num_shards=num_shards, plane=plane,
-            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack))
+            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
+            cache_k=cache_k, cache_refresh_every=cache_refresh_every,
+            cache_decay=cache_decay))
     return tuple(specs), mapper

@@ -580,13 +580,8 @@ def apply_gradients(state: HashTableState,
     w = jnp.where(inserted[:, None], fresh, w)
     s = {k: jnp.take(v, safe_slot, axis=0) for k, v in state.slots.items()}
 
-    compute = jnp.promote_types(state.weights.dtype, jnp.float32)
-    new_w, new_s = optimizer.update_rows(
-        w.astype(compute),
-        {k: v.astype(jnp.promote_types(v.dtype, jnp.float32)) for k, v in s.items()},
-        summed.astype(compute), counts)
-    new_w = new_w.astype(state.weights.dtype)
-    new_s = {k: new_s[k].astype(state.slots[k].dtype) for k in new_s}
+    new_w, new_s = table_lib.optimizer_block_update(optimizer, w, s,
+                                                    summed, counts)
 
     oob = jnp.asarray(state.capacity, jnp.int32)
     scatter_idx = jnp.where(ok, safe_slot, oob)

@@ -43,7 +43,10 @@ def make_feature_specs(feature_names: Sequence[str],
                        num_shards: int = -1,
                        plane: str = "a2a",
                        a2a_capacity: int = 0,
-                       a2a_slack: float = 2.0) -> Tuple[EmbeddingSpec, ...]:
+                       a2a_slack: float = 2.0,
+                       cache_k: int = 0,
+                       cache_refresh_every: int = 64,
+                       cache_decay: float = 0.8) -> Tuple[EmbeddingSpec, ...]:
     """Build the spec list for a set of categorical features.
 
     ``vocab_sizes``: int per feature, or a single int, or -1 for the hash
@@ -63,7 +66,9 @@ def make_feature_specs(feature_names: Sequence[str],
             name=name, input_dim=vocab, output_dim=embedding_dim,
             dtype=dtype, optimizer=optimizer, initializer=emb_init,
             hash_capacity=hash_capacity, num_shards=num_shards, plane=plane,
-            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack))
+            a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
+            cache_k=cache_k, cache_refresh_every=cache_refresh_every,
+            cache_decay=cache_decay))
         if need_linear:
             specs.append(EmbeddingSpec(
                 name=name + LINEAR_SUFFIX, input_dim=vocab, output_dim=1,
@@ -71,7 +76,9 @@ def make_feature_specs(feature_names: Sequence[str],
                 initializer={"category": "constant", "value": 0.0},
                 hash_capacity=hash_capacity, num_shards=num_shards,
                 plane=plane, a2a_capacity=a2a_capacity,
-                a2a_slack=a2a_slack))
+                a2a_slack=a2a_slack, cache_k=cache_k,
+                cache_refresh_every=cache_refresh_every,
+                cache_decay=cache_decay))
     return tuple(specs)
 
 

@@ -399,7 +399,8 @@ class ShardedOffloadedTable:
                  keep_fraction: float = 0.5,
                  backing_dir: Optional[str] = None,
                  persist_compress: str = "",
-                 seed: int = 0):
+                 seed: int = 0,
+                 overflow_check_every_n_batches: int = 0):
         from .parallel import sharded_hash as sh
         self.name = name
         self.meta = meta
@@ -412,6 +413,16 @@ class ShardedOffloadedTable:
         self.vocab = int(vocab)
         self.cache_capacity = int(cache_capacity)
         self.persist_pending_window = persist_pending_window
+        # bounded-lag overflow detection for loops that never reach a
+        # natural join point (hand-driven steps, fit() without
+        # persist_dir): every N batches note_update pays ONE device round
+        # trip (~105 ms on a degraded tunnel link — amortizable at
+        # N >= ~64) to read the deferred overflow counter. 0 (default)
+        # keeps detection at join points only (flush/persist/restore/
+        # finish/_evict — see check_overflow).
+        self.overflow_check_every_n_batches = int(
+            overflow_check_every_n_batches)
+        self._batches_since_overflow_check = 0
         self.occupancy_threshold = occupancy_threshold
         self.keep_fraction = keep_fraction
         from .utils import compress as compress_lib
@@ -670,25 +681,41 @@ class ShardedOffloadedTable:
         self._overflow_latest = cache.insert_failures + jnp.int32(0)
         return cache
 
-    def check_overflow(self) -> None:
+    def check_overflow(self, cache=None) -> None:
         """Read the cache's cumulative insert-overflow counter; raises
         if any insert since creation (or the last eviction rebuild, which
         checks before discarding) ever overflowed a probe window.
 
         This is a JOIN-POINT operation — ``flush``/``persist``/
         ``restore``/``finish``/``_evict`` — and deliberately has no
-        per-step counterpart: every device read is a synchronous round
-        trip (~105 ms over a degraded tunnel link), and one per table per
-        step is what serialized the whole tier in rounds 3-5
-        (tools/offload_diag7.py). ``fit(persist_dir=...)`` reaches a
-        join every ``persist_pending_window`` batches; hand-driven loops
-        at ``finish()``. The counter is cleared only after a SUCCESSFUL
-        read, so a transient device failure does not lose the evidence."""
-        if self._overflow_latest is None:
+        automatic per-step counterpart: every device read is a
+        synchronous round trip (~105 ms over a degraded tunnel link), and
+        one per table per step is what serialized the whole tier in
+        rounds 3-5 (tools/offload_diag7.py). ``fit(persist_dir=...)``
+        reaches a join every ``persist_pending_window`` batches;
+        hand-driven loops at ``finish()`` — or every
+        ``overflow_check_every_n_batches`` steps when that knob is set
+        (``note_update`` drives it). The counter is cleared only after a
+        SUCCESSFUL read, so a transient device failure does not lose the
+        evidence.
+
+        ``cache``: when the caller holds the LIVE cache state
+        (flush/_evict/persist), its ``insert_failures`` counter is read
+        directly — strictly more complete than the ``_overflow_latest``
+        copy taken at the last host-side insert, which misses failures
+        the jitted step's gradient-apply auto-insert accumulated since
+        (e.g. out-of-range batch ids; see the _start_writeback guard).
+        Same single device round trip either way."""
+        if cache is not None:
+            v = cache.insert_failures
+        elif self._overflow_latest is not None:
+            v = self._overflow_latest
+        else:
             return
-        v = self._overflow_latest
         overflowed = int(jax.device_get(v)) > 0   # may raise; keep v
+        # the cumulative live counter subsumes any older copy
         self._overflow_latest = None
+        self._batches_since_overflow_check = 0
         if overflowed:
             raise RuntimeError(
                 f"offloaded table {self.name!r}: HBM cache insert "
@@ -860,11 +887,13 @@ class ShardedOffloadedTable:
         never delete, so eviction = writeback + rebuild-from-host)."""
         self._join_writeback()
         # eviction DISCARDS the cache (create_cache zeroes the cumulative
-        # insert_failures) — read the pending overflow evidence first, or
-        # an overflow between the last join point and this rebuild would
-        # vanish; eviction is already a synchronous join, so the device
-        # round trip costs nothing extra here
-        self.check_overflow()
+        # insert_failures) — read the pending overflow evidence from the
+        # LIVE counter first (the _overflow_latest copy misses failures
+        # the jitted step accumulated after the last host-side insert),
+        # or an overflow between the last join point and this rebuild
+        # would vanish; eviction is already a synchronous join, so the
+        # device round trip costs nothing extra here
+        self.check_overflow(cache)
         resident_ids = np.nonzero(self._resident)[0]
         keep_target = max(0, min(int(self.keep_fraction * budget),
                                  budget - incoming))
@@ -900,18 +929,29 @@ class ShardedOffloadedTable:
         """Record that the jitted step applied gradients for ``ids``
         (host-side dirty marks + work watermark advance). ``uniq`` skips
         the np.unique when the caller already holds this batch's unique
-        valid ids (a PreparedBatch carries them)."""
+        valid ids (a PreparedBatch carries them).
+
+        With ``overflow_check_every_n_batches`` set, every N-th call also
+        reads the deferred overflow counter (one device round trip,
+        amortized over N steps) so hand-driven loops and ``fit()``
+        without ``persist_dir`` detect an HBM-cache insert overflow
+        within N steps instead of only at ``finish()``."""
         if uniq is None:
             uniq = np.unique(np.asarray(ids).ravel())
             uniq = uniq[(uniq >= 0) & (uniq < self.vocab)]
         self._dirty[uniq] = True
         self.work_id += 1
         self._batches_since_persist += 1
+        n = self.overflow_check_every_n_batches
+        if n > 0:
+            self._batches_since_overflow_check += 1
+            if self._batches_since_overflow_check >= n:
+                self.check_overflow()
 
     # --- persistence --------------------------------------------------------
     def flush(self, cache) -> int:
         """Asynchronously write back all dirty rows (cache stays intact)."""
-        self.check_overflow()
+        self.check_overflow(cache)
         dirty_ids = np.nonzero(self._dirty)[0]
         if dirty_ids.size:
             self._start_writeback(cache, dirty_ids)
@@ -989,7 +1029,14 @@ class ShardedOffloadedTable:
 
     def restore(self, path: str):
         """Replay base + increments into the host store; returns a FRESH
-        empty cache state (pre-restore cache rows must not write back)."""
+        empty cache state (pre-restore cache rows must not write back).
+
+        RAISES on pending pre-restore overflow (a behavior change from
+        the earlier API, which silently cleared it): training before this
+        restore may have run on initializer rows for the failed keys, and
+        the same ``cache_capacity`` would overflow again after it — wrap
+        restore in the same RuntimeError handling as ``flush``/
+        ``finish`` if you use it as a recovery path."""
         self._join_writeback()
         self._join_persist()
         # surface any overflow the discarded cache accumulated — training

@@ -59,8 +59,8 @@ from ..ops import dedup
 from ..utils import observability
 
 
-def _record_stat(counter: str, local_value: jnp.ndarray,
-                 record: bool) -> None:
+def record_stat(counter: str, local_value: jnp.ndarray,
+                record: bool) -> None:
     """Gated host accumulation of routed-exchange statistics.
 
     ``record`` is the trace-time gate (callers thread
@@ -277,7 +277,7 @@ def exchange_pull(flat_idx: jnp.ndarray,
     pending, uniq_rows, left = one_round(pending0, acc0)
     # record the per-device residue: the callback fires on every device
     # shard, so the host accumulator sums locals into the global total
-    _record_stat("a2a_extra_entries_pull",
+    record_stat("a2a_extra_entries_pull",
                  jnp.sum(pending < num_shards).astype(jnp.int32),
                  record_stats)
     if cap < m:
@@ -386,7 +386,7 @@ def exchange_push(flat_idx: jnp.ndarray,
     spilled = lax.psum(local_spill, tuple(grid_axes))
     # per-device residue: the callback fires on every device shard, so the
     # host accumulator sums locals into the global total
-    _record_stat("a2a_extra_entries_push", local_spill, record_stats)
+    record_stat("a2a_extra_entries_push", local_spill, record_stats)
     return lax.cond(spilled == 0, routed, gathered, state)
 
 

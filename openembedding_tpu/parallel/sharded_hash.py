@@ -24,14 +24,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from ..meta import EmbeddingVariableMeta
 from ..utils import observability
+from ..utils.jaxcompat import shard_map
 from ..optim.initializers import make_initializer
 from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import hash_table as hash_lib
 from . import alltoall as a2a
+from . import hot_cache
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -44,14 +45,19 @@ class HashShardingSpec:
     max_probes: int = hash_lib.DEFAULT_MAX_PROBES
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
-    plane: str = "a2a"   # "a2a" | "psum"
+    plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache"
     a2a_capacity: int = 0
     a2a_slack: float = 2.0
     key_width: int = 32  # 64 = [n, 2] int32 (lo, hi) pairs, x64-off
+    cache_k: int = 0     # hot-row replica slots ("a2a+cache" plane)
+
+    @property
+    def is_cached(self) -> bool:
+        return self.plane == "a2a+cache"
 
     @property
     def shard_axes(self) -> tuple:
-        if self.plane == "a2a":
+        if self.plane in ("a2a", "a2a+cache"):
             return (self.data_axis, self.model_axis)
         return (self.model_axis,)
 
@@ -84,32 +90,53 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
                             plane: str = "a2a",
                             a2a_capacity: int = 0,
                             a2a_slack: float = 2.0,
-                            key_width: int = 32) -> HashShardingSpec:
-    """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum")."""
-    if plane not in ("a2a", "psum"):
+                            key_width: int = 32,
+                            cache_k: int = 0) -> HashShardingSpec:
+    """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum").
+
+    ``plane="a2a+cache"``: a2a layout plus a ``cache_k``-row hot-row replica
+    on every device (``parallel/hot_cache.py``); 0 picks the default size.
+    """
+    if plane not in ("a2a", "psum", "a2a+cache"):
         raise ValueError(f"unknown plane {plane!r}")
     if key_width not in (32, 64):
         raise ValueError(f"key_width must be 32 or 64, got {key_width}")
-    want = mesh.size if plane == "a2a" else mesh.shape[MODEL_AXIS]
+    want = mesh.shape[MODEL_AXIS] if plane == "psum" else mesh.size
     if num_shards == -1:
         num_shards = want
     if num_shards != want:
         raise ValueError(
             f"num_shards={num_shards} must equal the {plane}-plane shard "
             f"count {want} for this mesh (or pass -1)")
+    if plane == "a2a+cache" and cache_k <= 0:
+        cache_k = hot_cache.DEFAULT_CACHE_K
+    if plane != "a2a+cache":
+        cache_k = 0
     cap = hash_lib.round_capacity(-(-total_capacity // num_shards))
     return HashShardingSpec(num_shards=num_shards, capacity_per_shard=cap,
                             max_probes=max_probes, plane=plane,
                             a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
-                            key_width=key_width)
+                            key_width=key_width, cache_k=cache_k)
 
 
-def state_specs(optimizer: SparseOptimizer, dim: int, spec: HashShardingSpec):
+def table_state_specs(optimizer: SparseOptimizer, dim: int,
+                      spec: HashShardingSpec):
     row = spec.row_spec()
     return hash_lib.HashTableState(
         keys=row, weights=row,
         slots={name: row for name in optimizer.slot_shapes(dim)},
         init_rng=P(), insert_failures=P())
+
+
+def state_specs(optimizer: SparseOptimizer, dim: int, spec: HashShardingSpec):
+    table = table_state_specs(optimizer, dim, spec)
+    if spec.is_cached:
+        return hot_cache.CachedState(
+            table=table,
+            cache=hot_cache.HotCacheState(
+                keys=P(), rows=P(),
+                slots={name: P() for name in table.slots}))
+    return table
 
 
 def create_sharded_hash_table(meta: EmbeddingVariableMeta,
@@ -118,7 +145,8 @@ def create_sharded_hash_table(meta: EmbeddingVariableMeta,
                               mesh: Mesh,
                               spec: HashShardingSpec,
                               rng: Optional[jax.Array] = None,
-                              key_dtype=jnp.int32) -> hash_lib.HashTableState:
+                              key_dtype=jnp.int32,
+                              wrap_cache: bool = True):
     """Allocate per-shard empty hash tables across the mesh.
 
     The per-key deterministic init uses the shared base rng (not folded per
@@ -139,9 +167,16 @@ def create_sharded_hash_table(meta: EmbeddingVariableMeta,
 
     fn = shard_map(_init, mesh=mesh,
                    in_specs=(P(),),
-                   out_specs=state_specs(optimizer, dim, spec),
+                   out_specs=table_state_specs(optimizer, dim, spec),
                    check_vma=False)
-    return jax.jit(fn)(rng)
+    state = jax.jit(fn)(rng)
+    if wrap_cache:
+        # all-pad replica: zero hits (pure-a2a behavior) until the first
+        # admission refresh (hot_cache.HotCacheManager / build_cache).
+        # ``wrap_cache=False`` returns the bare table (callers composing
+        # their own jitted init wrap eagerly afterwards).
+        return hot_cache.attach_empty(state, spec, mesh)
+    return state
 
 
 def _mask_non_owned(spec: HashShardingSpec, flat: jnp.ndarray,
@@ -281,18 +316,15 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                   record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if spec.plane == "a2a" and spec.num_shards > 1:
+    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
-        def _pull(keys, weights, init_rng, idx):
+        def _pull_core(keys, weights, init_rng, flat):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
             local = hash_lib.HashTableState(
                 keys=keys, weights=weights, slots={}, init_rng=init_rng,
                 insert_failures=jnp.zeros((), jnp.int32))
-            flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
-            out_shape = (idx.shape[:-1] if spec.wide else idx.shape) \
-                + (dim,)
             sentinel = hash_lib.empty_key(flat.dtype)
 
             def resolve(q):
@@ -305,13 +337,40 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                 return jnp.where(valid, spec.owner_shard(q),
                                  spec.num_shards).astype(jnp.int32)
 
-            rows = a2a.exchange_pull(
+            return a2a.exchange_pull(
                 flat, resolve, owner, sentinel=sentinel, dim=dim,
                 num_shards=spec.num_shards, grid_axes=grid_axes,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
                 slack=spec.a2a_slack, record_stats=record_stats)
-            return rows.reshape(out_shape)
+
+        if spec.is_cached:
+            def _pull(keys, weights, init_rng, ckeys, crows, idx):
+                flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
+                out_shape = (idx.shape[:-1] if spec.wide else idx.shape) \
+                    + (dim,)
+                sentinel = hash_lib.empty_key(flat.dtype)
+                valid = (flat[:, 1] if spec.wide else flat) != sentinel
+                pos, hit = hot_cache.lookup(ckeys, flat, valid)
+                served = jnp.where(hit[:, None],
+                                   jnp.take(crows, pos, axis=0),
+                                   jnp.zeros((1, dim), crows.dtype))
+                hot_cache.record_cache_stats(
+                    hit, valid,
+                    entry_bytes=dim * crows.dtype.itemsize
+                    + (8 if spec.wide else 4),
+                    split_axes=split_axes, split_sizes=split_sizes,
+                    record=record_stats)
+                resid = hot_cache.mask_hits(flat, hit, sentinel)
+                rows = _pull_core(keys, weights, init_rng, resid)
+                return (rows + served).reshape(out_shape)
+        else:
+            def _pull(keys, weights, init_rng, idx):
+                flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
+                out_shape = (idx.shape[:-1] if spec.wide else idx.shape) \
+                    + (dim,)
+                return _pull_core(keys, weights, init_rng,
+                                  flat).reshape(out_shape)
     else:
         def _pull(keys, weights, init_rng, idx):
             local = hash_lib.HashTableState(
@@ -328,14 +387,18 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
             return rows.reshape(out_shape)
 
     row = spec.row_spec()
+    if spec.is_cached:
+        in_specs = (row, row, P(), P(), P(), batch_spec)
+    else:
+        in_specs = (row, row, P(), batch_spec)
     fn = shard_map(_pull, mesh=mesh,
-                   in_specs=(row, row, P(), batch_spec),
+                   in_specs=in_specs,
                    out_specs=batch_spec,
                    check_vma=False)
     return jax.jit(fn)
 
 
-def pull_sharded(state: hash_lib.HashTableState,
+def pull_sharded(state,
                  indices: jnp.ndarray,
                  initializer: Any,
                  *,
@@ -346,13 +409,24 @@ def pull_sharded(state: hash_lib.HashTableState,
 
     Missing-but-valid keys get their deterministic init row (computed only by
     the owner shard); EMPTY-sentinel keys return zero rows. ``initializer=
-    None`` = read-only serving contract (missing keys -> zeros).
+    None`` = read-only serving contract (missing keys -> zeros). On the
+    ``"a2a+cache"`` plane ``state`` is a :class:`hot_cache.CachedState`;
+    hot keys are served from the local replica (cached keys are always
+    PRESENT in the table — admission rejects absent ones — so the replica
+    can never shadow the deterministic-init contract).
     """
-    dim = state.weights.shape[-1]
+    record = observability.evaluate_performance()
     if initializer is not None:
         initializer = make_initializer(initializer)
-    fn = _pull_program(mesh, spec, initializer, dim, batch_sharded,
-                       observability.evaluate_performance())
+    if spec.is_cached:
+        table = state.table
+        dim = table.weights.shape[-1]
+        fn = _pull_program(mesh, spec, initializer, dim, batch_sharded,
+                           record)
+        return fn(table.keys, table.weights, table.init_rng,
+                  state.cache.keys, state.cache.rows, indices)
+    dim = state.weights.shape[-1]
+    fn = _pull_program(mesh, spec, initializer, dim, batch_sharded, record)
     return fn(state.keys, state.weights, state.init_rng, indices)
 
 
@@ -363,14 +437,14 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                    slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if spec.plane == "a2a" and spec.num_shards > 1:
+    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
-        def _apply(keys, weights, slots, init_rng, idx, g):
+        def _push_core(keys, weights, slots, init_rng, flat, g2):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
-            flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
-            sentinel = hash_lib.empty_key(flat.dtype)
+            sentinel = hash_lib.empty_key(
+                flat.dtype if not spec.wide else jnp.int32)
 
             def owner(q):
                 valid = (q[:, 1] if spec.wide else q) != sentinel
@@ -391,8 +465,8 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                 return (new.keys, new.weights, new.slots,
                         fails + new.insert_failures)
 
-            st = a2a.exchange_push(
-                flat, g.reshape(-1, dim),
+            return a2a.exchange_push(
+                flat, g2,
                 (keys, weights, slots, jnp.zeros((), jnp.int32)),
                 apply_fn, owner,
                 sentinel=sentinel, num_shards=spec.num_shards,
@@ -400,9 +474,58 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                 split_axes=split_axes, split_sizes=split_sizes,
                 capacity=spec.a2a_capacity, slack=spec.a2a_slack,
                 record_stats=record_stats)
-            tkeys, tweights, tslots, fails = st
-            return (tkeys, tweights, tslots,
-                    lax.psum(fails, spec.shard_axes))
+
+        if spec.is_cached:
+            def _apply(keys, weights, slots, init_rng, ckeys, crows,
+                       cslots, idx, g):
+                me = a2a.linear_shard_id(grid_axes, grid_sizes)
+                flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
+                g2 = g.reshape(-1, dim)
+                sentinel = hash_lib.empty_key(flat.dtype)
+                valid = (flat[:, 1] if spec.wide else flat) != sentinel
+                pos, hit = hot_cache.lookup(ckeys, flat, valid)
+                k = ckeys.shape[0]
+                summed, counts = hot_cache.cache_pre_reduce(
+                    pos, hit, g2, k, split_axes, split_sizes, grid_axes)
+                hot_cache.record_cache_stats(
+                    hit, valid,
+                    entry_bytes=dim * crows.dtype.itemsize
+                    + (12 if spec.wide else 8),
+                    split_axes=split_axes, split_sizes=split_sizes,
+                    record=record_stats)
+                resid = hot_cache.mask_hits(flat, hit, sentinel)
+                tkeys, tweights, tslots, fails = _push_core(
+                    keys, weights, slots, init_rng, resid, g2)
+                # identical psum'd totals on every device -> identical
+                # replica update everywhere; the owner scatters its rows
+                # back so the table stays authoritative
+                cache = hot_cache.HotCacheState(keys=ckeys, rows=crows,
+                                                slots=cslots)
+                cache = hot_cache.update_replica(optimizer, cache, summed,
+                                                 counts)
+                # owner write-back: admitted keys are PRESENT, so the
+                # probe hits; the scatter drops non-owned / untouched rows
+                mine_keys = _mask_non_owned(spec, ckeys, me)
+                slot = hash_lib.find_rows(tkeys, mine_keys,
+                                          spec.max_probes)
+                touched = (slot >= 0) & (counts > 0)
+                oob = jnp.asarray(tweights.shape[0], jnp.int32)
+                sc = jnp.where(touched, slot, oob)
+                tweights = tweights.at[sc].set(
+                    cache.rows.astype(tweights.dtype), mode="drop")
+                tslots = {name: tslots[name].at[sc].set(
+                    cache.slots[name].astype(tslots[name].dtype),
+                    mode="drop") for name in tslots}
+                return (tkeys, tweights, tslots, cache.rows, cache.slots,
+                        lax.psum(fails, spec.shard_axes))
+        else:
+            def _apply(keys, weights, slots, init_rng, idx, g):
+                flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
+                tkeys, tweights, tslots, fails = _push_core(
+                    keys, weights, slots, init_rng, flat,
+                    g.reshape(-1, dim))
+                return (tkeys, tweights, tslots,
+                        lax.psum(fails, spec.shard_axes))
     else:
         def _apply(keys, weights, slots, init_rng, idx, g):
             flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
@@ -424,15 +547,24 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
 
     row = spec.row_spec()
     slot_specs = {name: row for name in slot_names}
-    fn = shard_map(_apply, mesh=mesh,
-                   in_specs=(row, row, slot_specs, P(),
-                             batch_spec, batch_spec),
-                   out_specs=(row, row, slot_specs, P()),
-                   check_vma=False)
+    if spec.is_cached:
+        cache_slot_specs = {name: P() for name in slot_names}
+        fn = shard_map(_apply, mesh=mesh,
+                       in_specs=(row, row, slot_specs, P(), P(), P(),
+                                 cache_slot_specs, batch_spec, batch_spec),
+                       out_specs=(row, row, slot_specs, P(),
+                                  cache_slot_specs, P()),
+                       check_vma=False)
+    else:
+        fn = shard_map(_apply, mesh=mesh,
+                       in_specs=(row, row, slot_specs, P(),
+                                 batch_spec, batch_spec),
+                       out_specs=(row, row, slot_specs, P()),
+                       check_vma=False)
     return jax.jit(fn)
 
 
-def apply_gradients_sharded(state: hash_lib.HashTableState,
+def apply_gradients_sharded(state,
                             optimizer: SparseOptimizer,
                             initializer: Any,
                             indices: jnp.ndarray,
@@ -441,16 +573,37 @@ def apply_gradients_sharded(state: hash_lib.HashTableState,
                             mesh: Mesh,
                             spec: HashShardingSpec,
                             batch_sharded: bool = True,
-                            dedup_capacity: Optional[int] = None
-                            ) -> hash_lib.HashTableState:
-    """Distributed push+update: each key's grads reach its single owner shard."""
-    dim = state.weights.shape[-1]
+                            dedup_capacity: Optional[int] = None):
+    """Distributed push+update: each key's grads reach its single owner
+    shard. On the ``"a2a+cache"`` plane ``state`` is a
+    :class:`hot_cache.CachedState`: hot keys pre-reduce locally + one psum
+    over the K replica rows, and the owner writes the updated rows back."""
     optimizer = make_optimizer(optimizer)
     initializer = make_initializer(initializer) if initializer is not None \
         else None
+    record = observability.evaluate_performance()
+    if spec.is_cached:
+        table = state.table
+        dim = table.weights.shape[-1]
+        fn = _apply_program(mesh, spec, optimizer, initializer, dim,
+                            batch_sharded, dedup_capacity,
+                            tuple(table.slots), record)
+        keys, weights, slots, crows, cslots, failed = fn(
+            table.keys, table.weights, table.slots, table.init_rng,
+            state.cache.keys, state.cache.rows, state.cache.slots,
+            indices, grads)
+        new_table = hash_lib.HashTableState(
+            keys=keys, weights=weights, slots=slots,
+            init_rng=table.init_rng,
+            insert_failures=table.insert_failures + failed)
+        return hot_cache.CachedState(
+            table=new_table,
+            cache=hot_cache.HotCacheState(keys=state.cache.keys,
+                                          rows=crows, slots=cslots))
+    dim = state.weights.shape[-1]
     fn = _apply_program(mesh, spec, optimizer, initializer, dim,
                         batch_sharded, dedup_capacity, tuple(state.slots),
-                        observability.evaluate_performance())
+                        record)
     keys, weights, slots, failed = fn(
         state.keys, state.weights, state.slots, state.init_rng,
         indices, grads)

@@ -34,6 +34,11 @@ Data planes (``ShardingSpec.plane``):
   the data axis); pull = gather + psum, push = all_gather + masked local
   update. Simpler program, more ICI bytes and D-fold HBM replication; kept
   as the ablation baseline and for meshes where replicas are wanted.
+* ``"a2a+cache"`` — the a2a layout plus a frequency-tracked top-K hot-row
+  replica in every device's HBM (``parallel/hot_cache.py``): pulls for hot
+  keys are served locally with no exchange round, pushes pre-reduce
+  locally and merge with one psum over the K cached rows — exactly
+  equivalent to ``"a2a"``, built for Zipfian key streams.
 """
 
 from __future__ import annotations
@@ -49,15 +54,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from ..meta import EmbeddingVariableMeta
 from ..ops import dedup
 from ..utils import observability
+from ..utils.jaxcompat import shard_map
 from ..optim.initializers import make_initializer
 from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import table as table_lib
 from . import alltoall as a2a
+from . import hot_cache
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -70,14 +76,19 @@ class ShardingSpec:
     layout: str = "mod"  # "mod" | "div"
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
-    plane: str = "a2a"   # "a2a" | "psum"
+    plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache"
     a2a_capacity: int = 0    # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0   # auto capacity = slack * mean bucket size
+    cache_k: int = 0         # hot-row replica slots ("a2a+cache" plane)
+
+    @property
+    def is_cached(self) -> bool:
+        return self.plane == "a2a+cache"
 
     @property
     def shard_axes(self) -> tuple:
         """Mesh axes the table's row dimension is sharded over."""
-        if self.plane == "a2a":
+        if self.plane in ("a2a", "a2a+cache"):
             return (self.data_axis, self.model_axis)
         return (self.model_axis,)
 
@@ -104,29 +115,39 @@ def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
                        capacity: Optional[int] = None,
                        plane: str = "a2a",
                        a2a_capacity: int = 0,
-                       a2a_slack: float = 2.0) -> ShardingSpec:
+                       a2a_slack: float = 2.0,
+                       cache_k: int = 0) -> ShardingSpec:
     """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum").
 
     The reference's shard-per-server default (WorkerContext.cpp:66-85): on
     the a2a plane every chip is a "server", on the psum plane every model
     slice is one (its data-axis replicas mirror each other).
+
+    ``plane="a2a+cache"`` is the a2a layout plus a ``cache_k``-row hot-row
+    replica on every device (``parallel/hot_cache.py``); 0 picks the
+    default size.
     """
     if layout not in ("mod", "div"):
         raise ValueError(f"unknown layout {layout!r}")
-    if plane not in ("a2a", "psum"):
+    if plane not in ("a2a", "psum", "a2a+cache"):
         raise ValueError(f"unknown plane {plane!r}")
-    want = mesh.size if plane == "a2a" else mesh.shape[MODEL_AXIS]
+    want = mesh.shape[MODEL_AXIS] if plane == "psum" else mesh.size
     if num_shards == -1:
         num_shards = want
     if num_shards != want:
         raise ValueError(
             f"num_shards={num_shards} must equal the {plane}-plane shard "
             f"count {want} for this mesh (or pass -1)")
+    if plane == "a2a+cache" and cache_k <= 0:
+        cache_k = hot_cache.DEFAULT_CACHE_K
+    if plane != "a2a+cache":
+        cache_k = 0
     vocab = capacity if capacity is not None else meta.vocabulary_size
     rows_per_shard = math.ceil(vocab / num_shards)
     return ShardingSpec(num_shards=num_shards, rows_per_shard=rows_per_shard,
                         layout=layout, plane=plane,
-                        a2a_capacity=a2a_capacity, a2a_slack=a2a_slack)
+                        a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
+                        cache_k=cache_k)
 
 
 def create_sharded_table(meta: EmbeddingVariableMeta,
@@ -135,7 +156,8 @@ def create_sharded_table(meta: EmbeddingVariableMeta,
                          *,
                          mesh: Mesh,
                          spec: Optional[ShardingSpec] = None,
-                         rng: Optional[jax.Array] = None) -> table_lib.TableState:
+                         rng: Optional[jax.Array] = None,
+                         wrap_cache: bool = True):
     """Materialize a table sharded over the mesh model axis.
 
     Each device initializes only its own rows (PRNG folded with the shard
@@ -163,15 +185,35 @@ def create_sharded_table(meta: EmbeddingVariableMeta,
 
     fn = shard_map(_init, mesh=mesh,
                    in_specs=(P(),),
-                   out_specs=state_specs(optimizer, dim, spec),
+                   out_specs=table_state_specs(optimizer, dim, spec),
                    check_vma=False)
-    return jax.jit(fn)(rng)
+    state = jax.jit(fn)(rng)
+    if wrap_cache:
+        # all-pad replica: zero hits (pure-a2a behavior) until the first
+        # admission refresh (hot_cache.HotCacheManager / build_cache).
+        # ``wrap_cache=False`` returns the bare table (callers composing
+        # their own jitted init wrap eagerly afterwards).
+        return hot_cache.attach_empty(state, spec, mesh)
+    return state
 
 
-def state_specs(optimizer: SparseOptimizer, dim: int, spec: ShardingSpec):
+def table_state_specs(optimizer: SparseOptimizer, dim: int,
+                      spec: ShardingSpec):
     row = spec.row_spec()
     slot_spec = {name: row for name in optimizer.slot_shapes(dim)}
     return table_lib.TableState(weights=row, slots=slot_spec)
+
+
+def state_specs(optimizer: SparseOptimizer, dim: int, spec: ShardingSpec):
+    table = table_state_specs(optimizer, dim, spec)
+    if spec.is_cached:
+        # the replica is replicated on every device
+        return hot_cache.CachedState(
+            table=table,
+            cache=hot_cache.HotCacheState(
+                keys=P(), rows=P(),
+                slots={name: P() for name in table.slots}))
+    return table
 
 
 def state_shardings(state_specs, mesh: Mesh):
@@ -243,13 +285,14 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
 
     # single shard => nothing to route; the masked-local body below (whose
     # collectives are free over size-1 axes) skips the bucketing machinery
-    # (~25% faster on one chip for the headline config)
-    if spec.plane == "a2a" and spec.num_shards > 1:
+    # (~25% faster on one chip for the headline config). The cached plane
+    # always routes: its residue masking composes with the exchange.
+    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
         sentinel = dedup.FILL
 
-        def _pull(weights, idx):
+        def _pull_core(weights, idx):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
 
             def resolve(keys):
@@ -273,6 +316,25 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
                 slack=spec.a2a_slack, record_stats=record_stats)
             return rows.reshape(idx.shape + (dim,))
+
+        if spec.is_cached:
+            def _pull(weights, ckeys, crows, idx):
+                flat = idx.ravel()
+                valid = (flat >= 0) & (flat < spec.padded_vocab)
+                pos, hit = hot_cache.lookup(ckeys, flat, valid)
+                served = jnp.where(hit[:, None],
+                                   jnp.take(crows, pos, axis=0),
+                                   jnp.zeros((1, dim), crows.dtype))
+                hot_cache.record_cache_stats(
+                    hit, valid,
+                    entry_bytes=dim * crows.dtype.itemsize + 4,
+                    split_axes=split_axes, split_sizes=split_sizes,
+                    record=record_stats)
+                resid = hot_cache.mask_hits(flat, hit, sentinel)
+                rows = _pull_core(weights, resid).reshape(-1, dim)
+                return (rows + served).reshape(idx.shape + (dim,))
+        else:
+            _pull = _pull_core
     else:
         def _pull(weights, idx):
             s = lax.axis_index(spec.model_axis)
@@ -287,14 +349,18 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
             rows = lax.psum(rows, spec.model_axis)
             return rows.reshape(idx.shape + (dim,))
 
+    if spec.is_cached:
+        in_specs = (spec.row_spec(), P(), P(), batch_spec)
+    else:
+        in_specs = (spec.row_spec(), batch_spec)
     fn = shard_map(_pull, mesh=mesh,
-                   in_specs=(spec.row_spec(), batch_spec),
+                   in_specs=in_specs,
                    out_specs=batch_spec,
                    check_vma=False)
     return jax.jit(fn)
 
 
-def pull_sharded(state: table_lib.TableState,
+def pull_sharded(state,
                  indices: jnp.ndarray,
                  *,
                  mesh: Mesh,
@@ -306,11 +372,18 @@ def pull_sharded(state: table_lib.TableState,
     ``batch_sharded`` (the normal training path) else replicated. Returns
     rows with the same batch sharding. Equivalent to the reference's pull
     RPC fan-out + response scatter (EmbeddingPullOperator.cpp:40-252), as a
-    gather + one psum over ICI.
+    gather + one psum over ICI. On the ``"a2a+cache"`` plane ``state`` is a
+    :class:`hot_cache.CachedState`; hot keys are served from the local
+    replica and only the residue rides the exchange.
     """
+    record = observability.evaluate_performance()
+    if spec.is_cached:
+        dim = state.table.weights.shape[-1]
+        fn = _pull_program(mesh, spec, dim, batch_sharded, record)
+        return fn(state.table.weights, state.cache.keys, state.cache.rows,
+                  indices)
     dim = state.weights.shape[-1]
-    fn = _pull_program(mesh, spec, dim, batch_sharded,
-                       observability.evaluate_performance())
+    fn = _pull_program(mesh, spec, dim, batch_sharded, record)
     return fn(state.weights, indices)
 
 
@@ -321,13 +394,12 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                    slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if spec.plane == "a2a" and spec.num_shards > 1:
+    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
-        def _apply(weights, slots, idx, g):
+        def _push_core(weights, slots, flat, g2):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
-            local_state = table_lib.TableState(weights=weights, slots=slots)
 
             def owner(keys):
                 shard, _ = spec.shard_and_local(keys)
@@ -347,13 +419,54 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                 return new.weights, new.slots
 
             return a2a.exchange_push(
-                idx.ravel(), g.reshape(-1, dim),
-                (local_state.weights, local_state.slots), apply_fn, owner,
+                flat, g2,
+                (weights, slots), apply_fn, owner,
                 sentinel=dedup.FILL, num_shards=spec.num_shards,
                 grid_axes=grid_axes, grid_sizes=grid_sizes,
                 split_axes=split_axes, split_sizes=split_sizes,
                 capacity=spec.a2a_capacity, slack=spec.a2a_slack,
                 record_stats=record_stats)
+
+        if spec.is_cached:
+            def _apply(weights, slots, ckeys, crows, cslots, idx, g):
+                me = a2a.linear_shard_id(grid_axes, grid_sizes)
+                flat = idx.ravel()
+                g2 = g.reshape(-1, dim)
+                valid = (flat >= 0) & (flat < spec.padded_vocab)
+                pos, hit = hot_cache.lookup(ckeys, flat, valid)
+                k = ckeys.shape[0]
+                summed, counts = hot_cache.cache_pre_reduce(
+                    pos, hit, g2, k, split_axes, split_sizes, grid_axes)
+                hot_cache.record_cache_stats(
+                    hit, valid,
+                    entry_bytes=dim * crows.dtype.itemsize + 8,
+                    split_axes=split_axes, split_sizes=split_sizes,
+                    record=record_stats)
+                # residue rides the exchange with hits masked invalid
+                resid = hot_cache.mask_hits(flat, hit, dedup.FILL)
+                weights, slots = _push_core(weights, slots, resid, g2)
+                # identical psum'd totals on every device -> identical
+                # replica update everywhere; the owner scatters its rows
+                # back so the table stays authoritative
+                cache = hot_cache.HotCacheState(keys=ckeys, rows=crows,
+                                                slots=cslots)
+                cache = hot_cache.update_replica(optimizer, cache, summed,
+                                                 counts)
+                shard, local = spec.shard_and_local(ckeys)
+                ckv = (ckeys >= 0) & (ckeys < spec.padded_vocab)
+                mine = ckv & (shard == me) & (counts > 0)
+                oob = jnp.asarray(spec.rows_per_shard, local.dtype)
+                sc = jnp.where(mine, local, oob)
+                weights = weights.at[sc].set(
+                    cache.rows.astype(weights.dtype), mode="drop")
+                slots = {name: slots[name].at[sc].set(
+                    cache.slots[name].astype(slots[name].dtype),
+                    mode="drop") for name in slots}
+                return weights, slots, cache.rows, cache.slots
+        else:
+            def _apply(weights, slots, idx, g):
+                return _push_core(weights, slots, idx.ravel(),
+                                  g.reshape(-1, dim))
     else:
         def _apply(weights, slots, idx, g):
             s = lax.axis_index(spec.model_axis)
@@ -373,15 +486,24 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
             return new_state.weights, new_state.slots
 
     slot_specs = {name: spec.row_spec() for name in slot_names}
-    fn = shard_map(_apply, mesh=mesh,
-                   in_specs=(spec.row_spec(), slot_specs, batch_spec,
-                             batch_spec),
-                   out_specs=(spec.row_spec(), slot_specs),
-                   check_vma=False)
+    if spec.is_cached:
+        cache_slot_specs = {name: P() for name in slot_names}
+        fn = shard_map(_apply, mesh=mesh,
+                       in_specs=(spec.row_spec(), slot_specs, P(), P(),
+                                 cache_slot_specs, batch_spec, batch_spec),
+                       out_specs=(spec.row_spec(), slot_specs, P(),
+                                  cache_slot_specs),
+                       check_vma=False)
+    else:
+        fn = shard_map(_apply, mesh=mesh,
+                       in_specs=(spec.row_spec(), slot_specs, batch_spec,
+                                 batch_spec),
+                       out_specs=(spec.row_spec(), slot_specs),
+                       check_vma=False)
     return jax.jit(fn)
 
 
-def apply_gradients_sharded(state: table_lib.TableState,
+def apply_gradients_sharded(state,
                             optimizer: SparseOptimizer,
                             indices: jnp.ndarray,
                             grads: jnp.ndarray,
@@ -389,19 +511,33 @@ def apply_gradients_sharded(state: table_lib.TableState,
                             mesh: Mesh,
                             spec: ShardingSpec,
                             batch_sharded: bool = True,
-                            dedup_capacity: Optional[int] = None
-                            ) -> table_lib.TableState:
+                            dedup_capacity: Optional[int] = None):
     """Distributed push+update: every shard applies its owned rows.
 
     Data-axis devices all_gather the global (indices, grads) so the update is
     computed identically on every data replica of a model shard — replacing
     the reference's single-owner store RPC (WorkerContext.cpp:115-123) with
-    deterministic replicated application.
+    deterministic replicated application. On the ``"a2a+cache"`` plane
+    ``state`` is a :class:`hot_cache.CachedState`: hot keys pre-reduce
+    locally + one psum over the K replica rows (no exchange for them), and
+    the owner writes the updated rows back so the table stays authoritative.
     """
-    dim = state.weights.shape[-1]
     optimizer = make_optimizer(optimizer)
+    record = observability.evaluate_performance()
+    if spec.is_cached:
+        table = state.table
+        dim = table.weights.shape[-1]
+        fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
+                            dedup_capacity, tuple(table.slots), record)
+        weights, slots, crows, cslots = fn(
+            table.weights, table.slots, state.cache.keys, state.cache.rows,
+            state.cache.slots, indices, grads)
+        return hot_cache.CachedState(
+            table=table_lib.TableState(weights=weights, slots=slots),
+            cache=hot_cache.HotCacheState(keys=state.cache.keys,
+                                          rows=crows, slots=cslots))
+    dim = state.weights.shape[-1]
     fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
-                        dedup_capacity, tuple(state.slots),
-                        observability.evaluate_performance())
+                        dedup_capacity, tuple(state.slots), record)
     weights, slots = fn(state.weights, state.slots, indices, grads)
     return table_lib.TableState(weights=weights, slots=slots)

@@ -269,7 +269,8 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
         sspec = coll.sharding_spec(name)
         offset, total = 0, None
         if spec.use_hash:
-            state = states[name]
+            from ..parallel import hot_cache
+            state = hot_cache.unwrap(states[name])
             empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
             wide = hash_lib.is_wide(state.keys)
             while total is None or offset < total:
@@ -295,7 +296,8 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
                 raise RuntimeError(
                     f"peer restore of {name!r}: rows did not fit the "
                     "local hash capacity")
-            out[name] = state
+            # cached-plane variables get a fresh all-pad replica back
+            out[name] = coll.wrap_hot_cache(name, state)
         else:
             import jax.numpy as jnp
             dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
@@ -323,7 +325,8 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
                 weights = st.deliver_rows_sharded(
                     weights, jnp.asarray(phys_p), jnp.asarray(rows_p),
                     mesh=coll.mesh, spec=sspec)
-            out[name] = table_lib.TableState(weights=weights, slots={})
+            out[name] = coll.wrap_hot_cache(
+                name, table_lib.TableState(weights=weights, slots={}))
     model = ServingModel(sign, coll, out, meta, shard_slice=shard_slice)
     return registry.register_model(model)
 

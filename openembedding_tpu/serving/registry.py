@@ -72,7 +72,8 @@ class ServingModel:
         name = (variable if isinstance(variable, str)
                 else self._by_id[int(variable)])
         spec = self.collection.specs[name]
-        state = self.states[name]
+        from ..parallel import hot_cache
+        state = hot_cache.unwrap(self.states[name])
         if spec.use_hash:
             total = int(state.keys.shape[0])
             hi = min(offset + limit, total)

@@ -102,6 +102,27 @@ def pull(state: TableState, indices: jnp.ndarray) -> jnp.ndarray:
     return rows.reshape(indices.shape + (state.dim,))
 
 
+def optimizer_block_update(optimizer: SparseOptimizer,
+                           weights: jnp.ndarray,
+                           slots: Dict[str, jnp.ndarray],
+                           summed: jnp.ndarray,
+                           counts: jnp.ndarray):
+    """One vectorized optimizer step over a gathered [U, D] row block,
+    with the framework-wide storage-dtype contract: math runs at >=
+    float32 even for bfloat16 tables, results are cast back to each
+    array's storage dtype. Shared by the array/hash apply paths and the
+    hot-row replica update (``parallel/hot_cache.py``)."""
+    compute = jnp.promote_types(weights.dtype, jnp.float32)
+    new_w, new_s = optimizer.update_rows(
+        weights.astype(compute),
+        {k: v.astype(jnp.promote_types(v.dtype, jnp.float32))
+         for k, v in slots.items()},
+        summed.astype(compute), counts)
+    new_w = new_w.astype(weights.dtype)
+    new_s = {k: new_s[k].astype(slots[k].dtype) for k in new_s}
+    return new_w, new_s
+
+
 def apply_gradients(state: TableState,
                     optimizer: SparseOptimizer,
                     indices: jnp.ndarray,
@@ -136,15 +157,7 @@ def apply_gradients(state: TableState,
     w = jnp.take(state.weights, safe_uniq, axis=0)
     s = {k: jnp.take(v, safe_uniq, axis=0) for k, v in state.slots.items()}
 
-    # Optimizer math runs at >= float32 precision even for bfloat16 tables;
-    # results are cast back to each array's storage dtype before the scatter.
-    compute = jnp.promote_types(state.weights.dtype, jnp.float32)
-    new_w, new_s = optimizer.update_rows(
-        w.astype(compute),
-        {k: v.astype(jnp.promote_types(v.dtype, jnp.float32)) for k, v in s.items()},
-        summed.astype(compute), counts)
-    new_w = new_w.astype(state.weights.dtype)
-    new_s = {k: new_s[k].astype(state.slots[k].dtype) for k in new_s}
+    new_w, new_s = optimizer_block_update(optimizer, w, s, summed, counts)
 
     oob = jnp.asarray(state.capacity, dtype=safe_uniq.dtype)
     scatter_idx = jnp.where(valid, safe_uniq, oob)  # padding -> dropped

@@ -117,6 +117,20 @@ class Trainer:
         self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self._train_step = None
         self._eval_step = None
+        # hot-row replica admission drivers, one per "a2a+cache" variable:
+        # the frequency sketch observes every stepped batch and the replica
+        # refreshes every cache_refresh_every steps OUTSIDE the jitted step
+        # (parallel/hot_cache.py)
+        self._hot = {name: collection.make_hot_cache_manager(name)
+                     for name in collection.cached_names()}
+        # ':linear' twins observe the SAME id column as their base
+        # variable — share one sketch so the per-step host count (and the
+        # per-window decay) runs once; each twin keeps its own replica
+        for name, mgr in self._hot.items():
+            if name.endswith(":linear"):
+                base = self._hot.get(name[: -len(":linear")])
+                if base is not None:
+                    mgr.share_sketch(base)
         self.pipeline_depth = max(1, int(pipeline_depth))
         # in-flight lookahead prepares, oldest first; each entry's thread
         # CHAINS on the previous one, so host_prepare calls run strictly
@@ -221,10 +235,36 @@ class Trainer:
         state, metrics = self._train_step(state, self.shard_batch(batch))
         for name, table in self.offload.items():
             table.note_update(batch["sparse"][name], uniq=uniqs.get(name))
+        state = self._note_hot_cache(state, batch)
         if next_batch is not None and self.offload \
                 and not self._prep_started(next_batch):
             self._start_host_prepare(next_batch)
         return state, metrics
+
+    def _note_hot_cache(self, state: TrainState, batch) -> TrainState:
+        """Feed the hot-row admission sketches with this batch's keys and
+        refresh due replicas (host-side; the refresh re-gathers rows from
+        the authoritative table — never a writeback)."""
+        if not self._hot:
+            return state
+        emb = None
+        counted = set()
+        for name, mgr in self._hot.items():
+            col = batch["sparse"].get(name)
+            if col is None:
+                continue
+            if id(mgr.sketch) in counted:
+                mgr.tick()      # shared sketch: already counted this step
+            else:
+                mgr.observe(col)
+                counted.add(id(mgr.sketch))
+            if mgr.due:
+                if emb is None:
+                    emb = dict(state.emb)
+                emb[name] = mgr.refresh(emb[name])
+        if emb is not None:
+            state = state.replace(emb=emb)
+        return state
 
     def _prep_started(self, batch) -> bool:
         return any(e[1] is batch for e in self._preps)
@@ -372,6 +412,12 @@ class Trainer:
         Keeps up to ``pipeline_depth`` batches of offload host-prepare in
         flight ahead of the device (see :meth:`train_step` and
         ``pipeline_depth`` in the constructor).
+
+        Offload overflow-detection lag: without ``persist_dir`` the loop
+        reaches no natural join point, so an HBM-cache insert overflow
+        surfaces only at the final ``finish()`` — construct the
+        ShardedOffloadedTable with ``overflow_check_every_n_batches=N``
+        to bound detection to N steps (one amortized device read per N).
 
         ``persist_dir``: incremental-persist offloaded tables whenever they
         signal ``should_persist`` — the reference's AutoPersist callback

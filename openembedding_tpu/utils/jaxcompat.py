@@ -1,0 +1,58 @@
+"""Version bridges over the moving parts of the JAX API.
+
+The framework targets the current JAX surface (``jax.shard_map``,
+``jax_num_cpu_devices``); older installs (<= 0.4.x) carry the same
+machinery under different names (``jax.experimental.shard_map`` with
+``check_rep``, virtual host devices via ``--xla_force_host_platform_
+device_count``). Every call site imports from here so the whole mesh
+simulation and shard_map plane run unchanged on both.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+try:  # JAX >= 0.5: top-level export with the check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+except ImportError:  # <= 0.4.x: experimental module, kwarg named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+try:  # JAX >= 0.5: top-level scoped-x64 context manager
+    enable_x64 = jax.enable_x64
+except AttributeError:  # <= 0.4.x: experimental module, same signature
+    from jax.experimental import enable_x64
+
+
+def set_num_cpu_devices(n: int) -> None:
+    """Request ``n`` virtual CPU devices BEFORE the backend initializes.
+
+    Newer JAX has a first-class config; older versions only honor the
+    XLA host-platform flag, which must be in ``XLA_FLAGS`` when the
+    backend comes up (same before-first-use constraint as the config).
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        import re
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={int(n)}"
+        if "xla_force_host_platform_device_count" in flags:
+            # REPLACE a pre-existing (possibly different) count — silently
+            # keeping it would surface later as a mesh-size mismatch
+            flags = re.sub(
+                r"--?xla_force_host_platform_device_count=\d+", flag,
+                flags)
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = f"{flags} {flag}".strip()

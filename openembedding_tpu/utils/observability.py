@@ -104,6 +104,29 @@ def record_batch_stats(sparse: Dict[str, np.ndarray],
         acc.add("pull_unique", np.unique(arr).size)
 
 
+def cache_stats(accumulator: Optional[Accumulator] = None
+                ) -> Dict[str, float]:
+    """Hot-row replica-cache counters (``parallel/hot_cache.py``).
+
+    ``cache_hits``/``cache_misses`` count batch entries against the cached
+    set; ``ici_bytes_saved`` estimates exchange traffic the hits skipped
+    (entry granularity, pre-dedup). Recording is gated by
+    :func:`set_evaluate_performance`, like the a2a accumulators. The
+    derived ``cache_hit_rate`` is hits / (hits + misses).
+    """
+    snap = (accumulator or GLOBAL).snapshot()
+
+    def _count(name: str) -> float:
+        return snap.get(name, {}).get("count", 0.0)
+
+    hits = _count("cache_hits")
+    misses = _count("cache_misses")
+    total = hits + misses
+    return {"cache_hits": hits, "cache_misses": misses,
+            "ici_bytes_saved": _count("ici_bytes_saved"),
+            "cache_hit_rate": hits / total if total else 0.0}
+
+
 def _prom_name(name: str) -> str:
     out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
     return out.lstrip("0123456789_") or "metric"

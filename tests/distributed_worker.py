@@ -14,9 +14,12 @@ def main() -> int:
     rank = int(sys.argv[1])
     port = sys.argv[2]
     os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     import jax
+    from openembedding_tpu.utils.jaxcompat import set_num_cpu_devices
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    set_num_cpu_devices(2)
 
     from openembedding_tpu import distributed
     distributed.initialize(master_endpoint=f"127.0.0.1:{port}",

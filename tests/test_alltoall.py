@@ -475,11 +475,12 @@ def test_a2a_pull_ici_contract_16dev():
     import sys
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     code = f"""
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 16)
 import sys
 sys.path.insert(0, {root!r})
+import jax
+from openembedding_tpu.utils.jaxcompat import set_num_cpu_devices
+jax.config.update("jax_platforms", "cpu")
+set_num_cpu_devices(16)
 sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
 import jax.numpy as jnp
 from openembedding_tpu.parallel.mesh import create_mesh

@@ -16,6 +16,7 @@ from openembedding_tpu import EmbeddingVariableMeta, make_optimizer
 from openembedding_tpu import hash_table as ht
 from openembedding_tpu.parallel.mesh import create_mesh
 from openembedding_tpu.parallel import sharded_hash as sh
+from openembedding_tpu.utils import jaxcompat
 
 DIM = 4
 META = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
@@ -199,7 +200,7 @@ def test_widen_ids_matches_split64():
     assert m.shape == (2, 3, 2)
     # device int64 branch (x64 on): full width + int64 sentinel -> EMPTY
     import jax
-    with jax.enable_x64(True):
+    with jaxcompat.enable_x64(True):
         ids64 = np.array([2**33 + 7, -5, np.iinfo(np.int64).min], np.int64)
         got64 = np.asarray(ht.widen_ids(jnp.asarray(ids64)))
     np.testing.assert_array_equal(got64[:2], ht.split64(ids64[:2]))

@@ -1,0 +1,403 @@
+"""Hot-row replica cache ("a2a+cache" plane): exact equivalence + policy.
+
+The cache is a pure optimization — the acceptance bar is that the cached
+plane's parameters stay allclose to the uncached "a2a" plane on identical
+streams (Zipf and uniform, mod and div layouts, array and hash tables),
+with the admission/refresh machinery (frequency sketch, static-shape
+batch partition) unit-tested on its own.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import EmbeddingVariableMeta, make_optimizer
+from openembedding_tpu import hash_table as ht
+from openembedding_tpu.parallel.mesh import create_mesh
+from openembedding_tpu.parallel import sharded_table as st
+from openembedding_tpu.parallel import sharded_hash as sh
+from openembedding_tpu.parallel import hot_cache as hot
+from openembedding_tpu.utils import observability as obs
+
+VOCAB, DIM, B, K = 64, 4, 16, 16
+OPT = {"category": "adagrad", "learning_rate": 0.1}
+INIT = {"category": "constant", "value": 0.25}
+
+
+def _streams(rng, n):
+    """(zipf, uniform) id streams over [0, VOCAB) — the skew the cache
+    exists for, and the skew-free regression control."""
+    zipf = np.minimum(rng.zipf(1.3, size=(n, B)) - 1, VOCAB - 1)
+    uni = rng.randint(0, VOCAB, size=(n, B))
+    return zipf.astype(np.int32), uni.astype(np.int32)
+
+
+def _assert_tables_close(a, b):
+    np.testing.assert_allclose(np.asarray(a.weights), np.asarray(b.weights),
+                               rtol=1e-5, atol=1e-6)
+    for name in a.slots:
+        np.testing.assert_allclose(np.asarray(a.slots[name]),
+                                   np.asarray(b.slots[name]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("layout", ["mod", "div"])
+@pytest.mark.parametrize("stream", ["zipf", "uniform"])
+def test_array_cached_plane_matches_a2a(devices8, layout, stream):
+    """Same seeds -> allclose params after M steps, across a mid-run
+    admission refresh (array tables)."""
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=VOCAB)
+    opt = make_optimizer(OPT)
+    spec_a = st.make_sharding_spec(meta, mesh, layout=layout, plane="a2a")
+    spec_c = st.make_sharding_spec(meta, mesh, layout=layout,
+                                   plane="a2a+cache", cache_k=K)
+    sa = st.create_sharded_table(meta, opt, INIT, mesh=mesh, spec=spec_a)
+    sc = st.create_sharded_table(meta, opt, INIT, mesh=mesh, spec=spec_c)
+    assert isinstance(sc, hot.CachedState)
+
+    rng = np.random.RandomState(0)
+    zipf, uni = _streams(rng, 8)
+    ids = zipf if stream == "zipf" else uni
+    grads = rng.randn(8, B, DIM).astype(np.float32)
+    mgr = hot.HotCacheManager(mesh=mesh, spec=spec_c, k=K, refresh_every=3)
+
+    for s in range(8):
+        idx, g = jnp.asarray(ids[s]), jnp.asarray(grads[s])
+        ra = st.pull_sharded(sa, idx, mesh=mesh, spec=spec_a)
+        rc = st.pull_sharded(sc, idx, mesh=mesh, spec=spec_c)
+        np.testing.assert_allclose(np.asarray(ra), np.asarray(rc),
+                                   rtol=1e-5, atol=1e-6)
+        sa = st.apply_gradients_sharded(sa, opt, idx, g, mesh=mesh,
+                                        spec=spec_a)
+        sc = st.apply_gradients_sharded(sc, opt, idx, g, mesh=mesh,
+                                        spec=spec_c)
+        mgr.observe(ids[s])
+        if mgr.due:
+            sc = mgr.refresh(sc)
+    assert mgr.refreshes >= 2
+    _assert_tables_close(sa, sc.table)
+    # the replica itself must mirror the authoritative rows it covers
+    ck = np.asarray(sc.cache.keys)
+    live = ck >= 0
+    if live.any():
+        want = np.asarray(st.pull_sharded(
+            sa, jnp.asarray(ck[live]), mesh=mesh, spec=spec_a,
+            batch_sharded=False))
+        np.testing.assert_allclose(np.asarray(sc.cache.rows)[live], want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("key_width", [32, 64])
+def test_hash_cached_plane_matches_a2a(devices8, key_width):
+    """Hash tables (int32 and wide pair keys): allclose across refresh."""
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer(OPT)
+    spec_a = sh.make_hash_sharding_spec(mesh, 1024, plane="a2a",
+                                        key_width=key_width)
+    spec_c = sh.make_hash_sharding_spec(mesh, 1024, plane="a2a+cache",
+                                        key_width=key_width, cache_k=K)
+    sa = sh.create_sharded_hash_table(meta, opt, mesh=mesh, spec=spec_a)
+    sc = sh.create_sharded_hash_table(meta, opt, mesh=mesh, spec=spec_c)
+
+    rng = np.random.RandomState(1)
+    keys64 = (np.minimum(rng.zipf(1.3, size=(8, B)), 500) * 7919
+              ).astype(np.int64)
+    grads = rng.randn(8, B, DIM).astype(np.float32)
+
+    def to_idx(a):
+        if key_width == 64:
+            return jnp.asarray(ht.split64(a))
+        return jnp.asarray(a.astype(np.int32))
+
+    mgr = hot.HotCacheManager(mesh=mesh, spec=spec_c, k=K, refresh_every=3)
+    for s in range(8):
+        idx, g = to_idx(keys64[s]), jnp.asarray(grads[s])
+        ra = sh.pull_sharded(sa, idx, INIT, mesh=mesh, spec=spec_a)
+        rc = sh.pull_sharded(sc, idx, INIT, mesh=mesh, spec=spec_c)
+        np.testing.assert_allclose(np.asarray(ra), np.asarray(rc),
+                                   rtol=1e-5, atol=1e-6)
+        sa = sh.apply_gradients_sharded(sa, opt, INIT, idx, g, mesh=mesh,
+                                        spec=spec_a)
+        sc = sh.apply_gradients_sharded(sc, opt, INIT, idx, g, mesh=mesh,
+                                        spec=spec_c)
+        mgr.observe(keys64[s])
+        if mgr.due:
+            sc = mgr.refresh(sc)
+    assert mgr.refreshes >= 2
+    # all seen keys must read back identically on both planes
+    seen = np.unique(keys64.ravel())
+    ra = sh.pull_sharded(sa, to_idx(seen), None, mesh=mesh, spec=spec_a,
+                         batch_sharded=False)
+    rc = sh.pull_sharded(sc, to_idx(seen), None, mesh=mesh, spec=spec_c,
+                         batch_sharded=False)
+    np.testing.assert_allclose(np.asarray(ra), np.asarray(rc),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_cache_counters_zipf_hits_uniform_exact(devices8):
+    """observability exposes cache_hits / cache_misses / ici_bytes_saved;
+    the Zipf stream reports > 0 hits; the uniform stream stays numerically
+    exact (the regression criterion — hits are fine, wrong rows are not).
+    """
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=VOCAB)
+    opt = make_optimizer(OPT)
+    spec_c = st.make_sharding_spec(meta, mesh, plane="a2a+cache", cache_k=K)
+    sc = st.create_sharded_table(meta, opt, INIT, mesh=mesh, spec=spec_c)
+
+    rng = np.random.RandomState(2)
+    zipf, uni = _streams(rng, 4)
+    mgr = hot.HotCacheManager(mesh=mesh, spec=spec_c, k=K, refresh_every=1)
+    for s in range(3):
+        mgr.observe(zipf[s])
+    sc = mgr.refresh(sc)
+
+    obs.GLOBAL.reset()
+    obs.set_evaluate_performance(True)
+    try:
+        _ = st.pull_sharded(sc, jnp.asarray(zipf[3]), mesh=mesh,
+                            spec=spec_c)
+        sc = st.apply_gradients_sharded(
+            sc, opt, jnp.asarray(zipf[3]),
+            jnp.ones((B, DIM), jnp.float32), mesh=mesh, spec=spec_c)
+        jax.effects_barrier()
+        stats = obs.cache_stats()
+    finally:
+        obs.set_evaluate_performance(False)
+    assert stats["cache_hits"] > 0
+    assert stats["ici_bytes_saved"] > 0
+    assert stats["cache_hits"] + stats["cache_misses"] == 2 * B
+    assert 0.0 < stats["cache_hit_rate"] <= 1.0
+
+    # uniform stream: rows must match the uncached plane exactly even when
+    # some uniform ids happen to hit the cached set
+    spec_a = st.make_sharding_spec(meta, mesh, plane="a2a")
+    sa = st.create_sharded_table(meta, opt, INIT, mesh=mesh, spec=spec_a)
+    # bring the uncached twin to the same table state
+    sa = st.apply_gradients_sharded(
+        sa, opt, jnp.asarray(zipf[3]), jnp.ones((B, DIM), jnp.float32),
+        mesh=mesh, spec=spec_a)
+    ra = st.pull_sharded(sa, jnp.asarray(uni[0]), mesh=mesh, spec=spec_a)
+    rc = st.pull_sharded(sc, jnp.asarray(uni[0]), mesh=mesh, spec=spec_c)
+    np.testing.assert_allclose(np.asarray(ra), np.asarray(rc),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_freq_sketch_decay_and_admission():
+    """Decayed counts rank recent-hot over stale-hot; pruning bounds size."""
+    sk = hot.FreqSketch(decay=0.5, prune_below=0.4)
+    sk.update(np.array([1, 1, 1, 1, 2, 2, 3]))
+    assert sk.topk(2).tolist() == [1, 2]
+    # decay twice: old mass shrinks 4x; key 3 (count 1 -> 0.25) prunes out
+    sk.decay()
+    sk.decay()
+    assert 3 not in set(sk.topk(10).tolist())
+    # a newly-hot key overtakes the decayed old head
+    sk.update(np.array([9] * 5))
+    assert sk.topk(1).tolist() == [9]
+    # ties break deterministically (by key) so refreshes are stable
+    sk2 = hot.FreqSketch()
+    sk2.update(np.array([7, 5, 7, 5]))
+    assert sk2.topk(2).tolist() == [5, 7]
+
+
+def test_freq_sketch_max_entries_bound():
+    sk = hot.FreqSketch(decay=1.0, max_entries=100)
+    sk.update(np.repeat(np.arange(50), 3))        # the hot half
+    sk.update(np.arange(1000, 1101))              # cold tail trips the cap
+    assert len(sk) <= 100
+    assert set(sk.topk(50).tolist()) == set(range(50))
+
+
+def test_lookup_partition_static_shapes(devices8):
+    """The cached/uncached batch partition: hit mask + sentinel masking
+    reconstruct the batch exactly, narrow and wide, in-graph."""
+    # narrow: sorted keys with pad sentinels
+    keys = np.full(8, np.iinfo(np.int32).min, np.int32)
+    keys[:4] = [3, 7, 11, 40]
+    keys.sort()
+    q = jnp.asarray(np.array([7, 5, 40, -1, 3, 63], np.int32))
+    valid = (q >= 0) & (q < VOCAB)
+    pos, hit = hot.lookup(jnp.asarray(keys), q, valid)
+    np.testing.assert_array_equal(np.asarray(hit),
+                                  [True, False, True, False, True, False])
+    got = np.asarray(jnp.asarray(keys)[np.asarray(pos)])[np.asarray(hit)]
+    np.testing.assert_array_equal(got, [7, 40, 3])
+    resid = hot.mask_hits(q, hit, -1)
+    np.testing.assert_array_equal(np.asarray(resid), [-1, 5, -1, -1, -1, 63])
+
+    # wide: unsigned-u64 sort order, [n, 2] pair queries
+    cand = np.array([2**40 + 5, -3 & (2**64 - 1), 17, 2**33], np.uint64)
+    keys64 = np.sort(cand).astype(np.int64)
+    pad = np.int64(np.uint64(0x8000000080000000))
+    full = np.concatenate([keys64, [pad] * 4])
+    full = full[np.argsort(full.view(np.uint64))]
+    wkeys = jnp.asarray(ht.split64(full))
+    queries = np.array([17, 99, 2**40 + 5, -3], np.int64)
+    wq = jnp.asarray(ht.split64(queries))
+    wvalid = jnp.asarray(np.ones(4, bool))
+    _, whit = hot.lookup(wkeys, wq, wvalid)
+    np.testing.assert_array_equal(np.asarray(whit),
+                                  [True, False, True, True])
+    wres = hot.mask_hits(wq, whit, ht.empty_key(np.int32))
+    assert np.asarray(wres)[1, 1] != ht.empty_key(np.int32)   # miss kept
+    assert (np.asarray(wres)[[0, 2, 3], 1]
+            == ht.empty_key(np.int32)).all()                  # hits masked
+
+
+def test_build_cache_rejects_absent_hash_keys(devices8):
+    """Admission must drop candidates not present in the hash table — a
+    replica row would otherwise shadow the deterministic-init contract."""
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=DIM, vocabulary_size=2**63)
+    opt = make_optimizer(OPT)
+    spec_c = sh.make_hash_sharding_spec(mesh, 1024, plane="a2a+cache",
+                                        key_width=32, cache_k=8)
+    sc = sh.create_sharded_hash_table(meta, opt, mesh=mesh, spec=spec_c)
+    present = np.array([5, 9, 13], np.int64)
+    sc = sh.apply_gradients_sharded(
+        sc, opt, INIT, jnp.asarray(present.astype(np.int32)),
+        jnp.ones((3, DIM), jnp.float32), mesh=mesh, spec=spec_c,
+        batch_sharded=False)
+    cache = hot.build_cache(sc.table, np.array([5, 9, 777, 888], np.int64),
+                            8, mesh=mesh, spec=spec_c)
+    live = np.asarray(cache.keys) != np.iinfo(np.int32).min
+    assert set(np.asarray(cache.keys)[live].tolist()) == {5, 9}
+
+
+def test_hot_cache_tests_run_in_tier1_lane():
+    """Tier-1 marker check: this module must ride the standard
+    ``pytest -m 'not slow'`` lane — no module/class-level slow marks."""
+    import sys
+    mod = sys.modules[__name__]
+    marks = getattr(mod, "pytestmark", [])
+    assert not any(getattr(m, "name", "") == "slow" for m in marks)
+    for obj in vars(mod).values():
+        own = getattr(obj, "pytestmark", None)
+        if own:
+            assert not any(getattr(m, "name", "") == "slow" for m in own), \
+                f"{obj} is marked slow — hot-cache coverage is tier-1"
+
+
+def test_freq_sketch_dense_mode_matches_dict():
+    """The vectorized dense backing (bounded vocabs) ranks identically to
+    the dict sketch, including decay and deterministic tie order."""
+    dense = hot.FreqSketch(decay=0.5, dense_vocab=100)
+    sparse = hot.FreqSketch(decay=0.5)
+    rng = np.random.RandomState(3)
+    for _ in range(5):
+        ks = rng.randint(0, 100, 64)
+        dense.update(ks)
+        sparse.update(ks)
+    assert dense.topk(10).tolist() == sparse.topk(10).tolist()
+    dense.decay()
+    sparse.decay()
+    assert dense.topk(10).tolist() == sparse.topk(10).tolist()
+    # zero-count keys never qualify even when k exceeds the live set
+    tiny = hot.FreqSketch(dense_vocab=8)
+    tiny.update(np.array([3, 3, 5]))
+    assert set(tiny.topk(8).tolist()) == {3, 5}
+
+
+def test_cached_plane_checkpoint_roundtrip(devices8, tmp_path):
+    """Checkpoint dumps only the authoritative table (the replica is
+    derived state); load re-attaches an all-pad replica that the next
+    refresh re-populates."""
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as ckpt
+    mesh = create_mesh(2, 4, devices8)
+    specs = (EmbeddingSpec(name="v", input_dim=VOCAB, output_dim=DIM,
+                           plane="a2a+cache", cache_k=K, optimizer=OPT,
+                           initializer=INIT),)
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    assert isinstance(states["v"], hot.CachedState)
+    sspec = coll.sharding_spec("v")
+    idx = jnp.arange(16, dtype=jnp.int32)
+    states["v"] = st.apply_gradients_sharded(
+        states["v"], coll.optimizer("v"), idx,
+        jnp.ones((16, DIM), jnp.float32), mesh=mesh, spec=sspec)
+    mgr = coll.make_hot_cache_manager("v")
+    mgr.observe(np.arange(16, dtype=np.int32))
+    states["v"] = mgr.refresh(states["v"])
+
+    ckpt.save_checkpoint(str(tmp_path / "c"), coll, states)
+    loaded = ckpt.load_checkpoint(str(tmp_path / "c"), coll)
+    assert isinstance(loaded["v"], hot.CachedState)
+    np.testing.assert_allclose(np.asarray(loaded["v"].table.weights),
+                               np.asarray(states["v"].table.weights),
+                               rtol=1e-6, atol=1e-6)
+    assert (np.asarray(loaded["v"].cache.keys)
+            == np.iinfo(np.int32).min).all()
+    # and the reloaded state trains on the cached plane unchanged
+    out = st.pull_sharded(loaded["v"], idx, mesh=mesh, spec=sspec,
+                          batch_sharded=False)
+    want = st.pull_sharded(states["v"], idx, mesh=mesh, spec=sspec,
+                           batch_sharded=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_trainer_wires_hot_cache(devices8):
+    """The Trainer auto-builds managers for a2a+cache variables, feeds the
+    sketch every step, and refreshes in place — the whole wiring the
+    plane-level tests drive by hand."""
+    import optax
+    from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.models import deepctr
+    mesh = create_mesh(2, 4, devices8)
+    feats = ("u",)
+    specs = deepctr.make_feature_specs(
+        feats, VOCAB, DIM, plane="a2a+cache", cache_k=K,
+        cache_refresh_every=2, optimizer=OPT)
+    coll = EmbeddingCollection(specs, mesh)
+    tr = Trainer(deepctr.build_model("lr", feats), coll, optax.sgd(0.1))
+    rng = np.random.RandomState(4)
+    zipf, _ = _streams(rng, 5)
+    batches = [{"label": (rng.rand(B) > 0.5).astype(np.float32),
+                "dense": rng.randn(B, 3).astype(np.float32),
+                "sparse": {"u": z, "u:linear": z}} for z in zipf]
+    state = tr.init(jax.random.PRNGKey(0), tr.shard_batch(batches[0]))
+    for b in batches:
+        state, _m = tr.train_step(state, b)
+    assert set(tr._hot) == {"u", "u:linear"}
+    assert all(m.refreshes >= 2 for m in tr._hot.values())
+    for name in tr._hot:
+        cached = state.emb[name]
+        assert isinstance(cached, hot.CachedState)
+        live = np.asarray(cached.cache.keys) >= 0
+        assert live.any(), "refresh admitted nothing from the zipf stream"
+
+
+def test_export_dense_unwraps_cached_plane(devices8):
+    """export_dense must read through the replica wrapper (the derived
+    cache is not part of the dense export)."""
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as ckpt
+    mesh = create_mesh(2, 4, devices8)
+    specs = (EmbeddingSpec(name="v", input_dim=VOCAB, output_dim=DIM,
+                           plane="a2a+cache", cache_k=K, optimizer=OPT,
+                           initializer=INIT),)
+    coll = EmbeddingCollection(specs, mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    dense = ckpt.export_dense(coll, states)
+    assert dense["v"].shape == (VOCAB, DIM)
+    np.testing.assert_allclose(dense["v"], 0.25, rtol=1e-6)
+
+
+def test_freq_sketch_sampling_covers_structured_layouts():
+    """Stride sampling must not alias with a [B, F] batch's feature
+    period: over a refresh window every feature column gets observed."""
+    sk = hot.FreqSketch(decay=1.0, dense_vocab=64)
+    cap = hot.FreqSketch.SAMPLE_CAP
+    F = 26
+    B = (cap // F) + 200          # big enough that sampling kicks in
+    batch = np.tile(np.arange(F, dtype=np.int64)[None, :], (B, 1))
+    for _ in range(F):            # one refresh window of updates
+        sk.update(batch)
+    seen = set(sk.topk(F).tolist())
+    assert seen == set(range(F)), sorted(set(range(F)) - seen)

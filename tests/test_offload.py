@@ -611,8 +611,9 @@ _PIPELINE_KILL_CHILD = r"""
 import sys
 sys.path.insert(0, {root!r})
 import jax
+from openembedding_tpu.utils.jaxcompat import set_num_cpu_devices
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+set_num_cpu_devices(8)
 import numpy as np
 import optax
 from openembedding_tpu import (EmbeddingCollection, EmbeddingVariableMeta,
@@ -984,3 +985,45 @@ def test_gather_retry_when_evicted_mid_gather(devices8):
     assert prep.gen == t._gen and not prep.needs_evict
     t.cancel_prepared(prep)
     assert t._planned_count == 0
+
+
+def test_overflow_check_every_n_batches(devices8):
+    """Bounded-lag overflow detection (ADVICE r5): with the knob set, a
+    deferred insert overflow surfaces within N note_update calls — not
+    only at finish() — so hand-driven loops and fit() without persist_dir
+    keep a bounded detection lag."""
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    t = ShardedOffloadedTable(
+        "t", EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=512),
+        {"category": "sgd", "learning_rate": 1.0},
+        {"category": "constant", "value": 0.25},
+        vocab=512, cache_capacity=256, mesh=mesh,
+        overflow_check_every_n_batches=3)
+    t._overflow_latest = jnp.asarray(1, jnp.int32)  # deferred evidence
+    ids = np.array([1, 2], np.int32)
+    t.note_update(ids)
+    t.note_update(ids)  # lag stays below N: no device read yet
+    with pytest.raises(RuntimeError, match="insert overflow"):
+        t.note_update(ids)
+    # evidence drained by the raise; the run can unwind through finish()
+    t.finish()
+
+
+def test_check_overflow_prefers_live_cache_counter(devices8):
+    """flush (and _evict/persist) read the LIVE cache.insert_failures
+    (ADVICE r5): failures accumulated by the jitted step's gradient-apply
+    auto-insert AFTER the last host-side insert are caught even though
+    the _overflow_latest copy never saw them."""
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    t = _mk_sharded(mesh)
+    cache = t.create_cache(jax.random.PRNGKey(0))
+    assert t._overflow_latest is None  # no host-side insert happened
+    poisoned = cache.replace(insert_failures=jnp.asarray(2, jnp.int32))
+    with pytest.raises(RuntimeError, match="insert overflow"):
+        t.flush(poisoned)
+    # a clean cache passes, and the copy (None) is not consulted
+    assert t.flush(cache) == 0
+    t._join_writeback()
