@@ -67,6 +67,12 @@ def parse_args(argv=None):
                         "native mmap library serves)")
     p.add_argument("--load", default="", help="checkpoint dir to read")
     p.add_argument("--log_every", type=int, default=20)
+    p.add_argument("--retrace_budget", type=int, default=16,
+                   help="XLA compilations allowed after the two-step "
+                        "warmup (first hot-cache refresh and offload "
+                        "inserts legitimately compile a few programs); "
+                        "a trip prints a RuntimeWarning. -1 disables "
+                        "the guard (analysis/retrace.py)")
     p.add_argument("--config", default="",
                    help="EnvConfig JSON file (a2a bucket sizing, report "
                         "interval/gate; OE_* env vars overlay it)")
@@ -84,6 +90,7 @@ def main(argv=None):
 
     from openembedding_tpu import (EmbeddingCollection, Trainer,
                                    checkpoint as ckpt)
+    from openembedding_tpu.analysis.retrace import RetraceGuard
     from openembedding_tpu.data import criteo
     from openembedding_tpu.fused import make_fused_specs
     from openembedding_tpu.models import deepctr
@@ -213,14 +220,34 @@ def main(argv=None):
 
     t0 = time.time()
     n = 0
-    for i, b in enumerate([first] + list(it)):
-        if i >= args.steps:
-            break
-        with vtimer("train_step"):
-            state, m = trainer.train_step(state, b)
-        n += 1
-        if args.log_every and (i + 1) % args.log_every == 0:
-            print(f"step {i+1}: loss={float(m['loss']):.5f}")
+    guard = None
+    try:
+        for i, b in enumerate([first] + list(it)):
+            if i >= args.steps:
+                break
+            with vtimer("train_step"):
+                state, m = trainer.train_step(state, b)
+            n += 1
+            if n == 2 and args.retrace_budget >= 0:
+                # steady state starts after the two-step warmup (see
+                # Trainer.fit): every later compile is a retrace —
+                # budgeted so a shape wobble in the input pipeline shows
+                # up in CI logs instead of as a silent 100x step-time
+                # regression
+                guard = RetraceGuard(budget=args.retrace_budget,
+                                     name="criteo_deepctr loop",
+                                     on_exceed="warn")
+                guard.__enter__()
+            if args.log_every and (i + 1) % args.log_every == 0:
+                print(f"step {i+1}: loss={float(m['loss']):.5f}")
+    finally:
+        # warn mode: __exit__ never raises, so the finally is purely a
+        # leak guard (an abandoned guard would count compiles forever)
+        if guard is not None:
+            guard.__exit__(None, None, None)
+    if guard is not None:
+        print(f"retrace guard: {guard.compiles} post-warmup XLA "
+              f"compilation(s) (budget {args.retrace_budget})")
     if n:
         jax.block_until_ready(m["loss"])
         dt = time.time() - t0

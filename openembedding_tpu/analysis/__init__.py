@@ -1,0 +1,51 @@
+"""graftcheck: static analysis of the compiled programs and the source.
+
+Three enforcement layers, all mechanical (ISSUE 3):
+
+* :mod:`.contracts` — declarative per-plane contracts over compiled HLO
+  text: which collectives each data plane's pull/push/step program may
+  contain and how big their buffers may be, plus cross-cutting audits
+  (no f64 leaks, donation honored, no host transfers inside the step).
+* :mod:`.lint` — a jit-purity AST linter over the package's own source
+  (host-state mutation under trace, tracer materialization, retrace-risk
+  branches, undonated step functions). CLI: ``python -m tools.graftlint``.
+* :mod:`.retrace` — a runtime guard that counts XLA compilations around
+  a training loop and fails past a declared budget.
+
+Import discipline: ``contracts`` and ``lint`` are stdlib-only and
+imported eagerly, so every subsystem module (and the graftlint CLI) can
+use ``@host_fn`` / the parsers without paying for jax. ``retrace``
+(imports jax) and ``programs`` (lowers real programs) load lazily via
+module ``__getattr__`` — the public surface is unchanged.
+"""
+
+from . import contracts, lint
+from .contracts import (ContractViolation, ProgramContract, OpBudget,
+                        REGISTRY, check_program, collect_collectives,
+                        summarize, check_a2a_pull_hlo)
+from .lint import LintViolation, host_fn, lint_paths, lint_source
+
+_LAZY = {
+    "retrace": ".retrace", "programs": ".programs",
+    "RetraceBudgetExceeded": ".retrace", "RetraceGuard": ".retrace",
+}
+
+
+def __getattr__(name):  # PEP 562: defer the jax-importing submodules
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        if name in ("retrace", "programs"):
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "contracts", "lint", "retrace", "programs",
+    "ContractViolation", "ProgramContract", "OpBudget", "REGISTRY",
+    "check_program", "collect_collectives", "summarize",
+    "check_a2a_pull_hlo",
+    "LintViolation", "host_fn", "lint_paths", "lint_source",
+    "RetraceBudgetExceeded", "RetraceGuard",
+]

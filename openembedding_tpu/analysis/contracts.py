@@ -1,0 +1,443 @@
+"""Compiled-program contract registry: per-plane HLO audits.
+
+The framework's core guarantee is structural, not numerical: per-device
+ICI bytes on the a2a planes scale as O(slack * batch_slice * dim), never
+O(global_batch * dim) or O(table) (SURVEY §1; the reference's
+exchange-not-broadcast design, EmbeddingPullOperator.cpp:60-112). That
+property lives in the COMPILED program — a sharding-annotation regression
+shows up as an oversized ``all-gather`` in the pull HLO long before it
+shows up as a 10x ICI blowup on a real mesh. This module generalizes the
+original ``utils/hlocheck.py`` (still re-exported there) into a
+declarative registry: each (plane, program) pair declares its expected
+collective inventory and byte bounds, checked against compiled HLO text.
+
+Cross-cutting audits (any program):
+
+* :func:`check_no_f64` — no ``f64`` op anywhere (an x64 leak doubles
+  every table byte and halves MXU throughput);
+* :func:`check_donation` — the step program's ``input_output_alias``
+  header actually aliases the donated table buffers;
+* :func:`max_copy_bytes` — no full-table ``copy`` op (donation that XLA
+  silently declined);
+* :func:`check_no_host_transfers` — no infeed/outfeed/host-callback
+  custom-calls inside the jitted step (the hot-cache admission sketch
+  and the observability accumulators must stay host-side; a stray
+  callback stalls TPU pipelining every step).
+
+Byte semantics follow hlocheck: bounds apply to the largest SINGLE
+buffer of a collective (async ``-start`` tuples carry operand AND result
+buffers — summing would double-count), ops inside a ``while`` body count
+once (static program size), and ``-done`` ops are skipped (their result
+aliases the ``-start`` tuple).
+
+This module imports only the stdlib so every other module (including
+``parallel/*``) can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8}
+
+_COLLECTIVES = ("all-to-all", "all-gather", "all-reduce",
+                "collective-permute", "reduce-scatter")
+
+# post-optimization TPU HLO splits collectives into async -start/-done
+# pairs (`%x = (...) all-gather-start(...)`); match either form under the
+# base name, and skip -done ops (their result aliases the -start tuple —
+# counting both would double every byte)
+_OP_RE = re.compile(
+    r"= (?P<type>.*?) (?P<op>" + "|".join(_COLLECTIVES)
+    + r")(?P<suffix>-start|-done)?\(")
+_SHAPE_RE = re.compile(r"(" + "|".join(_DTYPE_BYTES) + r")\[([\d,]*)\]")
+
+# the one legitimate all-gather in a pull program re-assembles each data
+# slice's pulled rows on its model-axis peers; the partitioner may pad
+# the gathered dim, so bounds carry this slack factor
+ROW_ASSEMBLY_SLACK = 1.0625
+
+
+class ContractViolation(AssertionError):
+    """A compiled program broke its plane's declared contract."""
+
+
+# --- HLO text parsing (absorbed from utils/hlocheck.py) ----------------------
+
+def _type_bytes(type_str: str) -> Tuple[int, int]:
+    """(total bytes, largest single buffer bytes) of one HLO type string."""
+    total = largest = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        b = n * _DTYPE_BYTES[dtype]
+        total += b
+        largest = max(largest, b)
+    return total, largest
+
+
+def collect_collectives(hlo_text: str) -> List[Tuple[str, int, int]]:
+    """Collective ops in a compiled HLO dump as (op, bytes, max_buffer).
+
+    ``bytes`` sums the result type's buffers (all-to-all emits one per
+    peer); ``max_buffer`` is the largest SINGLE buffer — the size-bound
+    checks use it because async -start tuples carry operand AND result
+    buffers (summing would double-count). Ops inside a ``while`` body are
+    counted once (static program size): per-invocation shapes, not
+    dynamic step totals — exactly what the scaling contract is about.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m and m.group("suffix") != "-done":
+            total, largest = _type_bytes(m.group("type"))
+            out.append((m.group("op"), total, largest))
+    return out
+
+
+def summarize(hlo_text: str) -> Dict[str, Tuple[int, int]]:
+    """op -> (count, total result bytes)."""
+    out: Dict[str, Tuple[int, int]] = {}
+    for op, b, _largest in collect_collectives(hlo_text):
+        c, t = out.get(op, (0, 0))
+        out[op] = (c + 1, t + b)
+    return out
+
+
+# --- cross-cutting audits ----------------------------------------------------
+
+def find_f64(hlo_text: str) -> List[str]:
+    """Lines carrying an f64 buffer — an x64 leak into the compiled plane."""
+    return [ln.strip() for ln in hlo_text.splitlines() if "f64[" in ln]
+
+
+def check_no_f64(hlo_text: str) -> None:
+    bad = find_f64(hlo_text)
+    if bad:
+        raise ContractViolation(
+            f"{len(bad)} f64 op(s) in the compiled program (x64 leak) — "
+            f"first: {bad[0][:200]}")
+
+
+_ALIAS_RE = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(?:may|must)-alias\)")
+
+
+def donated_params(hlo_text: str) -> Tuple[int, ...]:
+    """Parameter numbers the ``input_output_alias`` header aliases.
+
+    Donation declared at the jit boundary is a *request*; the header in
+    the post-optimization module is what XLA actually honored.
+    """
+    header = hlo_text.splitlines()[0] if hlo_text else ""
+    m = re.search(r"input_output_alias=\{(.*?)\},\s*\w+=", header)
+    blob = m.group(1) if m else header
+    return tuple(sorted({int(p) for p in _ALIAS_RE.findall(blob)}))
+
+
+def check_donation(hlo_text: str, min_aliased: int = 1) -> Tuple[int, ...]:
+    """The compiled module aliases at least ``min_aliased`` inputs to
+    outputs (table buffers updated in place, not copied per step)."""
+    aliased = donated_params(hlo_text)
+    if len(aliased) < min_aliased:
+        raise ContractViolation(
+            f"input_output_alias covers {len(aliased)} parameter(s) "
+            f"({aliased}) < required {min_aliased} — donation of the "
+            "table/state buffers was declined or never declared")
+    return aliased
+
+
+# the type is captured lazily like _OP_RE: async copy-start (and TPU
+# send/recv/infeed below) carry TUPLE result types with spaces — a \S+
+# capture would silently skip exactly the ops these audits exist for
+_COPY_RE = re.compile(r"= (?P<type>.*?) copy(?:-start)?\(")
+
+
+def max_copy_bytes(hlo_text: str) -> int:
+    """Largest single ``copy`` result buffer (0 if the program has none).
+
+    A copy the size of a table shard means XLA materialized a second
+    table per step — donation silently declined. The backend may insert
+    legitimate large copies of REPLICATED buffers (dense params), so
+    callers enforce ``max_copy_bytes(txt) < table_shard_bytes`` with a
+    model sized so table shards dominate every dense buffer
+    (``tests/test_analysis_contracts.py::test_train_step_contract`` and
+    the ``tools/graftcheck.py`` step audit both do).
+    """
+    worst = 0
+    for line in hlo_text.splitlines():
+        m = _COPY_RE.search(line)
+        if m:
+            _total, largest = _type_bytes(m.group("type"))
+            worst = max(worst, largest)
+    return worst
+
+
+_HOST_TRANSFER_RE = re.compile(
+    r"= .*? (infeed|outfeed|send|send-done|recv|recv-done)\(")
+
+
+def host_transfer_ops(hlo_text: str) -> List[str]:
+    """Host<->device transfer ops inside the program: infeed/outfeed,
+    HOST-side send/recv, and host-callback custom-calls
+    (jax.debug.callback / io_callback lower to
+    ``custom_call_target="xla_python_cpu_callback"`` and friends).
+
+    send/recv are also device-to-device channel ops (SPMD partitioners
+    decompose collective-permute into them), so those two only count
+    when the op carries ``is_host_transfer=true``.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _HOST_TRANSFER_RE.search(line)
+        if m:
+            op = m.group(1)
+            if op.startswith(("send", "recv")) \
+                    and "is_host_transfer=true" not in line:
+                continue
+            out.append(op)
+            continue
+        if "custom-call" in line and re.search(
+                r'custom_call_target="[^"]*(callback|host)[^"]*"', line):
+            out.append("host-callback")
+    return out
+
+
+def check_no_host_transfers(hlo_text: str) -> None:
+    ops = host_transfer_ops(hlo_text)
+    if ops:
+        raise ContractViolation(
+            f"compiled program contains host transfer op(s) {ops[:4]} — "
+            "host state (admission sketches, counters) must stay outside "
+            "the jitted step; a per-step callback stalls device "
+            "pipelining")
+
+
+# --- the per-plane registry --------------------------------------------------
+
+# A bound is a function of the program's static parameters. Every bound
+# receives the same params dict; the keys each plane consumes:
+#   batch_slice  entries per data-axis slice (global_batch / data axis)
+#   global_batch entries in the whole batch
+#   dim          embedding dim
+#   itemsize     row element bytes (4 for f32)
+#   cache_k      hot-row replica slots ("a2a+cache" only)
+#   num_shards   table shards (= mesh size on the a2a planes)
+Bound = Callable[[Mapping[str, int]], int]
+
+
+def _row_assembly(p: Mapping[str, int]) -> int:
+    # each data slice's pulled rows returned to its model-axis peers
+    return int(p["batch_slice"] * p["dim"] * p["itemsize"]
+               * ROW_ASSEMBLY_SLACK)
+
+
+def _global_prereduce(p: Mapping[str, int]) -> int:
+    # the push overflow fallback all_gathers every peer's pre-reduced
+    # slice: O(global_batch * dim) — paid only when structured key skew
+    # overflows the routed buckets, but the branch is compiled in
+    return int(p["global_batch"] * (p["dim"] + 2) * p["itemsize"]
+               * ROW_ASSEMBLY_SLACK)
+
+
+def _cache_psum(p: Mapping[str, int]) -> int:
+    # the K-row (grad sum, count) merge — O(cache_k * dim), batch-free
+    return int((p["cache_k"] + 1) * (p["dim"] + 1) * p["itemsize"]
+               * ROW_ASSEMBLY_SLACK)
+
+
+def _scalar(p: Mapping[str, int]) -> int:
+    # residue-loop pending counts / overflow flags: a few scalars
+    return 256
+
+
+def _batch_rows(p: Mapping[str, int]) -> int:
+    # psum-plane pull: rows for this device's batch slice, psum'd over
+    # the model axis — the plane's O(batch_slice * dim) broadcast cost
+    return int(p["batch_slice"] * (p["dim"] + 1) * p["itemsize"]
+               * ROW_ASSEMBLY_SLACK)
+
+
+def _global_batch_rows(p: Mapping[str, int]) -> int:
+    # psum-plane push: the full global batch gathered to every shard —
+    # the O(global_batch * dim) signature the a2a plane exists to kill
+    return int(p["global_batch"] * (p["dim"] + 2) * p["itemsize"]
+               * ROW_ASSEMBLY_SLACK)
+
+
+@dataclasses.dataclass(frozen=True)
+class OpBudget:
+    """Inventory entry for one collective op within one program."""
+
+    min_count: int = 0
+    max_count: Optional[int] = None
+    max_buffer: Optional[Bound] = None   # bound on the largest single buffer
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramContract:
+    """Declarative contract for one (plane, program) compiled HLO."""
+
+    plane: str
+    program: str                      # "pull" | "push" | "step"
+    ops: Mapping[str, OpBudget] = dataclasses.field(default_factory=dict)
+    forbid: Tuple[str, ...] = ()
+    no_f64: bool = True
+    no_host_transfers: bool = True
+    min_aliased: int = 0              # donation floor (step programs)
+
+    def check(self, hlo_text: str,
+              params: Mapping[str, int]) -> Dict[str, Tuple[int, int]]:
+        """Audit ``hlo_text`` against this contract; returns the
+        collective summary. Raises :class:`ContractViolation`."""
+        # one parse: summary and per-op largest buffer both derive from it
+        collected = collect_collectives(hlo_text)
+        summary: Dict[str, Tuple[int, int]] = {}
+        largest: Dict[str, int] = {}
+        for op, b, big in collected:
+            c, t = summary.get(op, (0, 0))
+            summary[op] = (c + 1, t + b)
+            largest[op] = max(largest.get(op, 0), big)
+        label = f"{self.plane}/{self.program}"
+        for op in self.forbid:
+            if op in summary:
+                raise ContractViolation(
+                    f"{label}: forbidden collective {op!r} present "
+                    f"(inventory: {summary})")
+        for op, budget in self.ops.items():
+            count = summary.get(op, (0, 0))[0]
+            if count < budget.min_count:
+                raise ContractViolation(
+                    f"{label}: expected >= {budget.min_count} {op!r} "
+                    f"op(s), found {count} (inventory: {summary}) — the "
+                    "plane's exchange structure is gone")
+            if budget.max_count is not None and count > budget.max_count:
+                raise ContractViolation(
+                    f"{label}: {count} {op!r} op(s) > allowed "
+                    f"{budget.max_count} (inventory: {summary})")
+            if budget.max_buffer is not None and op in largest:
+                bound = budget.max_buffer(params)
+                if largest[op] > bound:
+                    raise ContractViolation(
+                        f"{label}: {op!r} buffer of {largest[op]} bytes "
+                        f"> bound {bound} (params "
+                        f"{dict(params)}) — O(global_batch)/O(table) "
+                        "traffic has reappeared")
+        if self.no_f64:
+            check_no_f64(hlo_text)
+        if self.no_host_transfers:
+            check_no_host_transfers(hlo_text)
+        if self.min_aliased:
+            check_donation(hlo_text, self.min_aliased)
+        return summary
+
+
+REGISTRY: Dict[Tuple[str, str], ProgramContract] = {}
+
+
+def _register(c: ProgramContract) -> ProgramContract:
+    REGISTRY[(c.plane, c.program)] = c
+    return c
+
+
+# The a2a planes: owner exchange present, all-gather bounded by the row
+# re-assembly, all-reduce bounded by residue-loop scalars (pull) or the
+# K-row cache merge (cached push). The psum plane: NO all-to-all (that's
+# the point of the ablation), all-reduce/all-gather carry the
+# broadcast-style O(batch) signatures — inventoried so the baseline's
+# own shape is pinned too.
+_register(ProgramContract(
+    plane="a2a", program="pull",
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_row_assembly),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
+_register(ProgramContract(
+    plane="a2a", program="push",
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_global_prereduce),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
+_register(ProgramContract(
+    plane="a2a+cache", program="pull",
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_row_assembly),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
+_register(ProgramContract(
+    plane="a2a+cache", program="push",
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_global_prereduce),
+         "all-reduce": OpBudget(max_buffer=_cache_psum)}))
+_register(ProgramContract(
+    plane="psum", program="pull",
+    forbid=("all-to-all",),
+    ops={"all-reduce": OpBudget(min_count=1, max_buffer=_batch_rows)}))
+_register(ProgramContract(
+    plane="psum", program="push",
+    forbid=("all-to-all",),
+    ops={"all-gather": OpBudget(min_count=1,
+                                max_buffer=_global_batch_rows)}))
+# the whole train step: cross-cutting only (its collective inventory is
+# the union of its planes' + the dense-grad all-reduce); what the step
+# must prove is donation (tables updated in place) and host purity
+_register(ProgramContract(plane="any", program="step", min_aliased=1))
+
+
+def check_program(hlo_text: str, plane: str, program: str,
+                  **params) -> Dict[str, Tuple[int, int]]:
+    """Audit one compiled program against its registered contract.
+
+    ``params``: batch_slice, global_batch, dim, itemsize (default 4),
+    cache_k (cached plane), num_shards — whatever the plane's bounds
+    consume. Returns the collective summary; raises
+    :class:`ContractViolation` on any breach.
+    """
+    key = (plane, program)
+    if key not in REGISTRY:
+        raise KeyError(f"no contract registered for {key}; known: "
+                       f"{sorted(REGISTRY)}")
+    params.setdefault("itemsize", 4)
+    if program == "push" and "global_batch" not in params:
+        # never guess it from batch_slice: on a data>1 mesh that
+        # understates the overflow-fallback bound and raises spurious
+        # violations (programs.contract_params supplies both)
+        raise KeyError(
+            "push contracts need global_batch (the overflow-fallback "
+            "all-gather is O(global_batch * dim)); pass it explicitly "
+            "or use analysis.programs.contract_params")
+    return REGISTRY[key].check(hlo_text, params)
+
+
+# --- the original hlocheck entry point (kept verbatim for callers) -----------
+
+def check_a2a_pull_hlo(hlo_text: str, *, batch_slice: int, dim: int,
+                       itemsize: int = 4) -> Dict[str, Tuple[int, int]]:
+    """Enforce the a2a pull program's ICI contract; returns the summary.
+
+    * >= 1 ``all-to-all`` (the owner exchange actually compiled in — if
+      XLA or a plane regression replaced it with broadcast-style
+      collectives, the plane's whole point is gone);
+    * every ``all-gather`` result is bounded by the ROW-ASSEMBLY size
+      ``batch_slice * dim * itemsize`` (+6.25% partitioner padding slack):
+      the one legitimate gather returns each data-slice's pulled rows to
+      its model-axis peers. A table-sized or global-batch-sized gather
+      (the psum plane's O(global_batch * dim) signature) fails here.
+    """
+    summary = summarize(hlo_text)
+    if "all-to-all" not in summary:
+        raise AssertionError(
+            "a2a pull program compiled WITHOUT an all-to-all — the owner "
+            f"exchange is gone (collectives: {summary})")
+    bound = int(batch_slice * dim * itemsize * ROW_ASSEMBLY_SLACK)
+    for op, _total, largest in collect_collectives(hlo_text):
+        if op == "all-gather" and largest > bound:
+            raise AssertionError(
+                f"a2a pull program contains an all-gather buffer of "
+                f"{largest} bytes > row-assembly bound {bound} "
+                f"(batch_slice={batch_slice}, dim={dim}) — "
+                "O(global_batch)/O(table) traffic has reappeared on the "
+                "pull path")
+    return summary

@@ -1,0 +1,584 @@
+"""graftlint: a jit-purity AST linter for the package's own source.
+
+The compiled-program contracts (:mod:`.contracts`) catch structural
+regressions after the fact; this linter catches the *source* patterns
+that produce them — host state mutated under trace (a counter bump or
+FreqSketch touch inside a jitted step silently becomes a trace-time
+no-op or a per-step host callback), tracers materialized to Python
+(``.item()``/``np.*`` force a device sync per step), Python branches on
+traced values (one recompile per distinct value), and step functions
+jitted without donation (a full table copy per step).
+
+Scope and honesty: the linter reasons per-module and marks a function
+"traced" only when the module itself hands it to a tracing entry point —
+``jax.jit``, ``shard_map``, ``lax.cond/while_loop/scan/fori_loop/
+switch``, ``jax.grad/value_and_grad/vmap/checkpoint`` — directly, via a
+simple alias assignment, or by lexical nesting inside a traced function.
+Functions handed to ``jax.debug.callback`` / ``jax.pure_callback`` /
+``io_callback`` are host functions by construction and are exempt, as is
+anything decorated with :func:`host_fn`.
+
+Rules (each suppressible inline)::
+
+    JG001  host-state mutation inside a traced function
+    JG002  tracer materialized to host (.item()/.tolist()/np.* call)
+    JG003  Python control flow on a traced function's array argument
+    JG004  step function jitted without donate_argnums
+
+Suppression syntax — on the offending line or its enclosing ``def``
+line::
+
+    counters["steps"] += 1   # graftlint: disable=JG001
+    def step_fn(state):      # graftlint: disable=JG001,JG003
+
+CLI: ``python -m tools.graftlint openembedding_tpu/`` (nonzero exit on
+violations) — wired into the tier-1 lane.
+
+Stdlib-only on purpose: any module in the package (including
+``parallel/*``) may import :func:`host_fn` without cycles.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+def host_fn(fn):
+    """Mark a function as host-side by contract (never traced).
+
+    A documentation-grade no-op at runtime; the linter skips functions
+    carrying this decorator even when they are handed to a tracing entry
+    point, and the marker tells readers the function may freely touch
+    numpy / Python state (e.g. ``FusedMapper.fuse``,
+    ``FreqSketch.update``).
+    """
+    fn.__graftlint_host__ = True
+    return fn
+
+
+RULES: Dict[str, str] = {
+    "JG000": "file fails to parse (linted zero lines)",
+    "JG001": "host-state mutation inside a jit-traced function",
+    "JG002": "tracer materialized to host (.item()/.tolist()/np.*) "
+             "inside a jit-traced function",
+    "JG003": "Python control flow on an array argument of a jit-traced "
+             "function (retrace / concretization risk)",
+    "JG004": "step function jitted without donate_argnums "
+             "(full state copy per step)",
+}
+
+# entry points whose FUNCTION-VALUED argument positions are traced —
+# only those positions: marking every argument would catch carries and
+# operands that happen to share a name with a module-level def (a local
+# `init` passed to scan must not mark a host-side `def init`)
+_TRACE_ENTRIES: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,), "shard_map": (0,), "grad": (0,),
+    "value_and_grad": (0,), "vmap": (0,), "pmap": (0,),
+    "checkpoint": (0,), "custom_vjp": (0,), "custom_jvp": (0,),
+    "eval_shape": (0,), "named_call": (0,), "scan": (0,),
+    "while_loop": (0, 1), "cond": (1, 2), "fori_loop": (2,),
+    "switch": (1,),   # branches: ONE sequence at position 1
+}
+# keyword names that carry functions into those entries
+_TRACE_KWARGS = {"f", "fun", "body_fun", "cond_fun", "true_fun",
+                 "false_fun"}
+# entry points whose FIRST argument runs on HOST
+_HOST_ENTRIES = {"callback", "pure_callback", "io_callback",
+                 "host_callback"}
+
+# mutating method names; receivers resolving to non-local state trip
+# JG001. `.at[...].add/.set` (the functional-update idiom) is excluded
+# structurally, not by name.
+_MUTATORS = {"add", "add_time", "append", "extend", "update", "insert",
+             "setdefault", "pop", "popleft", "remove", "discard",
+             "clear", "observe", "increment", "write", "put"}
+
+# np.* members that are trace-safe metadata helpers, not materializers
+_NP_ALLOWED = {"dtype", "iinfo", "finfo", "ndim", "shape", "newaxis",
+               "pi", "inf", "nan", "float32", "float64", "int32",
+               "int64", "uint32", "uint64", "bool_", "integer",
+               "floating", "number", "ndarray"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?:=(?P<rules>[A-Z0-9, ]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class LintViolation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message} " \
+               f"[{RULES[self.rule]}]"
+
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule set (None = all rules) from comments."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = m.group("rules")
+            out[tok.start[0]] = (
+                {r.strip() for r in rules.split(",") if r.strip()}
+                if rules else None)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _call_target(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Call):
+        return _effective_target(func)[0]
+    return ""
+
+
+def _effective_target(expr: ast.expr) -> Tuple[str, Optional[ast.Call]]:
+    """(target name, kwarg-bearing Call or None) of a decorator/callee,
+    looking through ``partial``: ``@partial(jax.jit, donate_argnums=...)``
+    resolves to ('jit', <the partial Call>) — partial forwards its
+    kwargs, so donation checks read them off that Call."""
+    if isinstance(expr, ast.Call):
+        inner = _call_target(expr.func)
+        if inner == "partial" and expr.args:
+            return _call_target(expr.args[0]), expr
+        return inner, expr
+    return (expr.attr if isinstance(expr, ast.Attribute)
+            else expr.id if isinstance(expr, ast.Name) else ""), None
+
+
+def _has_host_decorator(node: ast.AST) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        if _call_target(dec) == "host_fn" or (
+                isinstance(dec, ast.Name) and dec.id == "host_fn"):
+            return True
+    return False
+
+
+def _has_trace_decorator(node: ast.AST) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        if _effective_target(dec)[0] in _TRACE_ENTRIES:
+            return True
+    return False
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """First pass: function defs, alias edges, traced/host name seeds."""
+
+    def __init__(self):
+        self.defs: Dict[str, List[ast.AST]] = {}
+        self.aliases: Dict[str, Set[str]] = {}
+        self.traced_names: Set[str] = set()
+        self.host_names: Set[str] = set()
+        self.jit_calls: List[ast.Call] = []
+        # (def node, decorator node) for every @jit / @partial(jit, ...)
+        # decorated function — JG004 must see these too, not just
+        # jit(step_fn) call sites
+        self.jit_decorated: List[Tuple[ast.AST, ast.AST]] = []
+
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        if _has_host_decorator(node):
+            self.host_names.add(node.name)
+        if _has_trace_decorator(node):
+            self.traced_names.add(node.name)
+        for dec in node.decorator_list:
+            name, call = _effective_target(dec)
+            if name == "jit":
+                self.jit_decorated.append((node, call or dec))
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        # alias edges: `_pull = _pull_core` makes marking transitive
+        if isinstance(node.value, ast.Name):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.aliases.setdefault(t.id, set()).add(node.value.id)
+                    self.aliases.setdefault(node.value.id, set()).add(t.id)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _mark(arg: ast.expr, into: Set[str]) -> None:
+        if isinstance(arg, ast.Name):
+            into.add(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            # `lax.scan(loop.body, ...)`: mark by method name
+            into.add(arg.attr)
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            # `lax.switch(i, [fa, fb], ...)`: branches ride a sequence
+            for e in arg.elts:
+                _ModuleIndex._mark(e, into)
+
+    def visit_Call(self, node):
+        target = _call_target(node.func)
+        if target in _TRACE_ENTRIES:
+            if target == "jit":
+                self.jit_calls.append(node)
+            for pos in _TRACE_ENTRIES[target]:
+                if pos < len(node.args):
+                    self._mark(node.args[pos], self.traced_names)
+            for kw in node.keywords:
+                if kw.arg in _TRACE_KWARGS:
+                    self._mark(kw.value, self.traced_names)
+        elif target in _HOST_ENTRIES:
+            if node.args:
+                self._mark(node.args[0], self.host_names)
+        self.generic_visit(node)
+
+
+def _close_over_aliases(names: Set[str], aliases: Dict[str, Set[str]]
+                        ) -> Set[str]:
+    work, seen = list(names), set(names)
+    while work:
+        n = work.pop()
+        for other in aliases.get(n, ()):
+            if other not in seen:
+                seen.add(other)
+                work.append(other)
+    return seen
+
+
+def _bound_names(target: ast.expr) -> Iterable[str]:
+    """Names a target expression actually BINDS: plain names and their
+    tuple/list/star destructurings — NOT the base of ``x[i] = ...`` or
+    ``x.a = ...`` (those mutate an existing object)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _bound_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from _bound_names(target.value)
+
+
+def _local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound inside the function: params + assignments + defs."""
+    out: Set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        out.add(a.arg)
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                out.update(_bound_names(t))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            out.update(_bound_names(node.target))
+        elif isinstance(node, ast.comprehension):
+            out.update(_bound_names(node.target))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            out.update(_bound_names(node.optional_vars))
+    return out
+
+
+def _array_params(fn: ast.AST) -> Set[str]:
+    """Parameters likely to be tracers: everything except ``self``/
+    ``cls`` and ``*``/``**`` catch-alls."""
+    args = fn.args
+    names = [a.arg for a in (args.posonlyargs + args.args
+                             + args.kwonlyargs)]
+    return {n for n in names if n not in ("self", "cls")}
+
+
+def _receiver_base(expr: ast.expr) -> Optional[ast.expr]:
+    """Innermost base of a dotted/subscripted receiver chain."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr
+
+
+def _is_functional_at(expr: ast.expr) -> bool:
+    """True for `x.at[...]` receivers (the jnp functional-update idiom)."""
+    return (isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Attribute)
+            and expr.value.attr == "at")
+
+
+class Linter:
+    """Single-file linter; :func:`lint_source` is the functional entry."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.violations: List[LintViolation] = []
+        self.suppress = _suppressions(source)
+
+    # -- suppression ---------------------------------------------------------
+    def _suppressed(self, rule: str, line: int,
+                    def_line: Optional[int]) -> bool:
+        for ln in (line, def_line):
+            if ln is None or ln not in self.suppress:
+                continue
+            rules = self.suppress[ln]
+            if rules is None or rule in rules:
+                return True
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, msg: str,
+              def_line: Optional[int] = None) -> None:
+        line = getattr(node, "lineno", 0)
+        if not self._suppressed(rule, line, def_line):
+            self.violations.append(
+                LintViolation(self.path, line, rule, msg))
+
+    # -- main ----------------------------------------------------------------
+    def run(self) -> List[LintViolation]:
+        try:
+            tree = ast.parse(self.source)
+        except SyntaxError as e:
+            self.violations.append(LintViolation(
+                self.path, e.lineno or 0, "JG000",
+                f"file does not parse: {e.msg}"))
+            return self.violations
+        index = _ModuleIndex()
+        index.visit(tree)
+        traced = _close_over_aliases(index.traced_names, index.aliases)
+        hosted = _close_over_aliases(index.host_names, index.aliases)
+        traced -= hosted
+
+        # collect traced def nodes (+ their lexical children)
+        traced_defs: List[ast.AST] = []
+        seen: Set[int] = set()
+
+        def add_with_children(fn: ast.AST):
+            if id(fn) in seen or _has_host_decorator(fn):
+                return
+            seen.add(id(fn))
+            traced_defs.append(fn)
+            for child in ast.walk(fn):
+                if (child is not fn
+                        and isinstance(child, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))
+                        and child.name not in hosted):
+                    add_with_children(child)
+
+        for name in traced:
+            for fn in index.defs.get(name, ()):
+                add_with_children(fn)
+
+        for fn in traced_defs:
+            self._check_traced_fn(fn)
+        for call in index.jit_calls:
+            self._check_jit_donation(call)
+        for fn, dec in index.jit_decorated:
+            self._check_decorator_donation(fn, dec)
+        return self.violations
+
+    # -- per-rule checks -----------------------------------------------------
+    def _check_traced_fn(self, fn: ast.AST) -> None:
+        local = _local_bindings(fn)
+        params = _array_params(fn)
+        own_nodes = self._own_statements(fn)
+        for node in own_nodes:
+            self._check_mutation(node, fn, local)
+            self._check_materialize(node, fn)
+            self._check_branch(node, fn, params)
+
+    def _own_statements(self, fn: ast.AST) -> List[ast.AST]:
+        """All nodes of ``fn`` excluding nested function bodies (they are
+        checked separately iff they are themselves traced)."""
+        out: List[ast.AST] = []
+
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                out.append(child)
+                walk(child)
+
+        walk(fn)
+        return out
+
+    def _check_mutation(self, node: ast.AST, fn: ast.AST,
+                        local: Set[str]) -> None:
+        def_line = fn.lineno
+        # assignment to non-local state: self.x = / module.attr = /
+        # GLOBAL[...] = — a local object's attribute/item is fine
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                    continue
+                base = _receiver_base(t)
+                if isinstance(base, ast.Name) \
+                        and base.id not in ("self", "cls") \
+                        and base.id in local:
+                    continue
+                self._emit(
+                    "JG001", node,
+                    "assignment to non-local state "
+                    f"`{ast.unparse(t)}` under trace runs once at "
+                    "trace time, not per step", def_line)
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            self._emit("JG001", node,
+                       f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                       f"{', '.join(node.names)}` inside a traced "
+                       "function mutates host state", def_line)
+        # a mutator call whose RESULT is discarded: `sketch.update(k)`,
+        # `GLOBAL.add(...)`. When the return value is consumed
+        # (`u, s = tx.update(...)`) the call is the functional idiom and
+        # makes no claim of side effect — skip it.
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute):
+            node = node.value
+            method = node.func.attr
+            if method not in _MUTATORS:
+                return
+            recv = node.func.value
+            if _is_functional_at(recv):
+                return                      # x.at[i].add(...) is pure
+            base = _receiver_base(recv)
+            if not isinstance(base, ast.Name):
+                return                      # chained receiver: no claim
+            if base.id not in ("self", "cls") and base.id in local:
+                return                      # local object, local effect
+            self._emit(
+                "JG001", node,
+                f"`{ast.unparse(node.func)}(...)` mutates host state "
+                "under trace (counters/sketches belong outside the "
+                "jitted step — see parallel/hot_cache.py)", def_line)
+
+    def _check_materialize(self, node: ast.AST, fn: ast.AST) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        def_line = fn.lineno
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("item", "tolist", "tobytes") \
+                    and not node.args:
+                self._emit(
+                    "JG002", node,
+                    f"`.{node.func.attr}()` forces a device sync per "
+                    "step inside a traced function", def_line)
+                return
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id in ("np", "numpy") \
+                    and node.func.attr not in _NP_ALLOWED:
+                self._emit(
+                    "JG002", node,
+                    f"`{ast.unparse(node.func)}(...)` runs on host; on "
+                    "a tracer it either fails or silently constant-"
+                    "folds at trace time", def_line)
+
+    def _check_branch(self, node: ast.AST, fn: ast.AST,
+                      params: Set[str]) -> None:
+        if not isinstance(node, (ast.If, ast.While)):
+            return
+        # only BARE argument names used directly as the condition or as
+        # a comparison operand trip the rule: `if x:`, `while x > 0:`.
+        # `if x.ndim == 2:` or `if is_wide(x):` are shape/metadata
+        # predicates — static at trace time, the supported config idiom.
+        hit: Set[str] = set()
+
+        def direct_names(expr: ast.expr):
+            if isinstance(expr, ast.Name):
+                hit.add(expr.id)
+            elif isinstance(expr, ast.BoolOp):
+                for v in expr.values:
+                    direct_names(v)
+            elif isinstance(expr, ast.UnaryOp):
+                direct_names(expr.operand)
+            elif isinstance(expr, ast.Compare):
+                for v in [expr.left] + list(expr.comparators):
+                    if isinstance(v, ast.Name):
+                        hit.add(v.id)
+
+        direct_names(node.test)
+        hit &= params
+        if hit:
+            kind = "if" if isinstance(node, ast.If) else "while"
+            self._emit(
+                "JG003", node,
+                f"`{kind}` on argument(s) {sorted(hit)} of a traced "
+                "function: concretization error or one recompile per "
+                "distinct value — use lax.cond/jnp.where, or hoist the "
+                "static config out of the traced signature", fn.lineno)
+
+    @staticmethod
+    def _is_step_name(name: str) -> bool:
+        # step / step_fn / train_step / step_impl — but NOT
+        # steps_per_epoch (anchored `^step($|_)`) and not eval steps
+        return bool(re.search(r"^step($|_)|(^|_)step(_fn)?$", name)) \
+            and not name.startswith("eval")
+
+    def _check_jit_donation(self, call: ast.Call) -> None:
+        if not call.args:
+            return
+        arg = call.args[0]
+        if not isinstance(arg, ast.Name) \
+                or not self._is_step_name(arg.id):
+            return
+        kwargs = {kw.arg for kw in call.keywords}
+        if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+            return
+        self._emit(
+            "JG004", call,
+            f"jax.jit({arg.id}) without donate_argnums: a step function "
+            "updating table state copies every table buffer each step",
+            None)
+
+    def _check_decorator_donation(self, fn: ast.AST,
+                                  dec: ast.AST) -> None:
+        """`@jax.jit` / `@partial(jax.jit, ...)` / `@jax.jit(...)` above a
+        step-named def: same donation requirement as the call form."""
+        if not self._is_step_name(fn.name):
+            return
+        kwargs = ({kw.arg for kw in dec.keywords}
+                  if isinstance(dec, ast.Call) else set())
+        if "donate_argnums" in kwargs or "donate_argnames" in kwargs:
+            return
+        self._emit(
+            "JG004", dec,
+            f"@jit on {fn.name} without donate_argnums: a step function "
+            "updating table state copies every table buffer each step",
+            fn.lineno)
+
+
+def lint_source(source: str, path: str = "<string>") -> List[LintViolation]:
+    """Lint one module's source text."""
+    return Linter(path, source).run()
+
+
+def lint_paths(paths: Sequence[str]) -> List[LintViolation]:
+    """Lint files and/or directory trees (``.py`` files, recursively)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        else:
+            files.append(p)
+    out: List[LintViolation] = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            out.extend(lint_source(fh.read(), f))
+    return out

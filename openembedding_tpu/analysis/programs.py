@@ -1,0 +1,135 @@
+"""Lower the framework's data-plane programs to compiled HLO text.
+
+Shared by ``tests/test_analysis_contracts.py`` and the
+``tools/graftcheck.py`` CI gate: build a collection on a mesh, lower the
+pull / push / train-step programs exactly as the training path runs them
+(batch-sharded inputs, batch-sharded outputs — a replicated output would
+force an artifact gather and fail the pull bound for the wrong reason),
+and return ``(hlo_text, params)`` ready for
+:func:`..analysis.contracts.check_program`.
+
+Imports of the wider package happen inside the functions (this module
+is part of ``analysis``, which the rest of the package may import at
+module level — see the package docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+CACHE_K = 128
+
+
+def _collection(mesh, plane: str, *, vocab: int, dim: int,
+                use_hash: bool):
+    from ..embedding import EmbeddingCollection, EmbeddingSpec
+    if use_hash:
+        spec = EmbeddingSpec(name="t", input_dim=-1, output_dim=dim,
+                             hash_capacity=vocab, plane=plane,
+                             cache_k=CACHE_K)
+    else:
+        spec = EmbeddingSpec(name="t", input_dim=vocab, output_dim=dim,
+                             plane=plane, cache_k=CACHE_K)
+    return EmbeddingCollection((spec,), mesh)
+
+
+def contract_params(mesh, *, batch: int, dim: int,
+                    itemsize: int = 4) -> Dict[str, int]:
+    from ..parallel.mesh import DATA_AXIS
+    data = mesh.shape[DATA_AXIS]
+    return {"batch_slice": batch // data, "global_batch": batch,
+            "dim": dim, "itemsize": itemsize, "cache_k": CACHE_K,
+            "num_shards": mesh.size}
+
+
+def lower_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
+               batch: int = 1024, use_hash: bool = False,
+               out_replicated: bool = False) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO of one plane's pull program on ``mesh``.
+
+    ``out_replicated=True`` deliberately breaks the output sharding
+    annotation (rows replicated instead of batch-sharded): XLA must then
+    gather the global batch onto every device — the regression shape the
+    a2a pull contract exists to catch. Test-only.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import DATA_AXIS
+    coll = _collection(mesh, plane, vocab=vocab, dim=dim,
+                       use_hash=use_hash)
+    states = coll.init(jax.random.PRNGKey(0))
+
+    def pull_fn(states, idx):
+        return coll.pull(states, {"t": idx})["t"]
+
+    idx = jax.device_put(jnp.zeros((batch,), jnp.int32),
+                         NamedSharding(mesh, P(DATA_AXIS)))
+    out_spec = P() if out_replicated else P(DATA_AXIS)
+    compiled = jax.jit(
+        pull_fn, out_shardings=NamedSharding(mesh, out_spec)
+    ).lower(states, idx).compile()
+    return compiled.as_text(), contract_params(mesh, batch=batch, dim=dim)
+
+
+def lower_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
+               batch: int = 1024,
+               use_hash: bool = False) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO of one plane's push (apply_gradients) program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import DATA_AXIS
+    coll = _collection(mesh, plane, vocab=vocab, dim=dim,
+                       use_hash=use_hash)
+    states = coll.init(jax.random.PRNGKey(0))
+
+    def push_fn(states, idx, grads):
+        return coll.apply_gradients(states, {"t": idx}, {"t": grads})
+
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    idx = jax.device_put(jnp.zeros((batch,), jnp.int32), sh)
+    grads = jax.device_put(jnp.zeros((batch, dim), jnp.float32), sh)
+    compiled = jax.jit(push_fn).lower(states, idx, grads).compile()
+    return compiled.as_text(), contract_params(mesh, batch=batch, dim=dim)
+
+
+def lower_train_step(mesh, plane: str = "a2a", *, vocab: int = 4096,
+                     dim: int = 8, batch: int = 256,
+                     model: str = "deepfm"
+                     ) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO of the Trainer's whole jitted train step.
+
+    The step contract audits cross-cutting properties: donation of the
+    state pytree honored (tables updated in place), no f64, no host
+    transfers smuggled into the step.
+    """
+    import numpy as np
+    import jax
+    import optax
+    from ..embedding import EmbeddingCollection
+    from ..models import deepctr
+    from ..training import Trainer
+    features = ("c0", "c1")
+    specs = deepctr.make_feature_specs(features, vocab, dim, plane=plane)
+    coll = EmbeddingCollection(
+        specs, mesh,
+        default_optimizer={"category": "adagrad", "learning_rate": 0.1})
+    trainer = Trainer(deepctr.build_model(model, features), coll,
+                     optax.adam(1e-2))
+    rng = np.random.RandomState(0)
+    batch_data = {
+        "label": rng.randint(0, 2, size=batch).astype(np.float32),
+        "dense": rng.randn(batch, 4).astype(np.float32),
+        "sparse": {f: rng.randint(0, vocab, size=batch).astype(np.int32)
+                   for f in features}
+    }
+    for f in features:
+        batch_data["sparse"][f + deepctr.LINEAR_SUFFIX] = \
+            batch_data["sparse"][f]
+    state = trainer.init(jax.random.PRNGKey(0),
+                         trainer.shard_batch(batch_data))
+    step = trainer._build_train_step()
+    compiled = step.lower(state,
+                          trainer.shard_batch(batch_data)).compile()
+    return compiled.as_text(), contract_params(mesh, batch=batch, dim=dim)

@@ -1,0 +1,148 @@
+"""Retrace guard: a compile-count budget around training loops.
+
+A steady-state training loop should compile NOTHING: every step reuses
+the jitted step program, every pull/push program is cached by its static
+config. A recompile per step — a shape wobble from an unpadded last
+batch, a Python value smuggled into a traced signature, an lru_cache key
+that includes a per-step object — silently turns a ~ms step into a
+~second step. The reference's answer is operational (jax_log_compiles
+eyeballing); this guard makes it mechanical: count XLA backend compiles
+over a scope and fail when they exceed the declared budget.
+
+Counting uses :mod:`jax.monitoring`'s duration events (the
+``/jax/core/compile/backend_compile_duration`` key fires once per real
+XLA compilation, cache hits fire nothing), so the guard is exact and
+costs nothing per step. Wired into :meth:`Trainer.fit`
+(``retrace_budget=``) and the deepctr example (``--retrace_budget``).
+
+Usage::
+
+    with RetraceGuard(budget=0, name="steady-state loop"):
+        for batch in batches:
+            state, metrics = trainer.train_step(state, batch)
+
+Nesting is supported; each guard counts every compile that happens while
+it is open (an inner guard's compiles are also the outer one's).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+_COMPILE_EVENT = "backend_compile"
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """More XLA compilations happened inside the guard than budgeted."""
+
+
+_lock = threading.Lock()
+_active: List["RetraceGuard"] = []
+_listener_registered = False
+
+
+def _on_event(event: str, duration_secs: float, **_kw) -> None:
+    if _COMPILE_EVENT not in event:
+        return
+    with _lock:
+        for guard in _active:
+            guard._compiles += 1
+
+
+def _ensure_listener() -> None:
+    """Register the module's single monitoring listener (idempotent).
+
+    jax.monitoring has no public unregister, so one listener stays
+    installed once any guard has been used; it is a no-op dict walk when
+    no guard is active.
+    """
+    global _listener_registered
+    with _lock:
+        if _listener_registered:
+            return
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _listener_registered = True
+
+
+class RetraceGuard:
+    """Context manager failing when XLA compiles exceed ``budget``.
+
+    ``budget`` is the number of compilations ALLOWED inside the scope
+    (0 = a steady-state loop that must be compile-free). ``on_exceed``:
+    ``"raise"`` (default) raises :class:`RetraceBudgetExceeded` on exit;
+    ``"warn"`` prints one warning and continues — the mode the example
+    wires in so a budget trip shows up in CI logs without killing a run
+    mid-epoch.
+    """
+
+    def __init__(self, budget: int = 0, *, name: str = "",
+                 on_exceed: str = "raise"):
+        if on_exceed not in ("raise", "warn"):
+            raise ValueError(f"on_exceed must be 'raise' or 'warn', "
+                             f"got {on_exceed!r}")
+        self.budget = int(budget)
+        self.name = name
+        self.on_exceed = on_exceed
+        self._compiles = 0
+        self._entered = False
+
+    @property
+    def compiles(self) -> int:
+        """XLA compilations observed so far inside this guard."""
+        return self._compiles
+
+    @property
+    def exceeded(self) -> bool:
+        return self._compiles > self.budget
+
+    def __enter__(self) -> "RetraceGuard":
+        if self._entered:
+            raise RuntimeError("RetraceGuard is not reentrant; create a "
+                               "new guard per scope")
+        _ensure_listener()
+        self._compiles = 0
+        self._entered = True
+        with _lock:
+            _active.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        with _lock:
+            if self in _active:
+                _active.remove(self)
+        self._entered = False
+        if exc_type is not None:
+            return False            # the original error is the story
+        if self.exceeded:
+            label = f" [{self.name}]" if self.name else ""
+            msg = (f"retrace budget exceeded{label}: {self._compiles} "
+                   f"XLA compilation(s) > budget {self.budget} — "
+                   "something in the loop retraces per step (shape "
+                   "wobble, Python value in a traced signature, or a "
+                   "program-cache key churning)")
+            if self.on_exceed == "raise":
+                raise RetraceBudgetExceeded(msg)
+            import warnings
+            warnings.warn(msg, RuntimeWarning, stacklevel=2)
+        return False
+
+
+def compile_count(fn, *args, **kwargs) -> int:
+    """Run ``fn(*args, **kwargs)`` and return how many XLA compilations
+    it triggered (a measurement helper for tests and diagnostics)."""
+    with RetraceGuard(budget=1 << 30) as g:
+        fn(*args, **kwargs)
+        n = g.compiles
+    return n
+
+
+def assert_no_recompiles(fn, *args, warmup: int = 1, **kwargs) -> None:
+    """Call ``fn`` ``warmup`` times, then once more under a zero-budget
+    guard: the steady-state invocation must be compile-free."""
+    for _ in range(max(0, warmup)):
+        fn(*args, **kwargs)
+    with RetraceGuard(budget=0, name=getattr(fn, "__name__", "fn")):
+        fn(*args, **kwargs)

@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .analysis.lint import host_fn
 from .embedding import EmbeddingSpec
 
 FUSED_NAME = "fields"
@@ -68,11 +69,14 @@ class FusedMapper:
     def total_vocab(self) -> int:
         return int(sum(self.vocab_sizes))
 
+    @host_fn
     def fuse(self, sparse: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Per-feature columns -> {name: [B, F] fused ids} (+ :linear copy).
 
-        Host-side (numpy): runs in the input pipeline like the reference's
-        dataset-map hashing (criteo_deepctr.py:202-240).
+        Host-side (numpy) BY CONTRACT (``@host_fn``): runs in the input
+        pipeline like the reference's dataset-map hashing
+        (criteo_deepctr.py:202-240); calling it on tracers inside a
+        jitted step is exactly what graftlint rule JG002 flags.
         """
         cols = [np.asarray(sparse[f]) for f in self.feature_names]
         ids = np.stack(cols, axis=1)  # [B, F]

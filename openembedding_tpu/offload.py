@@ -623,7 +623,7 @@ class ShardedOffloadedTable:
 
         The payload ships as ONE packed f32 buffer per chunk (keys bitcast
         into column 0) when dtypes allow — the per-step transfer count is
-        a measured cost on high-latency links (tools/offload_diag6.py) —
+        a measured cost on high-latency links (`python -m tools.offload_diag puts`) —
         with the generic per-array path as the fallback."""
         from .parallel import sharded_hash as sh
         chunk = 1 << 16
@@ -691,7 +691,7 @@ class ShardedOffloadedTable:
         automatic per-step counterpart: every device read is a
         synchronous round trip (~105 ms over a degraded tunnel link), and
         one per table per step is what serialized the whole tier in
-        rounds 3-5 (tools/offload_diag7.py). ``fit(persist_dir=...)``
+        rounds 3-5 (`python -m tools.offload_diag pipeline`). ``fit(persist_dir=...)``
         reaches a join every ``persist_pending_window`` batches;
         hand-driven loops at ``finish()`` — or every
         ``overflow_check_every_n_batches`` steps when that knob is set

@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..analysis.lint import host_fn
 from ..ops import dedup
 from ..utils import observability
 
@@ -73,6 +74,11 @@ def record_stat(counter: str, local_value: jnp.ndarray,
     the gate is turned off.
     """
     if record:
+        # _cb runs on HOST via jax.debug.callback — the one sanctioned
+        # escape hatch for counters (graftlint exempts callback
+        # functions; the compiled-program audit sees the resulting
+        # custom-call, which is why contracts are checked against the
+        # default record-off programs)
         def _cb(d):
             if observability.evaluate_performance():
                 observability.GLOBAL.add(counter, int(d))
@@ -390,6 +396,7 @@ def exchange_push(flat_idx: jnp.ndarray,
     return lax.cond(spilled == 0, routed, gathered, state)
 
 
+@host_fn
 def routing_overflow(indices, num_shards: int, slice_parts: int,
                      owner_of, capacity: int = 0, slack: float = 2.0) -> int:
     """Host-side diagnostic: how many uniques spill past round 1's buckets?

@@ -59,8 +59,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import hash_table as hash_lib
 from .. import table as table_lib
+from ..analysis.lint import host_fn
 from ..utils.jaxcompat import shard_map
 from . import alltoall as a2a
+
+
+def _reject_tracer(x, where: str) -> None:
+    """The admission plane is host-side BY CONTRACT: a tracer reaching it
+    means someone moved sketch/counter maintenance inside a jitted step —
+    the exact regression graftlint rule JG001 flags statically. Fail with
+    the design pointer instead of numpy's opaque TracerArrayConversion."""
+    if isinstance(x, jax.core.Tracer):
+        raise TypeError(
+            f"{where} received a JAX tracer: the frequency sketch must be "
+            "fed OUTSIDE the jitted step (host-side admission is what "
+            "keeps the cache plane's ICI contract — see the module "
+            "docstring and analysis/lint.py JG001)")
 
 DEFAULT_CACHE_K = 512
 
@@ -441,9 +455,11 @@ class FreqSketch:
     # RANKS (the only thing admission consumes) at ~0.5 ms
     SAMPLE_CAP = 16384
 
+    @host_fn
     def update(self, keys: np.ndarray) -> None:
         """Count one batch's (valid, in-range) keys (stride-sampled past
         :attr:`SAMPLE_CAP` entries — ranking-preserving)."""
+        _reject_tracer(keys, "FreqSketch.update")
         flat = np.asarray(keys).ravel()
         if flat.size > self.SAMPLE_CAP:
             stride = flat.size // self.SAMPLE_CAP + 1
@@ -571,7 +587,9 @@ class HotCacheManager:
         return arr[(arr != np.iinfo(np.int32).min)
                    & (arr != np.iinfo(np.int64).min)]
 
+    @host_fn
     def observe(self, ids) -> None:
+        _reject_tracer(ids, "HotCacheManager.observe")
         keys = self._valid_keys(ids)
         if keys.size:
             self.sketch.update(keys)

@@ -261,7 +261,7 @@ def _insert_packed_program(mesh: Mesh, spec: HashShardingSpec,
     EVERY step; one coalesced transfer replaces 2+len(slots) separate
     host->device arrays — fewer dispatches on any link, and on the
     tunneled bench chip per-transfer latency is the measurable cost
-    (tools/offload_diag6.py). The unpack (slice + bitcast) fuses into
+    (`python -m tools.offload_diag puts`). The unpack (slice + bitcast) fuses into
     the insert program."""
 
     def _insert(tkeys, tweights, tslots, init_rng, packed):
@@ -391,6 +391,9 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
         in_specs = (row, row, P(), P(), P(), batch_spec)
     else:
         in_specs = (row, row, P(), batch_spec)
+    # plane-identifiable HLO module name for the contract audits
+    # (analysis/contracts.py): failures name the plane that regressed
+    _pull.__name__ = f"hash_pull_{spec.plane.replace('+', '_')}"
     fn = shard_map(_pull, mesh=mesh,
                    in_specs=in_specs,
                    out_specs=batch_spec,
@@ -547,6 +550,7 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
 
     row = spec.row_spec()
     slot_specs = {name: row for name in slot_names}
+    _apply.__name__ = f"hash_push_{spec.plane.replace('+', '_')}"
     if spec.is_cached:
         cache_slot_specs = {name: P() for name in slot_names}
         fn = shard_map(_apply, mesh=mesh,

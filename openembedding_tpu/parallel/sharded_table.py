@@ -353,6 +353,10 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
         in_specs = (spec.row_spec(), P(), P(), batch_spec)
     else:
         in_specs = (spec.row_spec(), batch_spec)
+    # plane-identifiable HLO module name (jit names the module after the
+    # callable): a contract-audit failure then says WHICH plane's
+    # program regressed (analysis/contracts.py)
+    _pull.__name__ = f"pull_{spec.plane.replace('+', '_')}"
     fn = shard_map(_pull, mesh=mesh,
                    in_specs=in_specs,
                    out_specs=batch_spec,
@@ -486,6 +490,7 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
             return new_state.weights, new_state.slots
 
     slot_specs = {name: spec.row_spec() for name in slot_names}
+    _apply.__name__ = f"push_{spec.plane.replace('+', '_')}"
     if spec.is_cached:
         cache_slot_specs = {name: P() for name in slot_names}
         fn = shard_map(_apply, mesh=mesh,

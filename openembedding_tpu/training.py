@@ -30,6 +30,7 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .analysis.retrace import RetraceGuard
 from .embedding import EmbeddingCollection
 from .parallel.mesh import DATA_AXIS
 
@@ -406,12 +407,23 @@ class Trainer:
         return f"{self.model_uuid}-{int(jax.device_get(state.step))}"
 
     def fit(self, state: TrainState, batches, *, log_every: int = 0,
-            log_fn=print, persist_dir: Optional[str] = None):
+            log_fn=print, persist_dir: Optional[str] = None,
+            retrace_budget: Optional[int] = None):
         """Simple host loop over an iterable of batches (model.fit analogue).
 
         Keeps up to ``pipeline_depth`` batches of offload host-prepare in
         flight ahead of the device (see :meth:`train_step` and
         ``pipeline_depth`` in the constructor).
+
+        ``retrace_budget``: XLA compilations allowed after a TWO-step
+        warmup (step 1 compiles the step program; step 2 may legally
+        recompile once — its input is step 1's output, whose shardings/
+        layouts can differ from the init-time state). A steady-state
+        loop should need 0 unless it refreshes hot-row replicas or
+        inserts offload chunks of new sizes; a budget trip raises
+        :class:`analysis.retrace.RetraceBudgetExceeded` at the end of
+        the loop — the mechanical version of watching jax_log_compiles
+        (analysis/retrace.py).
 
         Offload overflow-detection lag: without ``persist_dir`` the loop
         reaches no natural join point, so an HBM-cache insert overflow
@@ -442,6 +454,7 @@ class Trainer:
 
         refill()
         i = 0
+        guard = None
         try:
             while window:
                 # prepare the whole window through the chain — head
@@ -456,6 +469,15 @@ class Trainer:
                 refill()
                 state, metrics = self.train_step(state, batch)
                 last = metrics
+                if retrace_budget is not None and guard is None and i >= 1:
+                    # two-step warmup: step 1 compiles the step program,
+                    # step 2 may recompile once more (its input is step
+                    # 1's OUTPUT, whose shardings/layouts can differ
+                    # from the init-time state); steady state starts at
+                    # step 3
+                    guard = RetraceGuard(budget=retrace_budget,
+                                         name="Trainer.fit steady state")
+                    guard.__enter__()
                 if persist_dir:
                     for name, table in self.offload.items():
                         if table.should_persist:
@@ -468,24 +490,42 @@ class Trainer:
                     log_fn(
                         f"step {i + 1}: loss={float(metrics['loss']):.5f}")
                 i += 1
-        except BaseException:
+        except BaseException as e:
             # an exception mid-loop must not mask the pipeline's deferred
             # errors NOR leave the lookahead/persister threads unjoined —
             # drain everything, suppressing secondary failures (the
             # original exception is the story)
-            try:
-                self._cancel_preps()
-            except Exception:  # noqa: BLE001 — unwinding
-                pass
-            for table in self.offload.values():
-                try:
-                    table.finish()
-                except Exception:  # noqa: BLE001 — unwinding
-                    pass
+            if guard is not None:
+                guard.__exit__(type(e), e, None)
+            self._drain_suppressed()
             raise
+        # the guard covers the LOOP only: the drain below may legitimately
+        # compile (a remainder-sized final flush chunk) and must not count
+        # against the steady-state budget. A budget trip raises — but the
+        # pipeline still gets drained (suppressed secondaries) first.
+        if guard is not None:
+            try:
+                guard.__exit__(None, None, None)
+            except BaseException:
+                self._drain_suppressed()
+                raise
         # drain the pipeline: the LAST batch's deferred overflow counter and
         # any in-flight background persist must raise HERE, not be lost
         self._cancel_preps()
         for table in self.offload.values():
             table.finish()
         return state, last
+
+    def _drain_suppressed(self) -> None:
+        """Unwind-path drain: join lookahead/persister threads and flush
+        every offload table, suppressing secondary failures (the caller
+        is already raising the story)."""
+        try:
+            self._cancel_preps()
+        except Exception:  # noqa: BLE001 — unwinding
+            pass
+        for table in self.offload.values():
+            try:
+                table.finish()
+            except Exception:  # noqa: BLE001 — unwinding
+                pass

@@ -1,106 +1,20 @@
-"""Compiled-HLO collective auditing: the a2a plane's ICI-traffic contract.
+"""Compiled-HLO collective auditing (compat shim).
 
-The owner-routed exchange exists so per-device ICI bytes scale as
-O(slack * batch_slice * dim), not O(global_batch * dim) or O(table) — the
-reference's exchange-not-broadcast design (EmbeddingPullOperator.cpp:60-112).
-That property lives in the COMPILED program, not the Python source: a
-regression (e.g. a sharding annotation change making XLA materialize the
-table or the global batch on every device) shows up as an oversized
-``all-gather`` in the pull program's HLO. These helpers parse the compiled
-text and enforce the contract; ``tests/test_alltoall.py`` runs them on 8-
-and 16-device virtual meshes and ``__graft_entry__.dryrun_multichip`` on
-whatever mesh the driver requests.
+The a2a-pull contract that lived here is now one entry in the
+declarative per-plane registry at ``openembedding_tpu/analysis/
+contracts.py`` (psum / a2a / a2a+cache x pull / push / step, plus the
+cross-cutting f64 / donation / host-transfer audits). This module
+re-exports the original surface so existing callers
+(``tests/test_alltoall.py``, ``__graft_entry__.dryrun_multichip``) keep
+working; new code should import ``openembedding_tpu.analysis.contracts``
+directly.
 """
 
 from __future__ import annotations
 
-import re
-from typing import Dict, List, Tuple
+from ..analysis.contracts import (  # noqa: F401
+    _COLLECTIVES, _DTYPE_BYTES, _OP_RE, _SHAPE_RE, ROW_ASSEMBLY_SLACK,
+    _type_bytes, check_a2a_pull_hlo, collect_collectives, summarize)
 
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
-                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
-                "f64": 8}
-
-_COLLECTIVES = ("all-to-all", "all-gather", "all-reduce",
-                "collective-permute", "reduce-scatter")
-
-# post-optimization TPU HLO splits collectives into async -start/-done
-# pairs (`%x = (...) all-gather-start(...)`); match either form under the
-# base name, and skip -done ops (their result aliases the -start tuple —
-# counting both would double every byte)
-_OP_RE = re.compile(
-    r"= (?P<type>.*?) (?P<op>" + "|".join(_COLLECTIVES)
-    + r")(?P<suffix>-start|-done)?\(")
-_SHAPE_RE = re.compile(r"(" + "|".join(_DTYPE_BYTES) + r")\[([\d,]*)\]")
-
-
-def _type_bytes(type_str: str) -> Tuple[int, int]:
-    """(total bytes, largest single buffer bytes) of one HLO type string."""
-    total = largest = 0
-    for dtype, dims in _SHAPE_RE.findall(type_str):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        b = n * _DTYPE_BYTES[dtype]
-        total += b
-        largest = max(largest, b)
-    return total, largest
-
-
-def collect_collectives(hlo_text: str) -> List[Tuple[str, int, int]]:
-    """Collective ops in a compiled HLO dump as (op, bytes, max_buffer).
-
-    ``bytes`` sums the result type's buffers (all-to-all emits one per
-    peer); ``max_buffer`` is the largest SINGLE buffer — the size-bound
-    checks use it because async -start tuples carry operand AND result
-    buffers (summing would double-count). Ops inside a ``while`` body are
-    counted once (static program size): per-invocation shapes, not
-    dynamic step totals — exactly what the scaling contract is about.
-    """
-    out = []
-    for line in hlo_text.splitlines():
-        m = _OP_RE.search(line)
-        if m and m.group("suffix") != "-done":
-            total, largest = _type_bytes(m.group("type"))
-            out.append((m.group("op"), total, largest))
-    return out
-
-
-def summarize(hlo_text: str) -> Dict[str, Tuple[int, int]]:
-    """op -> (count, total result bytes)."""
-    out: Dict[str, Tuple[int, int]] = {}
-    for op, b, _largest in collect_collectives(hlo_text):
-        c, t = out.get(op, (0, 0))
-        out[op] = (c + 1, t + b)
-    return out
-
-
-def check_a2a_pull_hlo(hlo_text: str, *, batch_slice: int, dim: int,
-                       itemsize: int = 4) -> Dict[str, Tuple[int, int]]:
-    """Enforce the a2a pull program's ICI contract; returns the summary.
-
-    * >= 1 ``all-to-all`` (the owner exchange actually compiled in — if
-      XLA or a plane regression replaced it with broadcast-style
-      collectives, the plane's whole point is gone);
-    * every ``all-gather`` result is bounded by the ROW-ASSEMBLY size
-      ``batch_slice * dim * itemsize`` (+6.25% partitioner padding slack):
-      the one legitimate gather returns each data-slice's pulled rows to
-      its model-axis peers. A table-sized or global-batch-sized gather
-      (the psum plane's O(global_batch * dim) signature) fails here.
-    """
-    summary = summarize(hlo_text)
-    if "all-to-all" not in summary:
-        raise AssertionError(
-            "a2a pull program compiled WITHOUT an all-to-all — the owner "
-            f"exchange is gone (collectives: {summary})")
-    bound = int(batch_slice * dim * itemsize * 1.0625)
-    for op, _total, largest in collect_collectives(hlo_text):
-        if op == "all-gather" and largest > bound:
-            raise AssertionError(
-                f"a2a pull program contains an all-gather buffer of "
-                f"{largest} bytes > row-assembly bound {bound} "
-                f"(batch_slice={batch_slice}, dim={dim}) — "
-                "O(global_batch)/O(table) traffic has reappeared on the "
-                "pull path")
-    return summary
+__all__ = ["collect_collectives", "summarize", "check_a2a_pull_hlo",
+           "ROW_ASSEMBLY_SLACK"]

@@ -1,0 +1,93 @@
+"""Seeded graftlint violations: every rule must fire exactly where marked.
+
+NOT imported by anything — ``tests/test_graftlint.py`` lints this file's
+SOURCE and asserts each ``# expect: JGxxx`` line is reported (and each
+``# graftlint: disable`` line is not). The shapes mirror the real
+mistakes the linter exists to catch: the PR-1 hot-cache design keeps the
+FreqSketch/counters outside the jitted step — these are the ways that
+discipline gets broken by accident.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+COUNTERS = {}
+HISTORY = []
+
+
+class Sketch:
+    def __init__(self):
+        self.seen = 0
+
+    def observe(self, keys):
+        self.seen += int(keys.size)
+
+
+SKETCH = Sketch()
+
+
+def step_fn(state, batch):
+    COUNTERS.update(steps=1)            # expect: JG001
+    HISTORY.append(batch)               # expect: JG001
+    SKETCH.observe(batch)               # expect: JG001
+    loss = batch.sum().item()           # expect: JG002
+    noise = np.log(loss + 1.0)          # expect: JG002
+    if state > 0:                       # expect: JG003
+        state = state + noise
+    return state + batch.mean()
+
+
+train = jax.jit(step_fn)                # expect: JG004
+
+
+@jax.jit                                # expect: JG004
+def decorated_step(state):
+    return state * 2
+
+
+class Loop:
+    def __init__(self):
+        self.calls = 0
+
+    def body(self, carry, x):
+        self.calls += 1                 # expect: JG001
+        while carry > 0:                # expect: JG003
+            carry = carry - x
+        return carry, x
+
+
+def make_scan(loop: Loop):
+    return lambda xs: lax.scan(loop.body, jnp.float32(8.0), xs)
+
+
+# --- sanctioned patterns: must NOT be reported -------------------------------
+
+def quiet_step_fn(state):
+    COUNTERS["x"] = 1  # graftlint: disable=JG001
+    return state * 2
+
+
+quiet = jax.jit(quiet_step_fn, donate_argnums=(0,))
+
+
+def callback_host(v):
+    # handed to jax.debug.callback below: host by construction, free to
+    # mutate whatever it wants
+    COUNTERS["cb"] = COUNTERS.get("cb", 0) + 1
+
+
+def traced_with_callback(x):
+    jax.debug.callback(callback_host, x.sum())
+    acc = jnp.zeros_like(x)
+    acc = acc.at[0].add(x[0])           # functional .at update: clean
+    local = {}
+    local.update(n=1)                   # local dict: clean
+    if x.ndim == 2:                     # metadata predicate: clean
+        x = x.reshape(-1)
+    return acc.sum() + x.sum()
+
+
+pulled = jax.jit(traced_with_callback)

@@ -202,6 +202,7 @@ def test_a2a_hash_matches_single(devices8, data, model):
 
 # --- adversarial skew: the exchange must be exact for ANY distribution ------
 
+@pytest.mark.slow
 @pytest.mark.parametrize("skew", ["congruent", "hotkey", "one_owner_hash"])
 def test_a2a_exact_under_adversarial_skew(devices8, skew):
     """Bit-exact a2a/psum parity at DEFAULT settings under structured skew.
@@ -412,31 +413,17 @@ def test_a2a_wide_keys_exact_under_skew(devices8):
 
 def _lower_pull(mesh, plane, *, vocab=1 << 16, dim=16, batch=1024,
                 use_hash=False):
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from openembedding_tpu.parallel.mesh import DATA_AXIS
-    if use_hash:
-        spec = EmbeddingSpec(name="t", input_dim=-1, output_dim=dim,
-                             hash_capacity=vocab, plane=plane)
-    else:
-        spec = EmbeddingSpec(name="t", input_dim=vocab, output_dim=dim,
-                             plane=plane)
-    coll = EmbeddingCollection((spec,), mesh)
-    states = coll.init(jax.random.PRNGKey(0))
-
-    def pull_fn(states, idx):
-        return coll.pull(states, {"t": idx})["t"]
-
-    idx = jax.device_put(jnp.zeros((batch,), jnp.int32),
-                         NamedSharding(mesh, P(DATA_AXIS)))
-    # rows stay batch-sharded over the data axis (the training step's
-    # layout) — a replicated output would force an artifact gather
-    compiled = jax.jit(
-        pull_fn, out_shardings=NamedSharding(mesh, P(DATA_AXIS))
-    ).lower(states, idx).compile()
-    return compiled.as_text()
+    """One lowering recipe for the whole repo: delegate to the shipped
+    helper (analysis/programs.py) so this file and the contract gate can
+    never drift apart and audit different programs."""
+    from openembedding_tpu.analysis import programs
+    txt, _params = programs.lower_pull(mesh, plane, vocab=vocab, dim=dim,
+                                       batch=batch, use_hash=use_hash)
+    return txt
 
 
-@pytest.mark.parametrize("mesh_shape", [(2, 4), (1, 8)])
+@pytest.mark.parametrize("mesh_shape", [
+    (2, 4), pytest.param((1, 8), marks=pytest.mark.slow)])
 @pytest.mark.parametrize("use_hash", [False, True])
 def test_a2a_pull_ici_contract(devices8, mesh_shape, use_hash):
     """The compiled a2a pull program's ICI contract: the owner exchange is
@@ -466,10 +453,13 @@ def test_a2a_pull_ici_contract(devices8, mesh_shape, use_hash):
     assert big, f"psum plane lost its broadcast signature: {psum_summary}"
 
 
+@pytest.mark.slow
 def test_a2a_pull_ici_contract_16dev():
     """Same contract on a 16-device virtual mesh (a child process: this
     process's backend is pinned to 8 devices) — the scaling regime the
-    plane exists for."""
+    plane exists for. Slow lane: the child recompiles 8 programs from
+    scratch (~several min on CPU); tier-1 keeps the same contract on the
+    8-device mesh here and in test_analysis_contracts.py."""
     import os
     import subprocess
     import sys

@@ -1,0 +1,96 @@
+"""Per-plane compiled-program contracts (analysis/contracts.py).
+
+The registry must (a) PASS on every shipped plane's pull/push program on
+an 8-device virtual mesh — the contracts describe reality — and (b)
+CATCH a deliberately broken sharding annotation — the contracts have
+teeth. The whole-train-step audit proves donation is honored and no
+host transfer hides inside the jitted step.
+"""
+
+import pytest
+
+from openembedding_tpu.analysis import contracts, programs
+from openembedding_tpu.parallel.mesh import create_mesh
+
+B, DIM = 1024, 16
+
+
+@pytest.mark.parametrize("plane", ["psum", "a2a", "a2a+cache"])
+def test_pull_push_contracts_array(devices8, plane):
+    mesh = create_mesh(2, 4, devices8)
+    txt, params = programs.lower_pull(mesh, plane, batch=B, dim=DIM)
+    summary = contracts.check_program(txt, plane, "pull", **params)
+    if plane != "psum":
+        assert summary["all-to-all"][0] >= 1
+    else:
+        assert "all-to-all" not in summary
+
+    txt, params = programs.lower_push(mesh, plane, batch=B, dim=DIM)
+    contracts.check_program(txt, plane, "push", **params)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("plane", ["a2a", "a2a+cache"])
+def test_pull_push_contracts_hash(devices8, plane):
+    """Slow lane (tier-1 budget): the hash planes recompile everything
+    from scratch (~25 s); tier-1 keeps the array matrix above and
+    `tools/graftcheck` covers hash in CI."""
+    mesh = create_mesh(2, 4, devices8)
+    txt, params = programs.lower_pull(mesh, plane, batch=B, dim=DIM,
+                                      use_hash=True)
+    contracts.check_program(txt, plane, "pull", **params)
+    txt, params = programs.lower_push(mesh, plane, batch=B, dim=DIM,
+                                      use_hash=True)
+    contracts.check_program(txt, plane, "push", **params)
+
+
+def test_broken_sharding_annotation_caught(devices8):
+    """Replicating the pull output (a one-line sharding regression)
+    forces a global-batch gather — the contract must fail it."""
+    mesh = create_mesh(2, 4, devices8)
+    txt, params = programs.lower_pull(mesh, "a2a", batch=B, dim=DIM,
+                                      out_replicated=True)
+    with pytest.raises(contracts.ContractViolation, match="all-gather"):
+        contracts.check_program(txt, "a2a", "pull", **params)
+
+
+def test_train_step_contract(devices8):
+    """The whole jitted step: donation honored (tables updated in
+    place), no f64, no host transfer, and no table-sized copy.
+
+    vocab/dim are sized so each table shard (vocab*dim*4/8 = 512 KiB)
+    dwarfs every dense buffer — a copy at or above shard size can only
+    be a table that lost its donation.
+    """
+    mesh = create_mesh(2, 4, devices8)
+    vocab, dim = 1 << 16, 16
+    txt, params = programs.lower_train_step(mesh, "a2a", vocab=vocab,
+                                            dim=dim, batch=256)
+    contracts.check_program(txt, "any", "step", **params)
+    aliased = contracts.donated_params(txt)
+    assert len(aliased) >= 4, aliased   # tables + slots + dense + opt
+    table_shard_bytes = vocab * dim * 4 // mesh.size
+    assert contracts.max_copy_bytes(txt) < table_shard_bytes
+
+
+def test_step_with_record_stats_contains_callback(devices8):
+    """Sanity for the host-transfer audit: when the observability gate
+    is ON the pull program legitimately carries a host callback — the
+    audit must SEE it (and the default program must not have one)."""
+    from openembedding_tpu.utils import observability as obs
+    mesh = create_mesh(2, 4, devices8)
+    txt, _ = programs.lower_pull(mesh, "a2a", batch=B, dim=DIM)
+    assert contracts.host_transfer_ops(txt) == []
+    obs.set_evaluate_performance(True)
+    try:
+        txt_rec, _ = programs.lower_pull(mesh, "a2a", batch=B, dim=DIM)
+    finally:
+        obs.set_evaluate_performance(False)
+    assert "host-callback" in contracts.host_transfer_ops(txt_rec)
+    with pytest.raises(contracts.ContractViolation, match="host"):
+        contracts.check_no_host_transfers(txt_rec)
+
+
+def test_registry_unknown_key():
+    with pytest.raises(KeyError, match="no contract registered"):
+        contracts.check_program("", "nope", "pull", batch_slice=1, dim=1)

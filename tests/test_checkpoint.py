@@ -58,6 +58,7 @@ def test_roundtrip_same_mesh(devices8, tmp_path):
                                np.asarray(s2["arr"].weights), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_roundtrip_resharded(devices8, tmp_path):
     """Checkpoint from a 4-shard mesh loads onto an 8-shard mesh."""
     mesh_a = create_mesh(2, 4, devices8)
@@ -149,6 +150,7 @@ def test_dense_export(devices8, tmp_path):
         ckpt.export_dense(coll_h, coll_h.init())
 
 
+@pytest.mark.slow
 def test_trainer_dense_state_roundtrip(devices8, tmp_path):
     """Full TrainState (dense params + optax) rides next to the sparse dump."""
     mesh = create_mesh(2, 4, devices8)
@@ -218,6 +220,7 @@ def test_legacy_npz_checkpoint_loads(devices8, tmp_path):
                                    rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_psum_plane_checkpoint_roundtrip(devices8, tmp_path):
     """psum-plane tables are replicated over the data axis; the streaming
     dump must emit each shard once (replica_id filter), not once per copy."""
@@ -309,6 +312,7 @@ def test_remote_load_onto_different_mesh(devices8):
                                    rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_remote_bfloat16_roundtrip(devices8):
     """bf16 tables survive the remote stream path: numpy serializes
     ml_dtypes bfloat16 as an opaque '<V2' descr, and the streaming loader
@@ -364,6 +368,7 @@ def test_local_dump_copied_to_remote_loads(devices8, tmp_path):
                                    rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_category_hotswap_array_to_hash(devices8, tmp_path):
     """An ARRAY dump loads into a HASH variable (bounded-vocab growth):
     logical row ids become keys, weights bit-equal, matching-optimizer
@@ -409,6 +414,7 @@ def test_category_hotswap_array_to_hash(devices8, tmp_path):
                                rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_category_hotswap_hash_to_array(devices8, tmp_path):
     """A HASH dump whose keys fit the bounded vocab loads into an ARRAY
     variable; out-of-range keys fail the load (deliver-or-fail)."""
@@ -468,6 +474,7 @@ def test_bounded_vocab_mismatch_still_rejected(devices8, tmp_path):
         ckpt.load_checkpoint(p, coll2)
 
 
+@pytest.mark.slow
 def test_wide_key_collection_roundtrip(devices8, tmp_path):
     """key_dtype='wide' hash variables (64-bit pair keys, x64 off) train
     through the collection and survive a checkpoint round trip."""
@@ -543,6 +550,7 @@ def test_category_hotswap_array_to_wide_hash(devices8, tmp_path):
     np.testing.assert_array_equal(got_a, want)
 
 
+@pytest.mark.slow
 def test_wide_key_dump_shard_slices(devices8, tmp_path):
     """Serving shard slices over WIDE-key dumps: each slice holds exactly
     the keys with ``joined_id % G == k`` (owner on the 64-bit value) —
@@ -616,6 +624,7 @@ def test_wide_key_dump_shard_slices(devices8, tmp_path):
     np.testing.assert_array_equal(got[allv % G != 1], 0.0)
 
 
+@pytest.mark.slow
 def test_hash_key_width_migration(devices8, tmp_path):
     """int32-key hash dumps load into key_dtype='wide' variables (key-space
     migration) and wide dumps refuse narrow tables when keys overflow."""

@@ -62,6 +62,7 @@ def test_npyz_short_write_fails(tmp_path):
         w.close()
 
 
+@pytest.mark.slow
 def test_compressed_checkpoint_round_trip(devices8, tmp_path):
     """compress='zlib' dumps load back identical to the raw dump —
     array, int32 hash, and wide hash variables."""

@@ -17,6 +17,7 @@ BASE = ["--num_buckets", "2048", "--batch_size", "128", "--steps", "4",
         "--embedding_dim", "4", "--data_parallel", "2", "--log_every", "0"]
 
 
+@pytest.mark.slow
 def test_example_fused_deepfm(devices8, tmp_path):
     _run(["--model", "deepfm", *BASE,
           "--save", str(tmp_path / "ck")])
@@ -28,11 +29,13 @@ def test_example_wdl_psum_plane(devices8):
     _run(["--model", "wdl", *BASE, "--plane", "psum"])
 
 
+@pytest.mark.slow
 def test_example_lr_hybrid_and_history(devices8):
     _run(["--model", "lr", *BASE, "--no-fused",
           "--sparse_as_dense", "2048", "--hist_len", "4"])
 
 
+@pytest.mark.slow
 def test_example_tfrecord_input(devices8, tmp_path):
     """--format tfrecord: the dependency-free TFRecord reader feeds the
     training pipeline (the reference's criteo_tfrecord.py data path)."""
@@ -52,6 +55,7 @@ def test_example_tfrecord_input(devices8, tmp_path):
           "--format", "tfrecord"])
 
 
+@pytest.mark.slow
 def test_example_sharded_serving_cluster(devices8):
     """serving_cluster --shards 2: the shard-group demo boots a 2x1 grid
     and serves through the ShardedRoutingClient."""

@@ -153,6 +153,7 @@ def test_sharded_hash_batch_replicated(devices8):
     np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_int64_keys_full_width(devices8):
     """The reference's 2^62 key space: int64 keys end-to-end in a dedicated
     x64 process (the global flag changes dtypes program-wide, so the
@@ -223,6 +224,7 @@ def test_pair_mod_matches_int64_mod():
         ht.pair_mod(pairs, 1 << 15)
 
 
+@pytest.mark.slow
 def test_pallas_probe_gather_parity():
     """Fused Pallas probe+gather (interpret mode) matches find_rows+take.
 

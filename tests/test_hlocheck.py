@@ -1,0 +1,151 @@
+"""Unit tests for the HLO-text parsing core (analysis/contracts.py, the
+absorbed utils/hlocheck.py) on SYNTHETIC HLO — previously this layer was
+only exercised indirectly through test_alltoall's real lowered programs.
+
+Covers the parsing contracts the real-program tests silently rely on:
+async -start/-done pair dedup, while-body single-count, byte/bound
+arithmetic, donation-header parsing, f64 and host-transfer detection.
+"""
+
+import pytest
+
+from openembedding_tpu.analysis import contracts
+from openembedding_tpu.utils import hlocheck  # the compat shim
+
+
+SYNC = """
+HloModule jit_pull
+  %x = f32[128,16]{1,0} all-to-all(f32[128,16]{1,0} %a), replica_groups={}
+  %y = f32[64,16]{1,0} all-gather(f32[8,16]{1,0} %b), dimensions={0}
+  %z = f32[] add(f32[] %c, f32[] %d)
+"""
+
+ASYNC = """
+HloModule jit_pull
+  %ags = (f32[8,16]{1,0}, f32[64,16]{1,0}) all-gather-start(f32[8,16]{1,0} %b)
+  %agd = f32[64,16]{1,0} all-gather-done((f32[8,16],f32[64,16]) %ags)
+  %ars = f32[4]{0} all-reduce-start(f32[4]{0} %c)
+  %ard = f32[4]{0} all-reduce-done(f32[4]{0} %ars)
+"""
+
+WHILE_BODY = """
+HloModule jit_loop
+%body (p: (s32[], f32[128,16])) -> (s32[], f32[128,16]) {
+  %aa = f32[128,16]{1,0} all-to-all(f32[128,16]{1,0} %q)
+  ROOT %t = (s32[], f32[128,16]) tuple(%i, %aa)
+}
+ENTRY %main {
+  %w = (s32[], f32[128,16]) while((s32[], f32[128,16]) %init),
+      condition=%cond, body=%body
+}
+"""
+
+
+def test_collect_sync_ops_and_bytes():
+    got = hlocheck.collect_collectives(SYNC)
+    assert got == [("all-to-all", 128 * 16 * 4, 128 * 16 * 4),
+                   ("all-gather", 64 * 16 * 4, 64 * 16 * 4)]
+    assert hlocheck.summarize(SYNC) == {
+        "all-to-all": (1, 8192), "all-gather": (1, 4096)}
+
+
+def test_async_start_done_pairs_dedup():
+    """-start counts once (with max SINGLE buffer, not the operand+result
+    tuple sum), -done not at all — counting both would double every
+    byte."""
+    got = hlocheck.collect_collectives(ASYNC)
+    assert [op for op, _b, _l in got] == ["all-gather", "all-reduce"]
+    ag = got[0]
+    # tuple type sums operand+result; the max single buffer is the result
+    assert ag[1] == (8 * 16 + 64 * 16) * 4
+    assert ag[2] == 64 * 16 * 4
+    assert hlocheck.summarize(ASYNC)["all-gather"][0] == 1
+
+
+def test_while_body_counts_once():
+    """Static program size: one all-to-all in a while body is ONE op
+    regardless of trip count — per-invocation shapes are the contract."""
+    assert hlocheck.summarize(WHILE_BODY) == {"all-to-all": (1, 8192)}
+
+
+def test_bound_arithmetic_and_slack():
+    # bound = batch_slice * dim * itemsize * 1.0625; the SYNC gather is
+    # 4096 bytes: passes at the bound, fails just under it
+    hlocheck.check_a2a_pull_hlo(SYNC, batch_slice=64, dim=16)
+    with pytest.raises(AssertionError, match="row-assembly bound"):
+        hlocheck.check_a2a_pull_hlo(SYNC, batch_slice=60, dim=16)
+    # slack: a gather 6% over the nominal size still passes
+    assert int(64 * 16 * 4 * hlocheck.ROW_ASSEMBLY_SLACK) >= 4096
+
+
+def test_missing_all_to_all_refused():
+    no_a2a = SYNC.replace("all-to-all", "all-reduce")
+    with pytest.raises(AssertionError, match="WITHOUT an all-to-all"):
+        hlocheck.check_a2a_pull_hlo(no_a2a, batch_slice=64, dim=16)
+
+
+def test_donation_header_parsing():
+    header = ('HloModule jit_step, is_scheduled=true, '
+              'input_output_alias={ {0}: (0, {}, may-alias), '
+              '{1}: (3, {}, must-alias) }, '
+              'entry_computation_layout={(f32[8])->f32[8]}\n')
+    assert contracts.donated_params(header) == (0, 3)
+    assert contracts.check_donation(header, 2) == (0, 3)
+    with pytest.raises(contracts.ContractViolation, match="donation"):
+        contracts.check_donation("HloModule jit_step\n%x = f32[] add()", 1)
+
+
+def test_f64_detection():
+    leak = SYNC + "  %bad = f64[256]{0} convert(f32[256]{0} %z)\n"
+    assert not contracts.find_f64(SYNC)
+    with pytest.raises(contracts.ContractViolation, match="f64"):
+        contracts.check_no_f64(leak)
+
+
+def test_host_transfer_detection():
+    cb = SYNC + ('  %c = () custom-call(f32[] %r), '
+                 'custom_call_target="xla_python_cpu_callback"\n')
+    out = SYNC + "  %o = token[] outfeed(f32[] %r, token[] %t)\n"
+    assert contracts.host_transfer_ops(SYNC) == []
+    assert contracts.host_transfer_ops(cb) == ["host-callback"]
+    assert contracts.host_transfer_ops(out) == ["outfeed"]
+
+
+def test_host_transfer_tuple_result_types():
+    """Real infeed/send ops carry TUPLE result types with spaces — the
+    audit must still see them (regression: a \\S+ type capture silently
+    skipped exactly these)."""
+    inf = SYNC + ("  %i = ((f32[4096,16]{1,0}), token[]) "
+                  "infeed(token[] %t)\n")
+    snd = SYNC + ("  %s = (f32[4096]{0}, u32[], token[]) "
+                  "send(f32[4096]{0} %x, token[] %t), channel_id=1, "
+                  "is_host_transfer=true\n")
+    assert contracts.host_transfer_ops(inf) == ["infeed"]
+    assert contracts.host_transfer_ops(snd) == ["send"]
+    with pytest.raises(contracts.ContractViolation, match="host"):
+        contracts.check_no_host_transfers(inf)
+    # device-to-device channel send/recv (collective-permute decomposed
+    # by the SPMD partitioner) is NOT a host transfer
+    d2d = SYNC + ("  %s = (f32[4096]{0}, u32[], token[]) "
+                  "send(f32[4096]{0} %x, token[] %t), channel_id=1\n")
+    assert contracts.host_transfer_ops(d2d) == []
+
+
+def test_copy_bytes():
+    prog = SYNC + "  %cp = f32[1024,16]{1,0} copy(f32[1024,16]{1,0} %w)\n"
+    assert contracts.max_copy_bytes(SYNC) == 0
+    assert contracts.max_copy_bytes(prog) == 1024 * 16 * 4
+    # async copy-start: tuple result type (operand + result + context) —
+    # max single buffer, not the tuple sum
+    astart = SYNC + ("  %cs = (f32[65536,16]{1,0}, f32[65536,16]{1,0}, "
+                     "u32[]) copy-start(f32[65536,16]{1,0} %w)\n")
+    assert contracts.max_copy_bytes(astart) == 65536 * 16 * 4
+
+
+def test_push_contract_requires_global_batch():
+    """check_program must refuse to guess global_batch for push
+    contracts (a batch_slice default understates the overflow-fallback
+    bound on any data>1 mesh)."""
+    with pytest.raises(KeyError, match="global_batch"):
+        contracts.check_program(SYNC, "a2a", "push",
+                                batch_slice=64, dim=16)

@@ -105,6 +105,7 @@ def _run_lr(devices8, dense_kept: bool, steps=4):
     return losses, got
 
 
+@pytest.mark.slow
 def test_hybrid_sgd_parity(devices8):
     """Plain SGD: dense-kept and sharded paths produce identical tables."""
     losses_s, rows_s = _run_lr(devices8, dense_kept=False)

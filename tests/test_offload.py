@@ -331,6 +331,7 @@ class TestPipelinedOffload:
                         "sparse": {"off": ids, "off:linear": ids}})
         return out
 
+    @pytest.mark.slow
     def test_packed_insert_matches_unpacked_fallback(self, devices8):
         """The one-transfer packed insert (keys bitcast into an f32
         column) must land bit-identical rows/slots to the generic
@@ -374,7 +375,7 @@ class TestPipelinedOffload:
         one blocking device_get per table per step is what serialized the
         tier on the tunneled bench chip (each read is a synchronous round
         trip; rounds 3-5 measured 466/242 ms steps from exactly this —
-        tools/offload_diag7.py). Overflow counters are cumulative on
+        `python -m tools.offload_diag pipeline`). Overflow counters are cumulative on
         device and may be read ONLY at join points (flush/persist/
         restore/finish)."""
         from openembedding_tpu.parallel.mesh import create_mesh
@@ -427,7 +428,9 @@ class TestPipelinedOffload:
         table._join_writeback()
         table.finish(); lin.finish()
 
-    @pytest.mark.parametrize("depth", [1, 2, 4])
+    @pytest.mark.parametrize("depth", [
+        pytest.param(1, marks=pytest.mark.slow),
+        pytest.param(2, marks=pytest.mark.slow), 4])
     def test_pipelined_fit_matches_serial_steps(self, devices8, tmp_path,
                                                 depth):
         """Bit-identical at EVERY lookahead depth: the planned-residency
@@ -467,7 +470,8 @@ class TestPipelinedOffload:
         assert tab_r.persisted_work > 0
         assert c.keys.shape[0] == tab_r.cache_capacity
 
-    @pytest.mark.parametrize("depth", [2, 4])
+    @pytest.mark.parametrize("depth", [
+        2, pytest.param(4, marks=pytest.mark.slow)])
     def test_pipeline_survives_eviction_batches(self, devices8, depth):
         """A lookahead batch that would overflow the cache falls back to
         the synchronous evict path mid-pipeline, values staying exact —
@@ -540,7 +544,8 @@ t.persist(p)                               # never returns
 """
 
 
-@pytest.mark.parametrize("mode", ["mid_file", "pre_meta"])
+@pytest.mark.parametrize("mode", [
+    pytest.param("mid_file", marks=pytest.mark.slow), "pre_meta"])
 def test_kill_mid_persist_restores_watermark(tmp_path, mode):
     """SIGKILL INSIDE persist (mid chain-file write / before the meta
     commit) must leave a restorable checkpoint at the PREVIOUS watermark —
@@ -646,6 +651,7 @@ print("FINISHED", flush=True)
 """
 
 
+@pytest.mark.slow
 def test_kill_mid_pipelined_fit_resume_exact(tmp_path):
     """SIGKILL a child mid-``fit`` with the WHOLE pipeline in flight —
     depth-3 lookahead prepares, async writeback, async incremental
@@ -757,6 +763,7 @@ def test_kill_mid_pipelined_fit_resume_exact(tmp_path):
                                       tab_ref.host_slots[k])
 
 
+@pytest.mark.slow
 def test_hand_driven_prefetch_matches_fit(devices8):
     """The PUBLIC prefetch API (the bench's hand-driven pattern:
     ``prefetch(window); train_step(batch)``) is the same pipeline fit
@@ -840,6 +847,7 @@ def test_persist_compress_chain(tmp_path, devices8):
     assert t3.persisted_work == t2.work_id
 
 
+@pytest.mark.slow
 def test_pipeline_parity_under_timing_fuzz(devices8):
     """Randomized host-gather delays shift every prepare/apply/evict
     interleaving; results must stay bit-identical to serial regardless

@@ -123,7 +123,8 @@ CONFIGS = [
 
 @pytest.mark.parametrize("config", CONFIGS,
                          ids=lambda c: c["category"] + str(zlib.crc32(repr(c).encode()) % 1000))
-@pytest.mark.parametrize("steps", [1, 10])
+@pytest.mark.parametrize("steps", [
+    1, pytest.param(10, marks=pytest.mark.slow)])
 def test_optimizer_matches_numpy_reference(config, steps):
     rng = np.random.RandomState(zlib.crc32(repr(config).encode()) % 2**31)
     opt = make_optimizer(config)

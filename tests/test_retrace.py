@@ -1,0 +1,115 @@
+"""Retrace guard: compile counting, budgets, and Trainer.fit wiring."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from openembedding_tpu.analysis import retrace
+
+
+def test_counts_compiles_and_cache_hits():
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    n_first = retrace.compile_count(f, jnp.ones((16,)))
+    assert n_first >= 1
+    # cached: same shape compiles nothing
+    assert retrace.compile_count(f, jnp.ones((16,))) == 0
+    # new shape retraces
+    assert retrace.compile_count(f, jnp.ones((17,))) >= 1
+
+
+def test_guard_trips_on_budget():
+    @jax.jit
+    def g(x):
+        return x + 1
+
+    g(jnp.ones((4,)))                       # warm
+    with pytest.raises(retrace.RetraceBudgetExceeded, match="budget"):
+        with retrace.RetraceGuard(budget=0, name="wobble"):
+            for n in (5, 6, 7):             # shape wobble: 3 compiles
+                g(jnp.ones((n,)))
+
+    with retrace.RetraceGuard(budget=0):
+        g(jnp.ones((4,)))                   # cached: stays quiet
+
+
+def test_guard_warn_mode_and_properties():
+    @jax.jit
+    def h(x):
+        return x - 1
+
+    with pytest.warns(RuntimeWarning, match="retrace budget"):
+        with retrace.RetraceGuard(budget=0, on_exceed="warn") as guard:
+            h(jnp.ones((31,)))
+    assert guard.compiles >= 1 and guard.exceeded
+    with pytest.raises(ValueError, match="on_exceed"):
+        retrace.RetraceGuard(on_exceed="explode")
+
+
+def test_guard_does_not_mask_inner_error():
+    with pytest.raises(KeyError):
+        with retrace.RetraceGuard(budget=0):
+            jax.jit(lambda x: x * 3)(jnp.ones((9,)))
+            raise KeyError("the original error is the story")
+
+
+def test_assert_no_recompiles_helper():
+    @jax.jit
+    def f(x):
+        return x @ x.T
+
+    retrace.assert_no_recompiles(f, jnp.ones((8, 4)))
+
+    calls = []
+
+    def shapeshifter(x):
+        calls.append(x)
+        return jax.jit(lambda v: v + len(calls))(x)  # new closure/step
+
+    with pytest.raises(retrace.RetraceBudgetExceeded):
+        retrace.assert_no_recompiles(shapeshifter, jnp.ones((4,)))
+
+
+def test_fit_retrace_budget_wiring(devices8):
+    """Trainer.fit(retrace_budget=...): a steady fixed-shape loop passes
+    a zero post-warmup budget; a shape-wobbling loop trips it."""
+    from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.models import deepctr
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(2, 4, devices8)
+
+    def make(batch_sizes, budget):
+        specs = deepctr.make_feature_specs(("f",), 64, 4)
+        coll = EmbeddingCollection(
+            specs, mesh,
+            default_optimizer={"category": "sgd", "learning_rate": 0.1})
+        trainer = Trainer(
+            deepctr.LogisticRegression(feature_names=("f",)), coll,
+            optax.sgd(1e-2))
+        rng = np.random.RandomState(0)
+
+        def batches():
+            for b in batch_sizes:
+                ids = rng.randint(0, 64, b).astype(np.int32)
+                yield {"label": (ids % 2).astype(np.float32),
+                       "dense": None,
+                       "sparse": {"f": ids, "f:linear": ids}}
+
+        it = batches()
+        first = next(it)
+        state = trainer.init(jax.random.PRNGKey(0),
+                             trainer.shard_batch(first))
+        return trainer.fit(state, [first] + list(it),
+                           retrace_budget=budget)
+
+    state, metrics = make([16] * 6, budget=0)
+    assert metrics is not None
+
+    with pytest.raises(retrace.RetraceBudgetExceeded):
+        make([16, 16, 24, 32], budget=0)

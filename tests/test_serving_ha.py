@@ -192,6 +192,7 @@ def test_binary_lookup_parity(model_dir):
         proc.kill()
 
 
+@pytest.mark.slow
 def test_peer_row_restore_without_dump(model_dir, tmp_path):
     """The dump store dies AFTER boot; a respawned replica must rebuild
     purely from a living peer's memory (the reference's coordinated-restore
@@ -226,6 +227,7 @@ def test_peer_row_restore_without_dump(model_dir, tmp_path):
         _cleanup(procs)
 
 
+@pytest.mark.slow
 def test_peer_row_restore_wide_keys(tmp_path, devices8):
     """Peer-to-peer restore of a WIDE-key model: /rows pages carry joined
     int64 ids, the restorer re-splits them into pairs."""

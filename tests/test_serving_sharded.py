@@ -126,6 +126,7 @@ def wide_sharded_model(tmp_path_factory):
     return path, keys64, want
 
 
+@pytest.mark.slow
 def test_wide_key_shard_groups(wide_sharded_model):
     """Shard-sliced serving of a WIDE-key model: G=3 groups each load the
     slice ``joined_id % 3 == k`` of a 2^62-key-space dump; the router
@@ -254,6 +255,7 @@ def test_shard_groups_with_replicas(sharded_model):
         _cleanup(procs)
 
 
+@pytest.mark.slow
 def test_pooled_wide_spec_serves_rows(tmp_path_factory):
     """Regression (advisor r4): a POOLED wide spec must serve with ROW
     semantics. The routing plane always fans out flat ``[n, 2]`` pair

@@ -51,7 +51,21 @@ def build_trainer(model_name, mesh, vocab=VOCAB, **spec_kw):
     return Trainer(model, coll, optax.adam(1e-2))
 
 
-@pytest.mark.parametrize("model_name", ["lr", "wdl", "deepfm", "xdeepfm", "dcn"])
+@pytest.mark.parametrize("model_name", [
+    pytest.param("lr", marks=pytest.mark.xfail(
+        strict=False,
+        reason="jax 0.4.37: lr loss drifts upward (0.80->0.81) instead of "
+               "decreasing — the synthetic label (c0+c1)%2 is XOR parity, "
+               "which a linear model cannot fit (no interaction term; the "
+               "deep models memorize it through their towers); earlier jax "
+               "images passed on init/optimizer noise. A learnable-task lr "
+               "check lives in test_auc_lift_on_learnable_task.")),
+    "deepfm",
+    # tier-1 budget (COVERAGE.md): deepfm exercises the shared
+    # linear+fields+MLP path; the variant towers ride the slow lane
+    pytest.param("wdl", marks=pytest.mark.slow),
+    pytest.param("xdeepfm", marks=pytest.mark.slow),
+    pytest.param("dcn", marks=pytest.mark.slow)])
 def test_model_zoo_trains(devices8, model_name):
     mesh = create_mesh(2, 4, devices8)
     trainer = build_trainer(model_name, mesh)
@@ -69,8 +83,11 @@ def test_model_zoo_trains(devices8, model_name):
     assert p.shape == (B,) and (p >= 0).all() and (p <= 1).all()
 
 
+@pytest.mark.slow
 def test_hash_collection_trains(devices8):
-    """input_dim=-1 features ride the hash-table path inside the same step."""
+    """input_dim=-1 features ride the hash-table path inside the same step.
+    Slow lane (tier-1 budget): the fused hash path trains in tier-1 via
+    test_fused.py::test_fused_hash_training."""
     mesh = create_mesh(2, 4, devices8)
     trainer = build_trainer("deepfm", mesh, vocab=-1, hash_capacity=4096)
     batches = list(synthetic_batches(20, hash_keys=True))

@@ -1,0 +1,5 @@
+"""Operational CLIs: lint/contract gates and device diagnostics.
+
+A package so the gates run module-style from the repo root (the tier-1
+lane invokes ``python -m tools.graftlint openembedding_tpu/``).
+"""

@@ -1,0 +1,109 @@
+"""graftcheck CLI: per-plane compiled-program contract gate for CI.
+
+    python -m tools.graftcheck [--mesh 2x4] [--batch 1024] [--dim 16]
+
+Builds a virtual CPU mesh, lowers every registered plane's pull/push
+program (array AND hash tables) plus the whole jitted train step, and
+audits them against ``openembedding_tpu/analysis/contracts.py``:
+collective inventory + byte bounds, no f64, no host transfers, step
+donation honored. Exit 0 when every contract holds, 1 with the first
+violation per program otherwise.
+
+This is the compile-audit-time version of the scaling guarantee: a
+sharding/plane regression fails HERE, on a laptop, instead of as a
+silent 10x ICI blowup on a real mesh. ``tests/test_analysis_contracts.py``
+runs the same registry inside the tier-1 lane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="compiled-program contract gate")
+    ap.add_argument("--mesh", default="2x4",
+                    help="DATAxMODEL virtual mesh shape (default 2x4)")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--skip-step", action="store_true",
+                    help="skip the (slower) whole-train-step audit")
+    args = ap.parse_args(argv)
+    data, model = (int(x) for x in args.mesh.split("x"))
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from openembedding_tpu.utils.jaxcompat import set_num_cpu_devices
+    set_num_cpu_devices(data * model)
+
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.analysis import contracts, programs
+
+    mesh = create_mesh(data, model)
+    failures = 0
+
+    def audit(label, fn):
+        nonlocal failures
+        try:
+            summary = fn()
+            print(f"ok   {label}: {summary}")
+        except contracts.ContractViolation as e:
+            failures += 1
+            print(f"FAIL {label}: {e}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — a gate must report,
+            # not die on the first broken lowering: the remaining
+            # programs still get audited and the summary still prints
+            failures += 1
+            print(f"FAIL {label}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    for plane in ("psum", "a2a", "a2a+cache"):
+        for use_hash in (False, True):
+            kind = "hash" if use_hash else "array"
+            for prog, lower in (("pull", programs.lower_pull),
+                                ("push", programs.lower_push)):
+                def run(plane=plane, prog=prog, lower=lower,
+                        use_hash=use_hash):
+                    txt, params = lower(mesh, plane, batch=args.batch,
+                                        dim=args.dim, use_hash=use_hash)
+                    return contracts.check_program(txt, plane, prog,
+                                                   **params)
+                audit(f"{plane}/{prog} ({kind})", run)
+
+    if not args.skip_step:
+        def run_step():
+            # vocab/dim sized so each table shard dwarfs every dense
+            # buffer: a copy at/above shard size can only be a table
+            # that lost its donation (see contracts.max_copy_bytes)
+            vocab, dim = 1 << 16, 16
+            txt, params = programs.lower_train_step(mesh, "a2a",
+                                                    vocab=vocab, dim=dim,
+                                                    batch=args.batch // 4)
+            summary = contracts.check_program(txt, "any", "step",
+                                              **params)
+            shard_bytes = vocab * dim * 4 // mesh.size
+            worst = contracts.max_copy_bytes(txt)
+            if worst >= shard_bytes:
+                raise contracts.ContractViolation(
+                    f"step program copies a {worst}-byte buffer >= table "
+                    f"shard size {shard_bytes} — donation silently "
+                    "declined for a table")
+            return summary
+        audit("any/step (deepfm, a2a)", run_step)
+
+    if failures:
+        print(f"graftcheck: {failures} contract violation(s)",
+              file=sys.stderr)
+        return 1
+    print("graftcheck: all contracts hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""graftlint CLI: jit-purity lint gate over package source.
+
+    python -m tools.graftlint openembedding_tpu/ [more paths...]
+
+Exit 0 when clean, 1 with one ``path:line: RULE message`` per violation
+otherwise — the tier-1 lane runs this before pytest (ROADMAP verify
+line) and ``tests/test_graftlint.py`` enforces a clean package from
+inside the suite as well. Rules, marking semantics, and the inline
+suppression syntax are documented in
+``openembedding_tpu/analysis/lint.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, _ROOT)
+
+
+def _load_lint():
+    """Load analysis/lint.py standalone (stdlib-only by design): going
+    through `import openembedding_tpu` would pull jax in for a pure AST
+    walk and turn a sub-second CI gate into a multi-second one."""
+    path = os.path.join(_ROOT, "openembedding_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("_graftlint_impl", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod   # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lint = _load_lint()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="jit-purity AST linter (rules JG001-JG004)")
+    ap.add_argument("paths", nargs="+",
+                    help=".py files or directories to lint")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated subset of rules to enforce "
+                         "(default: all)")
+    args = ap.parse_args(argv)
+    only = {r.strip() for r in args.rules.split(",") if r.strip()}
+    violations = lint.lint_paths(args.paths)
+    if only:
+        # JG000 (unparseable file) is never filterable: a gate that
+        # "passes" a file it linted zero lines of is no gate
+        violations = [v for v in violations
+                      if v.rule in only or v.rule == "JG000"]
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"graftlint: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
