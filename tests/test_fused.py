@@ -98,6 +98,7 @@ def test_fused_parity_with_per_feature(devices8):
             batch_sharded=False)
 
 
+@pytest.mark.slow
 def test_fused_training_end_to_end(devices8):
     mesh = create_mesh(2, 4, devices8)
     specs, mapper = make_fused_specs(
