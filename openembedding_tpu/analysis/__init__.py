@@ -9,17 +9,27 @@ Three enforcement layers, all mechanical (ISSUE 3):
 * :mod:`.lint` — a jit-purity AST linter over the package's own source
   (host-state mutation under trace, tracer materialization, retrace-risk
   branches, undonated step functions). CLI: ``python -m tools.graftlint``.
+* :mod:`.concurrency` — graftrace (ISSUE 4): a lock-discipline linter
+  over the threaded host planes (rules JG101-JG104, CLI
+  ``python -m tools.graftrace``), runtime TracedLock/TracedRLock
+  wrappers with lock-order-cycle (potential-deadlock) detection and
+  contention counters, and the deterministic interleaving harness
+  (``sync_point``/``SerialSchedule``/``PointGate``).
 * :mod:`.retrace` — a runtime guard that counts XLA compilations around
   a training loop and fails past a declared budget.
 
-Import discipline: ``contracts`` and ``lint`` are stdlib-only and
-imported eagerly, so every subsystem module (and the graftlint CLI) can
-use ``@host_fn`` / the parsers without paying for jax. ``retrace``
-(imports jax) and ``programs`` (lowers real programs) load lazily via
-module ``__getattr__`` — the public surface is unchanged.
+Import discipline: ``contracts``, ``lint``, and ``concurrency`` are
+stdlib-only and imported eagerly, so every subsystem module (and the
+graftlint/graftrace CLIs) can use ``@host_fn`` / ``make_lock`` /
+``sync_point`` without paying for jax. ``retrace`` (imports jax) and
+``programs`` (lowers real programs) load lazily via module
+``__getattr__`` — the public surface is unchanged.
 """
 
-from . import contracts, lint
+from . import concurrency, contracts, lint
+from .concurrency import (TraceViolation, TracedLock, TracedRLock,
+                          make_lock, make_rlock, sync_point,
+                          trace_paths, trace_source)
 from .contracts import (ContractViolation, ProgramContract, OpBudget,
                         REGISTRY, check_program, collect_collectives,
                         summarize, check_a2a_pull_hlo)
@@ -42,10 +52,12 @@ def __getattr__(name):  # PEP 562: defer the jax-importing submodules
 
 
 __all__ = [
-    "contracts", "lint", "retrace", "programs",
+    "concurrency", "contracts", "lint", "retrace", "programs",
     "ContractViolation", "ProgramContract", "OpBudget", "REGISTRY",
     "check_program", "collect_collectives", "summarize",
     "check_a2a_pull_hlo",
     "LintViolation", "host_fn", "lint_paths", "lint_source",
+    "TraceViolation", "TracedLock", "TracedRLock", "make_lock",
+    "make_rlock", "sync_point", "trace_paths", "trace_source",
     "RetraceBudgetExceeded", "RetraceGuard",
 ]

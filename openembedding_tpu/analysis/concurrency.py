@@ -1,0 +1,1087 @@
+"""graftrace: lock-discipline analysis for the threaded host planes.
+
+The graftcheck gate (:mod:`.contracts`) and graftlint (:mod:`.lint`)
+prove invariants of the *jitted* device program; the host side that
+keeps a production PS alive — offload's daemon writer/persister threads
+(``offload.py``), the HA failover/registry/REST serving plane
+(``serving/``), and the shared observability counters — is real
+multithreaded code. This module is the third leg of the static-analysis
+gate: it finds lock-discipline bugs the way Eraser (lockset analysis,
+Savage et al. 1997) and ThreadSanitizer (happens-before detection,
+Serebryany & Iskhodzhanov 2009) showed is mechanical, in three planes:
+
+**1. Static lock-discipline linter** (AST, same shape as :mod:`.lint`,
+stdlib-only so ``tools/graftrace.py`` loads it standalone)::
+
+    JG100  file fails to parse (linted zero lines)
+    JG101  unguarded shared-field access in a thread-spawning class
+    JG102  inconsistent lock-acquisition order (cycle in the static
+           lock-order graph)
+    JG103  blocking call while holding a lock
+    JG104  daemon thread with no join/shutdown path
+
+Scope and honesty: JG101 is per-class lockset analysis. A class is
+analyzed only when it BOTH owns a lock field and spawns a thread — a
+class with locks but no threads protects against *callers'* threads the
+analyzer cannot see (cross-module spawns like the Trainer's lookahead
+driving ``offload.host_prepare`` are invisible; the runtime plane below
+covers those). A field is *shared* when it is written outside
+``__init__`` and accessed both from a thread-entry-reachable unit and
+from elsewhere; it has a *discipline* when at least one access holds a
+lock. Violations are accesses of disciplined shared fields holding no
+guard lock — plus a field-level report when the accesses' locksets have
+an empty intersection (no common lock). Held-lock context propagates
+interprocedurally by call-site intersection: a method invoked *only*
+from inside ``with self._lock:`` blocks is analyzed with that lock held
+(the ``offload._evict`` pattern). Fields guarded purely by a
+join/happens-before protocol (never locked anywhere — the offload host
+store) are deliberately out of JG101's reach; they are what the
+deterministic interleaving harness pins instead.
+
+Suppression syntax — on the offending line or its enclosing ``def``
+line::
+
+    self.count += 1          # graftrace: disable=JG101
+    def worker(self):        # graftrace: disable=JG101,JG103
+
+CLI: ``python -m tools.graftrace openembedding_tpu/`` (nonzero exit on
+violations) — wired into CI next to graftlint/graftcheck.
+
+**2. Runtime detection** — :class:`TracedLock` / :class:`TracedRLock`
+wrappers feeding a process-global lock-order graph with cycle detection
+(*potential* deadlocks are reported even when never realized: an A→B
+edge recorded anywhere plus a later B→A acquisition is a report, no
+matter how the schedule happened to land) and per-lock contention /
+wait / hold counters (:func:`lock_stats`, surfaced through
+``utils/observability.py``). Opt-in: :func:`make_lock` /
+:func:`make_rlock` return plain ``threading`` locks unless
+``OE_REPORT_TRACE_LOCKS=1`` (the EnvConfig ``report.trace_locks``
+field) or :func:`set_trace_locks` — production paths pay nothing.
+
+**3. Deterministic interleaving harness** — :func:`sync_point` markers
+(no-op global ``None`` check when no schedule is installed) at the
+instrumented lock/thread points of offload, serving, and the Trainer
+lookahead; :class:`SerialSchedule` replays a prescribed cross-thread
+order and :class:`PointGate` holds named points closed until the test
+releases them, turning the raciest interleavings into reproducible
+regression tests (``tests/test_interleaving.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import threading
+import time
+import tokenize
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "JG100": "file fails to parse (linted zero lines)",
+    "JG101": "unguarded shared-field access in a thread-spawning class",
+    "JG102": "inconsistent lock-acquisition order (cycle in the static "
+             "lock-order graph)",
+    "JG103": "blocking call while holding a lock",
+    "JG104": "daemon thread with no join/shutdown path",
+}
+
+# constructors whose result is a lock for guard/order purposes (Condition
+# wraps a lock; Event/Semaphore are NOT guards)
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "TracedLock", "TracedRLock",
+               "make_lock", "make_rlock"}
+
+# callee names that block the calling thread (JG103). Deliberately
+# narrow — `.wait()` is excluded (Condition.wait RELEASES its lock and
+# is the sanctioned pattern), `.join` is special-cased to thread-bound
+# receivers below (str.join would drown the rule in false positives).
+_BLOCKING = {"sleep", "urlopen", "urlretrieve", "block_until_ready",
+             "device_get", "getaddrinfo", "create_connection",
+             "check_output", "check_call"}
+
+# receiver-method names that mutate their receiver (shared with the
+# graftlint JG001 notion; an access via these counts as a WRITE)
+_MUTATORS = {"append", "extend", "update", "insert", "setdefault", "pop",
+             "popleft", "remove", "discard", "clear", "add", "write",
+             "put", "increment"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftrace:\s*disable(?P<eq>=)?(?P<rules>[A-Za-z0-9, ]*)")
+_RULE_TOKEN_RE = re.compile(r"JG\d+")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceViolation:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message} " \
+               f"[{RULES[self.rule]}]"
+
+
+def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule set (None = all rules) from comments."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            if m.group("eq"):
+                # explicit rule list: parse FAIL-CLOSED — only tokens
+                # shaped JGxxx count (case-normalized), and a list that
+                # parses to nothing suppresses nothing. The alternative
+                # (treating `disable=jg1o3` as bare `disable`) would
+                # silently widen a typo into a blanket suppression.
+                out[tok.start[0]] = {
+                    t for t in (s.strip().upper()
+                                for s in m.group("rules").split(","))
+                    if _RULE_TOKEN_RE.fullmatch(t)}
+            else:
+                out[tok.start[0]] = None    # bare disable = all rules
+    except (tokenize.TokenError, SyntaxError):
+        # IndentationError (a SyntaxError) escapes tokenize on malformed
+        # source — swallow it here so ast.parse gets to report JG100
+        pass
+    return out
+
+
+def _reaches_in(succ, src, dst) -> bool:
+    """dst reachable from src in the successor mapping ``succ`` — shared
+    by the static JG102 pass (LockId keys) and the runtime lock-order
+    graph (name keys)."""
+    seen, work = set(), [src]
+    while work:
+        n = work.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        work.extend(succ.get(n, ()))
+    return False
+
+
+def _call_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """'x' for ``self.x``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _receiver_base(expr: ast.expr) -> ast.expr:
+    """Innermost base of a dotted/subscripted chain."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr
+
+
+def _self_field(expr: ast.expr) -> Optional[str]:
+    """'x' for ``self.x``, ``self.x[...]``, ``self.x.y[...]`` — the field
+    hanging directly off ``self`` in a dotted/subscripted chain."""
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return expr.attr
+        expr = expr.value
+    return None
+
+
+# lock identity: ("<ClassName>", attr) for self attrs, ("", name) for
+# module-level locks
+LockId = Tuple[str, str]
+
+
+def _lock_id_of(expr: ast.expr, cls: Optional["_ClassInfo"],
+                module_locks: Set[str]) -> Optional[LockId]:
+    attr = _self_attr(expr)
+    if attr is not None and cls is not None and attr in cls.lock_fields:
+        return (cls.name, attr)
+    if isinstance(expr, ast.Name) and expr.id in module_locks:
+        return ("", expr.id)
+    return None
+
+
+def _fmt_lock(lock: LockId) -> str:
+    return f"{lock[0]}.{lock[1]}" if lock[0] else lock[1]
+
+
+@dataclasses.dataclass
+class _Unit:
+    """One analyzable code body: a method, or a function nested inside
+    one (thread targets are usually nested ``_run`` defs)."""
+
+    name: str
+    node: ast.AST
+    cls: Optional["_ClassInfo"]
+    entry_held: Set[LockId] = dataclasses.field(default_factory=set)
+    # (frozenset(entry_held), held_at) memo for _lexical_held — JG101's
+    # fixed point, the order-graph warm-up, and JG103 all walk the same
+    # units; the held map only changes when entry_held does
+    held_cache: Optional[Tuple[frozenset, Dict[int, Set[LockId]]]] = None
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    node: ast.ClassDef
+    lock_fields: Set[str] = dataclasses.field(default_factory=set)
+    method_names: Set[str] = dataclasses.field(default_factory=set)
+    thread_attrs: Set[str] = dataclasses.field(default_factory=set)
+    spawns_thread: bool = False
+    units: List[_Unit] = dataclasses.field(default_factory=list)
+    # thread target names: self-attr method names and nested-def names
+    thread_targets: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class _Access:
+    field: str
+    line: int
+    held: Set[LockId]
+    unit: _Unit
+    write: bool
+
+
+class _ThreadBinding:
+    """One ``threading.Thread(...)`` creation site (JG104 bookkeeping)."""
+
+    def __init__(self, node: ast.Call, daemon: bool,
+                 bound_name: Optional[str], bound_attr: Optional[str],
+                 cls: Optional[str]):
+        self.node = node
+        self.daemon = daemon
+        self.bound_name = bound_name   # local/module variable name
+        self.bound_attr = bound_attr   # self.<attr> name
+        self.cls = cls                 # owning class, for attr scoping
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    return _call_name(call.func) == "Thread"
+
+
+def _thread_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _thread_target(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+class Analyzer:
+    """Single-file analyzer; :func:`trace_source` is the functional
+    entry point."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.violations: List[TraceViolation] = []
+        self.suppress = _suppressions(source)
+        self.module_locks: Set[str] = set()
+        self.classes: List[_ClassInfo] = []
+        self.module_units: List[_Unit] = []
+        self.thread_bindings: List[_ThreadBinding] = []
+        # name -> bound-from-Thread (for `.join` receiver resolution)
+        self.thread_names: Set[str] = set()
+        self.thread_attr_by_class: Dict[str, Set[str]] = {}
+        self.joined_names: Set[str] = set()
+        self.joined_attrs_by_class: Dict[str, Set[str]] = {}
+        # static lock-order graph: edge -> first line it was observed on
+        self.order_edges: Dict[Tuple[LockId, LockId], int] = {}
+
+    # -- suppression ---------------------------------------------------------
+    def _suppressed(self, rule: str, line: int,
+                    def_line: Optional[int]) -> bool:
+        for ln in (line, def_line):
+            if ln is None or ln not in self.suppress:
+                continue
+            rules = self.suppress[ln]
+            if rules is None or rule in rules:
+                return True
+        return False
+
+    def _emit(self, rule: str, line: int, msg: str,
+              def_line: Optional[int] = None) -> None:
+        if not self._suppressed(rule, line, def_line):
+            self.violations.append(
+                TraceViolation(self.path, line, rule, msg))
+
+    # -- indexing ------------------------------------------------------------
+    def _index(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _call_name(node.value.func) in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+            if isinstance(node, ast.ClassDef):
+                self.classes.append(self._index_class(node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_units.append(_Unit(node.name, node, None))
+        # thread bindings + joins, module-wide
+        self._index_threads(tree)
+
+    def _index_class(self, node: ast.ClassDef) -> _ClassInfo:
+        info = _ClassInfo(name=node.name, node=node)
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            info.method_names.add(item.name)
+            info.units.append(_Unit(f"{node.name}.{item.name}", item, info))
+            # nested defs are separate units (thread-target bodies)
+            for sub in ast.walk(item):
+                if sub is not item and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info.units.append(_Unit(
+                        f"{node.name}.{item.name}.{sub.name}", sub, info))
+        for unit in info.units:
+            for sub in self._own_nodes(unit.node):
+                # lock fields: self.x = threading.Lock()/make_lock(...)
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call) and \
+                        _call_name(sub.value.func) in _LOCK_CTORS:
+                    for t in sub.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            info.lock_fields.add(attr)
+                if isinstance(sub, ast.Call) and _is_thread_ctor(sub):
+                    info.spawns_thread = True
+                    target = _thread_target(sub)
+                    if target is not None:
+                        attr = _self_attr(target)
+                        if attr:
+                            info.thread_targets.add(attr)
+                        elif isinstance(target, ast.Name):
+                            info.thread_targets.add(target.id)
+        return info
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+        """All nodes of a unit excluding nested function bodies."""
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk(child)
+        yield from walk(fn)
+
+    def _index_threads(self, tree: ast.Module) -> None:
+        """Thread creations, their bindings, and every ``.join`` receiver
+        (JG104's join-path evidence). Attr bindings are scoped per class;
+        bare-name bindings are module-wide (a name joined anywhere in the
+        module counts — the Trainer's chained-prep idiom joins under a
+        different binding of the same loop variable)."""
+        cls_of: Dict[int, str] = {}
+        for cls in self.classes:
+            for sub in ast.walk(cls.node):
+                cls_of[id(sub)] = cls.name
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_thread_ctor(node.value):
+                cls = cls_of.get(id(node))
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        self.thread_bindings.append(_ThreadBinding(
+                            node.value, _thread_daemon(node.value),
+                            None, attr, cls))
+                        if cls:
+                            ci = next(c for c in self.classes
+                                      if c.name == cls)
+                            ci.thread_attrs.add(attr)
+                    elif isinstance(t, ast.Name):
+                        self.thread_bindings.append(_ThreadBinding(
+                            node.value, _thread_daemon(node.value),
+                            t.id, None, cls))
+                        self.thread_names.add(t.id)
+            # Thread() creations NOT bound by an Assign are caught
+            # directly in _check_jg104 via the bound_calls set
+            if isinstance(node, ast.Attribute) and node.attr == "join":
+                base = node.value
+                attr = _self_attr(base)
+                if attr:
+                    cls = cls_of.get(id(node), "")
+                    self.joined_attrs_by_class.setdefault(
+                        cls, set()).add(attr)
+                elif isinstance(base, ast.Name):
+                    self.joined_names.add(base.id)
+
+    # -- held-lock computation ----------------------------------------------
+    def _lexical_held(self, unit: _Unit) -> Dict[int, Set[LockId]]:
+        """node-id -> lock set held lexically at that node (with-blocks),
+        plus the unit's entry-held context."""
+        key = frozenset(unit.entry_held)
+        if unit.held_cache is not None and unit.held_cache[0] == key:
+            return unit.held_cache[1]
+        held_at: Dict[int, Set[LockId]] = {}
+        cls = unit.cls
+        mlocks = self.module_locks
+
+        def walk(node: ast.AST, held: Set[LockId]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.With):
+                    acquired: Set[LockId] = set()
+                    for item in child.items:
+                        lock = _lock_id_of(item.context_expr, cls, mlocks)
+                        if lock is not None:
+                            acquired.add(lock)
+                            # record static lock-order edges
+                            for h in held | acquired - {lock}:
+                                if h != lock:
+                                    self.order_edges.setdefault(
+                                        (h, lock), child.lineno)
+                    held_at[id(child)] = set(held)
+                    walk(child, held | acquired)
+                    continue
+                held_at[id(child)] = set(held)
+                walk(child, held)
+
+        walk(unit.node, set(unit.entry_held))
+        unit.held_cache = (key, held_at)
+        return held_at
+
+    def _propagate_entry_held(self, cls: _ClassInfo) -> None:
+        """Fixed point: a method called ONLY under lock L inherits L."""
+        method_units = {u.name.split(".", 1)[1]: u for u in cls.units
+                        if u.name.count(".") == 1}
+        for _ in range(len(method_units) + 1):
+            changed = False
+            # gather call sites per method with current contexts
+            sites: Dict[str, List[Set[LockId]]] = {m: []
+                                                   for m in method_units}
+            for unit in cls.units:
+                held_at = self._lexical_held(unit)
+                for node in self._own_nodes(unit.node):
+                    if isinstance(node, ast.Call):
+                        attr = _self_attr(node.func)
+                        if attr in method_units:
+                            sites[attr].append(held_at.get(id(node),
+                                                           set()))
+            for m, contexts in sites.items():
+                new = (set.intersection(*contexts) if contexts else set())
+                if new != method_units[m].entry_held:
+                    method_units[m].entry_held = new
+                    changed = True
+            if not changed:
+                break
+
+    # -- thread reachability -------------------------------------------------
+    def _thread_reachable(self, cls: _ClassInfo) -> Set[int]:
+        """ids of units reachable from this class's thread entries."""
+        by_method: Dict[str, _Unit] = {}
+        by_nested: Dict[str, List[_Unit]] = {}
+        for u in cls.units:
+            parts = u.name.split(".")
+            if len(parts) == 2:
+                by_method[parts[1]] = u
+            else:
+                by_nested.setdefault(parts[-1], []).append(u)
+
+        entries: List[_Unit] = []
+        for t in cls.thread_targets:
+            if t in by_method:
+                entries.append(by_method[t])
+            entries.extend(by_nested.get(t, ()))
+        reach: Set[int] = set()
+        work = list(entries)
+        while work:
+            u = work.pop()
+            if id(u) in reach:
+                continue
+            reach.add(id(u))
+            for node in self._own_nodes(u.node):
+                if isinstance(node, ast.Call):
+                    attr = _self_attr(node.func)
+                    if attr in by_method and id(by_method[attr]) not in reach:
+                        work.append(by_method[attr])
+        return reach
+
+    # -- accesses ------------------------------------------------------------
+    def _collect_accesses(self, cls: _ClassInfo) -> List[_Access]:
+        out: List[_Access] = []
+        skip = cls.lock_fields | cls.method_names | cls.thread_attrs
+        for unit in cls.units:
+            if unit.node.name in ("__init__", "__post_init__"):
+                continue
+            held_at = self._lexical_held(unit)
+            write_ids: Set[int] = set()
+            for node in self._own_nodes(unit.node):
+                targets: List[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_field(t)
+                    if attr and attr not in skip:
+                        out.append(_Access(attr, node.lineno,
+                                           held_at.get(id(node), set()),
+                                           unit, write=True))
+                        for sub in ast.walk(t):
+                            write_ids.add(id(sub))
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS:
+                    attr = _self_field(node.func.value)
+                    if attr and attr not in skip:
+                        out.append(_Access(attr, node.lineno,
+                                           held_at.get(id(node), set()),
+                                           unit, write=True))
+                        for sub in ast.walk(node.func):
+                            write_ids.add(id(sub))
+            # reads: remaining self.F loads not already counted as writes
+            for node in self._own_nodes(unit.node):
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        id(node) not in write_ids:
+                    attr = _self_attr(node)
+                    if attr and attr not in skip:
+                        out.append(_Access(attr, node.lineno,
+                                           held_at.get(id(node), set()),
+                                           unit, write=False))
+        return out
+
+    # -- rules ---------------------------------------------------------------
+    def _check_jg101(self, cls: _ClassInfo) -> None:
+        if not cls.lock_fields or not cls.spawns_thread:
+            return
+        self._propagate_entry_held(cls)
+        reach = self._thread_reachable(cls)
+        accesses = self._collect_accesses(cls)
+        by_field: Dict[str, List[_Access]] = {}
+        for a in accesses:
+            by_field.setdefault(a.field, []).append(a)
+        for field, accs in sorted(by_field.items()):
+            written = any(a.write for a in accs)
+            in_thread = any(id(a.unit) in reach for a in accs)
+            outside = any(id(a.unit) not in reach for a in accs)
+            if not (written and in_thread and outside):
+                continue            # not shared, or read-only config
+            guards = set().union(*(a.held for a in accs))
+            if not guards:
+                continue            # join/happens-before protocol field
+            bare = [a for a in accs if not a.held]
+            for a in bare:
+                where = ("thread-reachable " if id(a.unit) in reach
+                         else "")
+                self._emit(
+                    "JG101", a.line,
+                    f"field `self.{field}` is guarded by "
+                    f"{sorted(_fmt_lock(g) for g in guards)} elsewhere "
+                    f"but accessed lock-free in {where}"
+                    f"`{a.unit.name}`", a.unit.node.lineno)
+            if not bare:
+                common = set.intersection(*(a.held for a in accs))
+                if not common:
+                    first = min(accs, key=lambda a: a.line)
+                    locksets = sorted(
+                        {tuple(sorted(_fmt_lock(g) for g in a.held))
+                         for a in accs})
+                    self._emit(
+                        "JG101", first.line,
+                        f"accesses of `self.{field}` hold no COMMON "
+                        f"lock (locksets seen: {locksets})",
+                        first.unit.node.lineno)
+
+    def _check_jg102(self) -> None:
+        """Cycle in the static lock-order graph: report every edge that
+        participates in a cycle (each is a fix site)."""
+        succ: Dict[LockId, Set[LockId]] = {}
+        for (a, b) in self.order_edges:
+            succ.setdefault(a, set()).add(b)
+
+        for (a, b), line in sorted(self.order_edges.items(),
+                                   key=lambda kv: kv[1]):
+            if _reaches_in(succ, b, a):
+                self._emit(
+                    "JG102", line,
+                    f"acquiring `{_fmt_lock(b)}` while holding "
+                    f"`{_fmt_lock(a)}` closes a lock-order cycle "
+                    f"(`{_fmt_lock(b)}` is also acquired before "
+                    f"`{_fmt_lock(a)}` elsewhere)")
+
+    def _check_jg103(self) -> None:
+        all_units = list(self.module_units)
+        for cls in self.classes:
+            all_units.extend(cls.units)
+        for unit in all_units:
+            held_at = self._lexical_held(unit)
+            for node in self._own_nodes(unit.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                held = held_at.get(id(node), set())
+                if not held:
+                    continue
+                name = _call_name(node.func)
+                blocking = name in _BLOCKING
+                if not blocking and name == "join" and \
+                        isinstance(node.func, ast.Attribute):
+                    base = node.func.value
+                    attr = _self_attr(base)
+                    if attr and unit.cls and attr in unit.cls.thread_attrs:
+                        blocking = True
+                    elif isinstance(base, ast.Name) and \
+                            base.id in self.thread_names:
+                        blocking = True
+                if blocking:
+                    self._emit(
+                        "JG103", node.lineno,
+                        f"`{ast.unparse(node.func)}(...)` blocks while "
+                        f"holding {sorted(_fmt_lock(h) for h in held)} — "
+                        "every other thread needing the lock stalls "
+                        "behind the wait", unit.node.lineno)
+
+    def _check_jg104(self, tree: ast.Module) -> None:
+        bound_calls = {id(b.node) for b in self.thread_bindings}
+        # unbound daemon creations: Thread(...).start() / bare Thread(...)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_thread_ctor(node) \
+                    and id(node) not in bound_calls \
+                    and _thread_daemon(node):
+                self._emit(
+                    "JG104", node.lineno,
+                    "fire-and-forget daemon thread: nothing can join it, "
+                    "observe its exception, or shut it down — it dies "
+                    "with the interpreter mid-work")
+        for b in self.thread_bindings:
+            if not b.daemon:
+                continue
+            if b.bound_name is not None:
+                if b.bound_name not in self.joined_names:
+                    self._emit(
+                        "JG104", b.node.lineno,
+                        f"daemon thread bound to `{b.bound_name}` is "
+                        "never joined anywhere in this module — errors "
+                        "and shutdown are silent")
+            elif b.bound_attr is not None:
+                joined = self.joined_attrs_by_class.get(b.cls or "", set())
+                if b.bound_attr not in joined:
+                    self._emit(
+                        "JG104", b.node.lineno,
+                        f"daemon thread stored in `self.{b.bound_attr}` "
+                        f"is never joined by {b.cls or 'this module'} — "
+                        "errors and shutdown are silent")
+
+    # -- main ----------------------------------------------------------------
+    def run(self) -> List[TraceViolation]:
+        try:
+            tree = ast.parse(self.source)
+        except SyntaxError as e:
+            self.violations.append(TraceViolation(
+                self.path, e.lineno or 0, "JG100",
+                f"file does not parse: {e.msg}"))
+            return self.violations
+        self._index(tree)
+        for cls in self.classes:
+            self._check_jg101(cls)
+        # populate the static lock-order graph over EVERY unit before the
+        # cycle check — module-level functions matter too (module locks
+        # order against class locks); entry-held propagation first where
+        # a class owns locks, so interprocedurally-held edges appear
+        # (_check_jg101 already propagated the thread-spawning classes)
+        for cls in self.classes:
+            if cls.lock_fields and not cls.spawns_thread:
+                self._propagate_entry_held(cls)
+        for unit in self.module_units:
+            self._lexical_held(unit)
+        for cls in self.classes:
+            for u in cls.units:
+                self._lexical_held(u)
+        self._check_jg102()
+        self._check_jg103()
+        self._check_jg104(tree)
+        self.violations.sort(key=lambda v: (v.line, v.rule))
+        return self.violations
+
+
+def trace_source(source: str, path: str = "<string>"
+                 ) -> List[TraceViolation]:
+    """Analyze one module's source text."""
+    return Analyzer(path, source).run()
+
+
+def trace_paths(paths: Sequence[str]) -> List[TraceViolation]:
+    """Analyze files and/or directory trees (``.py``, recursively)."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        else:
+            files.append(p)
+    out: List[TraceViolation] = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            out.extend(trace_source(fh.read(), f))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Runtime plane: traced locks, lock-order graph, contention counters
+# ---------------------------------------------------------------------------
+
+_TRACE_ENV = "OE_REPORT_TRACE_LOCKS"
+_trace_forced: Optional[bool] = None
+
+_RT = threading.Lock()                   # guards the registries below
+_ORDER: Dict[str, Set[str]] = {}         # lock name -> successors
+_CYCLES: List[str] = []                  # recorded potential deadlocks
+_CYCLE_PAIRS: Set[Tuple[str, str]] = set()
+_STATS: Dict[str, Dict[str, float]] = {}
+_HELD = threading.local()                # .stack: [(name, t_acquired)]
+_STACKS: List[list] = []                 # every thread's held stack, for
+                                         # cross-thread releases
+
+
+def set_trace_locks(on: Optional[bool]) -> None:
+    """Force runtime lock tracing on/off; ``None`` restores the
+    environment-variable default (``OE_REPORT_TRACE_LOCKS``)."""
+    global _trace_forced
+    _trace_forced = on
+
+
+def trace_locks_enabled() -> bool:
+    if _trace_forced is not None:
+        return _trace_forced
+    v = os.environ.get(_TRACE_ENV, "")
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def make_lock(name: str):
+    """A named lock: :class:`TracedLock` when tracing is enabled, a plain
+    ``threading.Lock`` otherwise (the enablement check runs ONCE, at
+    construction — production paths pay nothing per acquire)."""
+    return TracedLock(name) if trace_locks_enabled() else threading.Lock()
+
+
+def make_rlock(name: str):
+    """Reentrant variant of :func:`make_lock`."""
+    return TracedRLock(name) if trace_locks_enabled() \
+        else threading.RLock()
+
+
+def reset_runtime() -> None:
+    """Clear the lock-order graph, recorded cycles, and counters
+    (test isolation)."""
+    with _RT:
+        _ORDER.clear()
+        _CYCLES.clear()
+        _CYCLE_PAIRS.clear()
+        _STATS.clear()
+        # _STACKS is NOT pruned: each live thread's thread-local still
+        # references its (usually empty) list, and dropping it here
+        # would orphan the thread from cross-thread release lookups.
+        # A dead thread leaks one empty list — negligible.
+
+
+def potential_deadlocks() -> List[str]:
+    """Every lock-order cycle the traced locks have observed so far —
+    *potential* deadlocks: an A→B ordering recorded anywhere plus a
+    B→A acquisition is reported even if the schedule never realized the
+    deadlock (the lock-order-graph method, same as the static JG102 but
+    over the orders that actually executed)."""
+    with _RT:
+        return list(_CYCLES)
+
+
+def lock_stats() -> Dict[str, Dict[str, float]]:
+    """Per-lock runtime counters: ``acquires``, ``contended`` (acquire
+    found the lock held), ``wait_s`` (time blocked acquiring), ``hold_s``
+    (time held). Surfaced through ``observability.lock_stats()``."""
+    with _RT:
+        return {k: dict(v) for k, v in _STATS.items()}
+
+
+def _stat(name: str) -> Dict[str, float]:
+    return _STATS.setdefault(name, {"acquires": 0, "contended": 0,
+                                    "wait_s": 0.0, "hold_s": 0.0})
+
+
+def _held_stack() -> list:
+    stack = getattr(_HELD, "stack", None)
+    if stack is None:
+        stack = _HELD.stack = []
+        with _RT:
+            _STACKS.append(stack)
+    return stack
+
+
+def _note_acquired(name: str, contended: bool, wait: float) -> None:
+    stack = _held_stack()
+    with _RT:
+        st = _stat(name)
+        st["acquires"] += 1
+        st["contended"] += 1 if contended else 0
+        st["wait_s"] += wait
+        for held, _t0 in stack:
+            if held == name:
+                continue
+            _ORDER.setdefault(held, set()).add(name)
+            # closing edge? then name ->* held already existed
+            if (held, name) not in _CYCLE_PAIRS and \
+                    _reaches_in(_ORDER, name, held):
+                _CYCLE_PAIRS.add((held, name))
+                _CYCLE_PAIRS.add((name, held))
+                _CYCLES.append(
+                    f"potential deadlock: `{held}` -> `{name}` acquired "
+                    f"while the reverse order `{name}` -> `{held}` was "
+                    "recorded earlier")
+        # under _RT: the cross-thread-release branch below scans and
+        # pops OTHER threads' stacks, so even own-stack mutation races
+        # against it lock-free
+        stack.append((name, time.perf_counter()))
+
+
+def _note_released(name: str) -> None:
+    stack = _held_stack()
+    with _RT:
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == name:
+                _n, t0 = stack.pop(i)
+                _stat(name)["hold_s"] += time.perf_counter() - t0
+                return
+        # released by a thread other than the acquirer — legal for
+        # threading.Lock (handoff/signaling patterns). Close the
+        # acquirer's entry: left stale, it would fabricate an order edge
+        # for every lock that thread acquires next, poisoning
+        # potential_deadlocks()
+        for other in _STACKS:
+            if other is stack:
+                continue
+            for i in range(len(other) - 1, -1, -1):
+                if other[i][0] == name:
+                    _n, t0 = other.pop(i)
+                    _stat(name)["hold_s"] += time.perf_counter() - t0
+                    return
+
+
+class TracedLock:
+    """``threading.Lock`` wrapper feeding the lock-order graph and the
+    contention/hold counters; every acquire/release is also a
+    :func:`sync_point` (``lock:<name>:acquire`` / ``:release``) so the
+    interleaving harness can schedule around it."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = self._make_inner()
+        self._depth = threading.local()
+        self._owner: Optional[int] = None   # holder ident (reentrant only)
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def _depth_get(self) -> int:
+        return getattr(self._depth, "n", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        sync_point(f"lock:{self.name}:acquire")
+        if self._reentrant and self._depth_get() > 0:
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                self._depth.n = self._depth_get() + 1
+            return got
+        t0 = time.perf_counter()
+        got = self._inner.acquire(False)
+        contended = not got
+        if not got and blocking:
+            got = self._inner.acquire(True, timeout)
+        if got:
+            _note_acquired(self.name, contended,
+                           time.perf_counter() - t0)
+            if self._reentrant:
+                self._depth.n = self._depth_get() + 1
+                self._owner = threading.get_ident()
+        return got
+
+    def release(self) -> None:
+        if self._reentrant:
+            self._depth.n = self._depth_get() - 1
+            if self._depth.n > 0:
+                self._inner.release()
+                return
+            self._owner = None
+        _note_released(self.name)
+        self._inner.release()
+        sync_point(f"lock:{self.name}:release")
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TracedRLock(TracedLock):
+    """Reentrant :class:`TracedLock`: only the outermost acquire/release
+    updates the order graph and the hold timer."""
+
+    _reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def locked(self) -> bool:
+        # RLock grows .locked() only in Python 3.14; the owner field
+        # kept by the outermost acquire/release answers without touching
+        # the lock itself (an acquire-probe would steal the lock for a
+        # moment and spuriously fail concurrent non-blocking acquires)
+        return self._owner is not None
+
+
+# ---------------------------------------------------------------------------
+# Deterministic interleaving harness
+# ---------------------------------------------------------------------------
+
+_SCHEDULE = None
+
+
+def install_schedule(schedule) -> None:
+    """Install a schedule (``SerialSchedule``/``PointGate``/anything with
+    ``sync(key, point)``); :func:`clear_schedule` removes it. ONE global
+    slot: schedules are a test-harness facility, not production state."""
+    global _SCHEDULE
+    _SCHEDULE = schedule
+
+
+def clear_schedule() -> None:
+    global _SCHEDULE
+    _SCHEDULE = None
+
+
+def sync_point(point: str) -> None:
+    """Named interleaving marker. A no-op (one global ``None`` check)
+    unless a schedule is installed; then the schedule decides when the
+    calling thread may proceed. Keys are matched as the bare ``point``
+    or ``"<thread name>/<point>"`` (name the test's threads to address
+    them individually)."""
+    sched = _SCHEDULE
+    if sched is None:
+        return
+    sched.sync(f"{threading.current_thread().name}/{point}", point)
+
+
+class SerialSchedule:
+    """Replay a prescribed total order of sync points across threads.
+
+    ``order`` is a list of keys — ``"<thread>/<point>"`` to address one
+    thread's arrival, or a bare ``"<point>"`` to match whichever thread
+    arrives. A thread reaching a point that appears in the remaining
+    order blocks until its key is at the head; points not in the
+    remaining order pass through untouched. A ``timeout`` expiry raises
+    (a wedged schedule must fail the test, not hang the suite).
+    """
+
+    def __init__(self, order: Sequence[str], timeout: float = 20.0):
+        self._order = deque(order)
+        self._cv = threading.Condition()
+        self._timeout = timeout
+
+    def sync(self, key: str, point: str) -> None:
+        deadline = time.monotonic() + self._timeout
+        with self._cv:
+            while True:
+                if not self._order or (key not in self._order
+                                       and point not in self._order):
+                    return
+                head = self._order[0]
+                if head in (key, point):
+                    self._order.popleft()
+                    self._cv.notify_all()
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"schedule wedged: {key!r} waited for head "
+                        f"{head!r} (remaining order "
+                        f"{list(self._order)!r})")
+                self._cv.wait(remaining)
+
+    def done(self) -> bool:
+        with self._cv:
+            return not self._order
+
+
+class PointGate:
+    """Hold named sync points CLOSED until the test opens them.
+
+    ``gate = PointGate(["offload.writeback.scatter"])`` blocks any
+    thread reaching that point; ``gate.wait_arrival(point)`` lets the
+    test confirm a thread is parked there (the deterministic observation
+    window), and ``gate.open(point)`` releases it — and every later
+    arrival. Entries may be bare points (gate every thread) or
+    ``"<thread name>/<point>"`` keys (gate one thread — two named
+    threads parked at the same point is the canonical race-observation
+    window). Points not listed pass through untouched.
+    """
+
+    def __init__(self, points: Sequence[str], timeout: float = 20.0):
+        self._open = {p: threading.Event() for p in points}
+        self._arrived = {p: threading.Event() for p in points}
+        self._timeout = timeout
+
+    def sync(self, key: str, point: str) -> None:
+        # the thread-specific key wins over the bare point, so a test can
+        # gate "racer-0/p" while other threads pass "p" untouched
+        k = key if key in self._open else point
+        ev = self._open.get(k)
+        if ev is None:
+            return
+        self._arrived[k].set()
+        if not ev.wait(self._timeout):
+            raise TimeoutError(f"gate {k!r} never opened")
+
+    def wait_arrival(self, point: str, timeout: Optional[float] = None
+                     ) -> bool:
+        return self._arrived[point].wait(timeout or self._timeout)
+
+    def open(self, point: str) -> None:
+        self._open[point].set()
+
+    def open_all(self) -> None:
+        for ev in self._open.values():
+            ev.set()

@@ -136,7 +136,9 @@ def _suppressions(source: str) -> Dict[int, Optional[Set[str]]]:
             out[tok.start[0]] = (
                 {r.strip() for r in rules.split(",") if r.strip()}
                 if rules else None)
-    except tokenize.TokenError:
+    except (tokenize.TokenError, SyntaxError):
+        # IndentationError (a SyntaxError) escapes tokenize on malformed
+        # source — swallow it here so ast.parse gets to report JG000
         pass
     return out
 

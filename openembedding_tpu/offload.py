@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .analysis.concurrency import make_rlock, sync_point
 from .embedding import EmbeddingSpec
 from .meta import EmbeddingVariableMeta
 from .optim.initializers import make_initializer
@@ -486,8 +487,12 @@ class ShardedOffloadedTable:
         # apply_prepared/_evict mutate the books on the main thread — at
         # depth K >= 2 some prepare is always mid-flight when an apply
         # lands, so the read-compute-mark cycle must be atomic against
-        # the apply's planned->resident transfer and eviction's rebuild
-        self._book = threading.RLock()
+        # the apply's planned->resident transfer and eviction's rebuild.
+        # ALSO guards the _dirty marks (written by note_update/flush on
+        # the step thread, read+cleared by writeback launch/eviction).
+        # make_rlock: a plain RLock unless OE_REPORT_TRACE_LOCKS enables
+        # the graftrace runtime detector (analysis/concurrency.py)
+        self._book = make_rlock(f"offload.{self.name}.book")
         self.evictions = 0  # lifetime LRU-eviction count (observability)
         # prepares/applies redone because an eviction rebuilt residency
         # under them (the generation protocol's retry paths)
@@ -499,6 +504,12 @@ class ShardedOffloadedTable:
         self._batches_since_persist = 0
         self._writer: Optional[threading.Thread] = None
         self._writer_err: Optional[BaseException] = None
+        # rows the failed writeback left stale; re-marked dirty at the
+        # join (NOT by the writer thread itself — the evict path joins
+        # the writer while holding _book, so a writer-side _book acquire
+        # would deadlock). Written by the writer, read at join: the
+        # thread join is the happens-before edge, no lock involved.
+        self._writer_err_dirty: Optional[np.ndarray] = None
         self._persister: Optional[threading.Thread] = None
         self._persister_err: Optional[BaseException] = None
         # latest cumulative insert_failures copy; read ONLY at join
@@ -538,6 +549,12 @@ class ShardedOffloadedTable:
             self._writer = None
         if self._writer_err is not None:
             err, self._writer_err = self._writer_err, None
+            redo, self._writer_err_dirty = self._writer_err_dirty, None
+            if redo is not None:
+                # updates not written: re-mark so a later flush retries
+                # (over-marking rows re-dirtied meanwhile is harmless)
+                with self._book:
+                    self._dirty[redo] = True
             raise RuntimeError("async writeback failed") from err
 
     def _start_writeback(self, cache, dirty_ids: np.ndarray) -> None:
@@ -556,6 +573,7 @@ class ShardedOffloadedTable:
 
         def _run():
             try:
+                sync_point("offload.writeback.run")
                 host = {k: np.asarray(jax.device_get(v))
                         for k, v in arrays.items()}
                 keys = host["keys"]
@@ -569,6 +587,7 @@ class ShardedOffloadedTable:
                 mask[dirty_ids] = True
                 sel = mask[ids]
                 ids = ids[sel]
+                sync_point("offload.writeback.scatter")
                 if ids.size:
                     self.host_weights[ids] = host["weights"][live][sel]
                     for sname in self.host_slots:
@@ -576,15 +595,17 @@ class ShardedOffloadedTable:
                             host[f"slot_{sname}"][live][sel]
                     self.host_work_id[ids] = work
             except BaseException as e:  # noqa: BLE001 — re-raised at join
-                # updates not written: re-mark so a later flush retries
-                # (over-marking rows re-dirtied meanwhile is harmless)
-                self._dirty[dirty_ids] = True
+                # _writer_err_dirty re-marks the rows AT THE JOIN (see
+                # __init__: the writer must not take _book itself)
+                self._writer_err_dirty = dirty_ids
                 self._writer_err = e
 
         # clear eagerly so updates landing DURING the writeback re-mark
-        # their rows; restored on failure above
-        self._dirty[dirty_ids] = False
-        self._writer = threading.Thread(target=_run, daemon=True)
+        # their rows; restored at the join on failure
+        with self._book:
+            self._dirty[dirty_ids] = False
+        self._writer = threading.Thread(
+            target=_run, daemon=True, name=f"oe-writeback-{self.name}")
         self._writer.start()
 
     # --- cache management ---------------------------------------------------
@@ -885,6 +906,7 @@ class ShardedOffloadedTable:
         """LRU-batch eviction: write back dirty rows, keep the hottest
         survivors, rebuild the cache with them (open-addressing tables
         never delete, so eviction = writeback + rebuild-from-host)."""
+        sync_point("offload.evict")
         self._join_writeback()
         # eviction DISCARDS the cache (create_cache zeroes the cumulative
         # insert_failures) — read the pending overflow evidence from the
@@ -939,7 +961,8 @@ class ShardedOffloadedTable:
         if uniq is None:
             uniq = np.unique(np.asarray(ids).ravel())
             uniq = uniq[(uniq >= 0) & (uniq < self.vocab)]
-        self._dirty[uniq] = True
+        with self._book:
+            self._dirty[uniq] = True
         self.work_id += 1
         self._batches_since_persist += 1
         n = self.overflow_check_every_n_batches
@@ -950,9 +973,15 @@ class ShardedOffloadedTable:
 
     # --- persistence --------------------------------------------------------
     def flush(self, cache) -> int:
-        """Asynchronously write back all dirty rows (cache stays intact)."""
+        """Asynchronously write back all dirty rows (cache stays intact).
+        Raises any error a PREVIOUS async writeback stored, even when
+        nothing is dirty now (the join below would otherwise be skipped
+        and a dead writer's exception would sit unread until finish)."""
+        self._join_writeback()
         self.check_overflow(cache)
-        dirty_ids = np.nonzero(self._dirty)[0]
+        sync_point("offload.flush")
+        with self._book:
+            dirty_ids = np.nonzero(self._dirty)[0]
         if dirty_ids.size:
             self._start_writeback(cache, dirty_ids)
         return int(dirty_ids.size)
@@ -972,13 +1001,19 @@ class ShardedOffloadedTable:
             raise RuntimeError("async persist failed") from err
 
     def finish(self) -> None:
-        """End-of-loop barrier for the pipeline's loose ends: raises any
-        deferred insert overflow and joins/raises the async persist.
-        ``Trainer.fit`` calls this before returning; hand-driven loops
-        should too (a daemon persister thread would otherwise die with
-        the interpreter mid-write)."""
+        """End-of-loop barrier for the pipeline's loose ends: joins/raises
+        the async writeback and persist (both are joined even when the
+        writeback join raises, so a daemon persister is never left to die
+        mid-write at interpreter exit), then raises any deferred insert
+        overflow. ``Trainer.fit`` calls this before returning;
+        hand-driven loops should too. The joins come FIRST — same order
+        as ``flush`` — so a pending overflow raise cannot drop the stored
+        writeback error or skip the failed-row dirty re-mark."""
+        try:
+            self._join_writeback()
+        finally:
+            self._join_persist()
         self.check_overflow()
-        self._join_persist()
 
     def persist(self, cache, path: str, *,
                 blocking: bool = True) -> Dict[str, Any]:
@@ -1023,7 +1058,8 @@ class ShardedOffloadedTable:
                 self._persister_err = e
                 self.persisted_work = persisted
 
-        self._persister = threading.Thread(target=_run, daemon=True)
+        self._persister = threading.Thread(
+            target=_run, daemon=True, name=f"oe-persist-{self.name}")
         self._persister.start()
         return {"async": True, "work_id": work}
 

@@ -100,24 +100,32 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
                               compress=compress).start()
     print(f"replica: listening on {server.port}", flush=True)
 
-    for item in args.load:
-        sign, _, uri = item.partition("=")
-        registry.create_model(uri, model_sign=sign or None, block=True,
-                              shard_index=args.shard_index,
-                              shard_count=args.shard_count)
-        print(f"replica: loaded {sign or uri} "
-              f"(shard {args.shard_index}/{args.shard_count})", flush=True)
-
-    if peers:
-        n = restore_from_peers(registry, peers, compress=compress)
-        print(f"replica: restored {n} model(s) from peers", flush=True)
-
-    print("replica: ready", flush=True)
     try:
+        for item in args.load:
+            sign, _, uri = item.partition("=")
+            registry.create_model(uri, model_sign=sign or None, block=True,
+                                  shard_index=args.shard_index,
+                                  shard_count=args.shard_count)
+            print(f"replica: loaded {sign or uri} "
+                  f"(shard {args.shard_index}/{args.shard_count})",
+                  flush=True)
+
+        if peers:
+            n = restore_from_peers(registry, peers, compress=compress)
+            print(f"replica: restored {n} model(s) from peers", flush=True)
+
+        print("replica: ready", flush=True)
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
-        return 0
+        pass
+    finally:
+        # graceful — on ANY exit, including a failed boot load: join the
+        # accept loop + quiesce async loaders instead of letting daemon
+        # teardown kill them mid-commit (graftrace JG104 discipline
+        # applied to the daemon entry point)
+        server.stop()
+    return 0
 
 
 def restore_from_peers(registry, peers: Sequence[str],

@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis.concurrency import make_lock, sync_point
 from ..embedding import EmbeddingCollection, EmbeddingSpec
 from ..meta import ModelMeta, ModelStatus, UNBOUNDED_VOCAB
 from .. import checkpoint as ckpt_lib
@@ -213,9 +214,15 @@ class ModelRegistry:
     def __init__(self, mesh, *, default_hash_capacity: int = 2**20):
         self.mesh = mesh
         self.default_hash_capacity = default_hash_capacity
-        self._lock = threading.Lock()
+        # make_lock: plain Lock unless OE_REPORT_TRACE_LOCKS enables the
+        # graftrace runtime detector (analysis/concurrency.py)
+        self._lock = make_lock("serving.registry")
         self._models: Dict[str, ServingModel] = {}
         self._status: Dict[str, Dict[str, Any]] = {}
+        # outstanding async create_model load threads, by sign; joined
+        # by close() so shutdown quiesces instead of relying on daemon
+        # teardown killing a loader mid-commit
+        self._loaders: Dict[str, threading.Thread] = {}
 
     # --- lifecycle (ModelController.create/delete/show equivalents) -------
     def create_model(self, model_uri: str, *, model_sign: Optional[str] = None,
@@ -250,6 +257,7 @@ class ModelRegistry:
 
         def _load():
             try:
+                sync_point("registry.load.start")
                 specs = _specs_from_meta(meta, self.default_hash_capacity,
                                          num_shards, shard_slice)
                 coll = EmbeddingCollection(specs, self.mesh)
@@ -257,6 +265,7 @@ class ModelRegistry:
                                                   shard_slice=shard_slice)
                 model = ServingModel(sign, coll, states, meta,
                                      shard_slice=shard_slice)
+                sync_point("registry.load.commit")
                 with self._lock:
                     self._models[sign] = model
                     self._status[sign]["model_status"] = ModelStatus.NORMAL
@@ -265,15 +274,49 @@ class ModelRegistry:
                     self._status[sign]["model_status"] = ModelStatus.ERROR
                     self._status[sign]["model_error"] = (
                         f"{e}\n{traceback.format_exc()}")
+            finally:
+                # self-prune so a long-lived server's churn of async
+                # creates does not accumulate dead Thread objects until
+                # close. IDENTITY-guarded: after a failed load a retry
+                # may already have registered a NEW loader under this
+                # sign — popping that one would leave it untracked by
+                # close() (no-op for the block=True caller and when
+                # join_loads already swapped the dict out)
+                me = threading.current_thread()
+                with self._lock:
+                    if self._loaders.get(sign) is me:
+                        del self._loaders[sign]
 
         if block:
             _load()
-            err = self._status[sign]
+            with self._lock:
+                err = dict(self._status[sign])
             if err["model_status"] == ModelStatus.ERROR:
                 raise RuntimeError(err["model_error"])
         else:
-            threading.Thread(target=_load, daemon=True).start()
+            t = threading.Thread(target=_load, daemon=True,
+                                 name=f"oe-model-load-{sign}")
+            # publish + start under ONE lock hold: a concurrent close()
+            # between the two would join a never-started thread (raises)
+            with self._lock:
+                self._loaders[sign] = t
+                t.start()
         return sign
+
+    def join_loads(self, timeout: float = 60.0) -> None:
+        """Wait for every outstanding async ``create_model`` load thread
+        (per-thread ``timeout`` seconds; a stuck loader is abandoned, not
+        waited on forever — its status stays CREATING and the next
+        create_model for that sign still raises)."""
+        with self._lock:
+            loaders, self._loaders = dict(self._loaders), {}
+        for t in loaders.values():
+            t.join(timeout)
+
+    def close(self, timeout: float = 60.0) -> None:
+        """Quiesce the registry: join async loaders so shutdown never
+        relies on daemon teardown killing one mid-commit."""
+        self.join_loads(timeout)
 
     def register_model(self, model: ServingModel, *,
                        replica_num: int = 3) -> str:
@@ -302,6 +345,7 @@ class ModelRegistry:
     def find_model(self, sign: str) -> ServingModel:
         """NORMAL-status model or error — the find_model_variable gate
         (ModelController.cpp:24-44 rejects CREATING)."""
+        sync_point("registry.find")
         with self._lock:
             st = self._status.get(sign)
             if st is None:

@@ -269,16 +269,37 @@ class ControllerServer:
 
     def __init__(self, registry: ModelRegistry, port: int = DEFAULT_PORT,
                  host: str = "127.0.0.1", peers=None, compress: str = ""):
+        self.registry = registry
         self.httpd = ThreadingHTTPServer(
             (host, port), make_handler(registry, peers, compress=compress))
+        # non-daemon handler threads: server_close() then joins in-flight
+        # requests (block_on_close), so stop() cannot kill a handler
+        # mid-commit at interpreter exit. Safe from self-join: no handler
+        # ever calls stop() (there is no per-node shutdown endpoint).
+        self.httpd.daemon_threads = False
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name=f"oe-rest-{self.port}")
 
     def start(self):
         self._thread.start()
         return self
 
-    def stop(self):
-        self.httpd.shutdown()
+    def stop(self, timeout: float = 10.0):
+        """Graceful shutdown: stop accepting and quiesce the registry's
+        async loaders instead of leaving daemons to die with the
+        interpreter. ``httpd.shutdown()`` itself blocks (unbounded)
+        until the accept loop exits, and ``server_close()`` joins any
+        in-flight request handlers (non-daemon, see ``__init__``), so
+        ``timeout`` bounds the accept-thread join and the loader
+        quiesce — NOT a wedged accept loop or handler. When start()
+        never ran, shutdown() is skipped entirely: it waits on an event
+        only serve_forever() ever sets, so calling it would hang
+        forever."""
+        if self._thread.ident is not None:
+            self.httpd.shutdown()
         self.httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        self.registry.close(timeout)

@@ -30,6 +30,7 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .analysis.concurrency import sync_point
 from .analysis.retrace import RetraceGuard
 from .embedding import EmbeddingCollection
 from .parallel.mesh import DATA_AXIS
@@ -300,13 +301,14 @@ class Trainer:
             if prev is not None:
                 prev.join()
             try:
+                sync_point("trainer.prep.run")
                 for name, table in self.offload.items():
                     results[name] = table.host_prepare(
                         batch["sparse"][name])
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 err.append(e)
 
-        t = threading.Thread(target=_run, daemon=True)
+        t = threading.Thread(target=_run, daemon=True, name="oe-prep")
         t.start()
         self._preps.append((t, batch, results, err))
 

@@ -147,6 +147,13 @@ class ReportConfig:
 
     report_interval: float = 0.0   # seconds; 0 disables the reporter
     evaluate_performance: bool = False
+    # arm the graftrace runtime lock detector (analysis/concurrency.py):
+    # make_lock/make_rlock hand out TracedLock wrappers feeding the
+    # lock-order graph + contention counters. Off = plain threading
+    # locks, zero per-acquire cost. Env: OE_REPORT_TRACE_LOCKS=1 (read
+    # both here and directly by concurrency.trace_locks_enabled, so the
+    # env var works even without an EnvConfig.load)
+    trace_locks: bool = False
 
     def __post_init__(self):
         _validate(self)
@@ -232,6 +239,11 @@ class EnvConfig:
         from . import observability
         observability.set_evaluate_performance(
             self.report.evaluate_performance)
+        if self.report.trace_locks:
+            # force ON (never force-off: an explicit OE_REPORT_TRACE_LOCKS
+            # env var must keep working without an EnvConfig in play)
+            from ..analysis.concurrency import set_trace_locks
+            set_trace_locks(True)
         if self.report.report_interval > 0:
             return observability.Reporter(
                 self.report.report_interval).start()

@@ -127,6 +127,25 @@ def cache_stats(accumulator: Optional[Accumulator] = None
             "cache_hit_rate": hits / total if total else 0.0}
 
 
+def lock_stats() -> Dict[str, Dict[str, float]]:
+    """Per-lock runtime counters from the graftrace detector
+    (``analysis/concurrency.py`` TracedLock): ``acquires``, ``contended``
+    (acquire found the lock held), ``wait_s`` (time blocked acquiring),
+    ``hold_s`` (time held). Empty unless ``OE_REPORT_TRACE_LOCKS=1`` (or
+    ``EnvConfig.report.trace_locks``) armed the traced locks before the
+    instrumented objects were constructed."""
+    from ..analysis import concurrency
+    return concurrency.lock_stats()
+
+
+def potential_deadlocks() -> list:
+    """Lock-order cycles the traced locks observed (graftrace runtime
+    plane): *potential* deadlocks, reported even when the schedule never
+    realized them. Empty when tracing is off."""
+    from ..analysis import concurrency
+    return concurrency.potential_deadlocks()
+
+
 def _prom_name(name: str) -> str:
     out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
     return out.lstrip("0123456789_") or "metric"
@@ -155,6 +174,17 @@ def prometheus_text(accumulator: Optional[Accumulator] = None,
             lines.append(f"{base}_seconds_total {fields['seconds']:.10g}")
             lines.append(f"# TYPE {base}_calls_total counter")
             lines.append(f"{base}_calls_total {fields['calls']}")
+    # graftrace traced-lock counters (empty unless OE_REPORT_TRACE_LOCKS)
+    for name, st in sorted(lock_stats().items()):
+        base = f"{prefix}_lock_{_prom_name(name)}"
+        lines.append(f"# TYPE {base}_acquires_total counter")
+        lines.append(f"{base}_acquires_total {st['acquires']:.10g}")
+        lines.append(f"# TYPE {base}_contended_total counter")
+        lines.append(f"{base}_contended_total {st['contended']:.10g}")
+        lines.append(f"# TYPE {base}_wait_seconds_total counter")
+        lines.append(f"{base}_wait_seconds_total {st['wait_s']:.10g}")
+        lines.append(f"# TYPE {base}_hold_seconds_total counter")
+        lines.append(f"{base}_hold_seconds_total {st['hold_s']:.10g}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

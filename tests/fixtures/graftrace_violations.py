@@ -1,0 +1,146 @@
+"""Seeded graftrace violations: every rule must fire exactly where marked.
+
+``tests/test_graftrace.py`` analyzes this file's SOURCE and asserts each
+``# expect: JGxxx`` line is reported (and each ``# graftrace: disable``
+line is not). The shapes mirror the real host-plane mistakes the
+analyzer exists to catch: offload's writer/persister discipline, the
+serving registry's async loaders, the REST accept loop.
+
+``tests/test_interleaving.py`` also IMPORTS this module and drives
+:class:`LossyCounter` through the deterministic interleaving harness —
+the seeded JG101 race is not just reported, it is REPRODUCED (a lost
+update forced on every run via the ``fixture.race.gap`` sync point).
+"""
+
+import threading
+import time
+import urllib.request
+
+from openembedding_tpu.analysis.concurrency import sync_point
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+class LossyCounter:
+    """JG101: ``total`` is guarded in ``snapshot`` but the worker threads
+    read-modify-write it lock-free — the classic lost update."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def snapshot(self) -> int:
+        with self._lock:
+            return self.total
+
+    def _work(self, n: int) -> None:
+        for _ in range(n):
+            v = self.total                            # expect: JG101
+            sync_point("fixture.race.gap")
+            self.total = v + 1                        # expect: JG101
+
+    def spawn(self, workers: int, n: int) -> None:
+        ts = [threading.Thread(target=self._work, args=(n,),
+                               name=f"racer-{i}")
+              for i in range(workers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+
+class OrderInverter:
+    """JG102: transfer() takes a then b, reconcile() takes b then a —
+    run concurrently they deadlock; the static lock-order graph has the
+    cycle either way."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.items = []
+
+    def transfer(self):
+        with self._a:
+            with self._b:                             # expect: JG102
+                self.items.append(1)
+
+    def reconcile(self):
+        with self._b:
+            with self._a:                             # expect: JG102
+                self.items.append(2)
+
+
+class SlowPath:
+    """JG103: blocking calls while holding the lock — every other thread
+    needing ``_lock`` stalls behind the sleep/RPC."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = {}
+
+    def refresh(self, url):
+        with self._lock:
+            time.sleep(0.01)                          # expect: JG103
+            self.rows["latest"] = urllib.request.urlopen(url)  # expect: JG103
+
+
+def publish(url):
+    """JG103 with a MODULE-level lock."""
+    with LOCK_A:
+        urllib.request.urlopen(url)                   # expect: JG103
+
+
+class FireAndForget:
+    """JG104: daemon threads nothing joins — they die with the
+    interpreter mid-work and their exceptions are never observed
+    (the bug offload's writer/persister had before the flush/finish
+    join fix)."""
+
+    def __init__(self):
+        self.stopping = threading.Event()
+        self._pump = threading.Thread(                # expect: JG104
+            target=self._run, daemon=True)
+        self._pump.start()
+        threading.Thread(target=self._run, daemon=True).start()  # expect: JG104
+
+    def _run(self):
+        while not self.stopping.wait(0.01):
+            pass
+
+
+WATCHER = threading.Thread(target=print, daemon=True)  # expect: JG104
+
+
+# --- sanctioned patterns: must NOT be reported -------------------------------
+
+def quiet_publish(url):
+    with LOCK_B:
+        time.sleep(0.01)  # graftrace: disable=JG103
+        return url
+
+
+class Sanctioned:
+    """Suppressed JG104 (a true fire-and-forget by design) plus clean
+    discipline everywhere else: consistent guard, non-daemon worker
+    joined at close."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+        self._beat = threading.Thread(  # graftrace: disable=JG104
+            target=print, daemon=True)
+        self._worker = threading.Thread(target=self._drain,
+                                        name="sanctioned-drain")
+        self._worker.start()
+
+    def _drain(self):
+        with self._lock:
+            self.pending.clear()
+
+    def put(self, item):
+        with self._lock:
+            self.pending.append(item)
+
+    def close(self):
+        self._worker.join()

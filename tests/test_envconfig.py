@@ -55,3 +55,20 @@ def test_round_trip():
     j = cfg.to_json()
     assert j["offload"]["cache_capacity"] == 512
     assert EnvConfig.load(config=j, env={}) == cfg
+
+
+def test_trace_locks_wires_the_runtime_detector():
+    from openembedding_tpu.analysis import concurrency
+
+    cfg = EnvConfig.load(env={})
+    assert cfg.report.trace_locks is False
+    cfg = EnvConfig.load(env={"OE_REPORT_TRACE_LOCKS": "1"})
+    assert cfg.report.trace_locks is True
+    try:
+        cfg.apply_report()
+        assert concurrency.trace_locks_enabled()
+        assert isinstance(concurrency.make_lock("envcfg.probe"),
+                          concurrency.TracedLock)
+    finally:
+        concurrency.set_trace_locks(None)
+        concurrency.reset_runtime()

@@ -1,0 +1,319 @@
+"""Deterministic interleaving harness: the raciest host-plane schedules,
+replayed exactly, every run.
+
+Three layers:
+
+* harness semantics — SerialSchedule replays a prescribed cross-thread
+  order; PointGate parks named threads at named points (the
+  race-observation window).
+* the SEEDED race — tests/fixtures/graftrace_violations.py's
+  LossyCounter (the JG101 fixture class) is driven to a lost update on
+  EVERY run: both workers parked after their reads, then released.
+  The same schedule pressure against a guarded counter stays correct.
+* the REAL planes — offload's writer-vs-step and writer-error paths,
+  and the serving registry's async-load-vs-lookup window, pinned at
+  the sync points instrumented in this PR.
+"""
+
+import importlib.util
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from openembedding_tpu.analysis import concurrency
+from openembedding_tpu.analysis.concurrency import (
+    PointGate, SerialSchedule, clear_schedule, install_schedule, sync_point)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE = os.path.join(HERE, "fixtures", "graftrace_violations.py")
+
+
+def _load_fixture():
+    spec = importlib.util.spec_from_file_location("graftrace_fixture",
+                                                  FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_schedule():
+    yield
+    clear_schedule()
+
+
+# --- harness semantics -------------------------------------------------------
+
+def test_serial_schedule_replays_prescribed_order():
+    for want in (["b", "a"], ["a", "b"]):
+        order = []
+        for name in want:
+            order += [f"{name}/enter", f"{name}/exit"]
+        sched = SerialSchedule(order)
+        install_schedule(sched)
+        out = []
+
+        def work():
+            sync_point("enter")
+            out.append(threading.current_thread().name)
+            sync_point("exit")
+
+        ts = [threading.Thread(target=work, name=n) for n in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        clear_schedule()
+        assert sched.done()
+        assert out == want
+
+
+def test_serial_schedule_wedge_raises_not_hangs():
+    sched = SerialSchedule(["other/p", "me/p"], timeout=0.2)
+    with pytest.raises(TimeoutError, match="wedged"):
+        sched.sync("me/p", "p")
+
+
+def test_point_gate_parks_and_releases():
+    gate = PointGate(["stop.here"], timeout=10)
+    install_schedule(gate)
+    out = []
+
+    def work():
+        sync_point("free.point")      # unlisted: passes through
+        sync_point("stop.here")
+        out.append(1)
+
+    t = threading.Thread(target=work)
+    t.start()
+    assert gate.wait_arrival("stop.here")
+    assert out == []                  # provably parked at the point
+    gate.open("stop.here")
+    t.join(10)
+    assert out == [1]
+
+
+# --- the seeded race, reproduced ---------------------------------------------
+
+def test_seeded_race_reproduces_deterministically():
+    """The fixture's JG101 is not just reported — it is REALIZED, every
+    run: both racers parked between read and write, then released, so
+    one increment is always lost (total 1, never 2)."""
+    mod = _load_fixture()
+    for _ in range(3):
+        gate = PointGate(["racer-0/fixture.race.gap",
+                          "racer-1/fixture.race.gap"])
+        install_schedule(gate)
+        c = mod.LossyCounter()
+        t = threading.Thread(target=c.spawn, args=(2, 1))
+        t.start()
+        assert gate.wait_arrival("racer-0/fixture.race.gap")
+        assert gate.wait_arrival("racer-1/fixture.race.gap")
+        # both workers hold total==0 in hand; both writes now land
+        gate.open_all()
+        t.join(30)
+        clear_schedule()
+        assert c.total == 1
+        assert c.snapshot() == 1
+
+
+def test_guarded_counter_survives_the_same_schedule():
+    """The JG101 fix (read-modify-write under the lock) under identical
+    schedule pressure: parking one worker inside its critical section
+    just queues the other on the lock — nothing is lost."""
+    mod = _load_fixture()
+
+    class GuardedCounter(mod.LossyCounter):
+        def _work(self, n):
+            for _ in range(n):
+                with self._lock:
+                    v = self.total
+                    sync_point("fixture.race.gap")
+                    self.total = v + 1
+
+    gate = PointGate(["racer-0/fixture.race.gap"])
+    install_schedule(gate)
+    c = GuardedCounter()
+    t = threading.Thread(target=c.spawn, args=(2, 1))
+    t.start()
+    assert gate.wait_arrival("racer-0/fixture.race.gap")
+    gate.open("racer-0/fixture.race.gap")
+    t.join(30)
+    clear_schedule()
+    assert c.total == 2
+
+
+# --- offload: writer vs step thread ------------------------------------------
+
+def _make_offload(mesh, vocab=256, cache=64):
+    from openembedding_tpu import EmbeddingVariableMeta
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    meta = EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=vocab)
+    return ShardedOffloadedTable(
+        "off", meta, {"category": "sgd", "learning_rate": 0.1},
+        {"category": "constant", "value": 0.25},
+        vocab=vocab, cache_capacity=cache, mesh=mesh)
+
+
+def test_offload_update_during_writeback_stays_dirty(devices8):
+    """The _dirty discipline under the raciest schedule: flush clears the
+    marks eagerly, the writer parks BEFORE scattering, the step thread
+    re-dirties a row mid-writeback — the re-mark must survive (next
+    flush covers it), and the parked writeback must still land."""
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    table = _make_offload(mesh)
+    cache = table.create_cache()
+    ids = np.arange(8, dtype=np.int32)
+    cache = table.prepare(cache, ids)
+    table.note_update(ids)
+
+    gate = PointGate(["offload.writeback.scatter"])
+    install_schedule(gate)
+    assert table.flush(cache) == ids.size
+    assert gate.wait_arrival("offload.writeback.scatter")
+    # mid-writeback, the step thread dirties a row: the eager clear must
+    # not eat this mark
+    table.note_update(np.array([3], np.int32))
+    gate.open("offload.writeback.scatter")
+    table._join_writeback()
+    clear_schedule()
+    assert (table.host_work_id[ids] > 0).all()
+    with table._book:
+        assert bool(table._dirty[3]) and not bool(table._dirty[5])
+    assert table.flush(cache) == 1     # exactly the re-dirtied row
+
+
+def test_offload_writer_error_surfaces_at_next_flush(devices8):
+    """The satellite fix, pinned: a writeback that dies on its thread is
+    not silent — the stored exception raises at the NEXT flush (or
+    finish), and the failed rows are re-marked dirty so a later flush
+    retries them."""
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    table = _make_offload(mesh)
+    cache = table.create_cache()
+    ids = np.arange(6, dtype=np.int32)
+    cache = table.prepare(cache, ids)
+    table.note_update(ids)
+
+    gate = PointGate(["offload.writeback.run"])
+    install_schedule(gate)
+    assert table.flush(cache) == ids.size
+    writer = table._writer
+    assert gate.wait_arrival("offload.writeback.run")
+    real_get = jax.device_get
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device loss")
+
+    jax.device_get = boom
+    try:
+        gate.open("offload.writeback.run")
+        writer.join(30)
+    finally:
+        jax.device_get = real_get
+    clear_schedule()
+    with pytest.raises(RuntimeError, match="async writeback failed"):
+        table.flush(cache)
+    # the failed rows came back dirty: the retry covers all of them
+    assert table.flush(cache) == ids.size
+    table._join_writeback()
+    assert (table.host_work_id[ids] > 0).all()
+    table.finish()                     # and finish() is clean again
+
+
+def test_offload_finish_raises_stored_writer_error(devices8):
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    table = _make_offload(mesh)
+    cache = table.create_cache()
+    ids = np.arange(4, dtype=np.int32)
+    cache = table.prepare(cache, ids)
+    table.note_update(ids)
+
+    gate = PointGate(["offload.writeback.run"])
+    install_schedule(gate)
+    table.flush(cache)
+    writer = table._writer
+    assert gate.wait_arrival("offload.writeback.run")
+    real_get = jax.device_get
+    jax.device_get = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("injected device loss"))
+    try:
+        gate.open("offload.writeback.run")
+        writer.join(30)
+    finally:
+        jax.device_get = real_get
+    clear_schedule()
+    # finish (the fit() epilogue) surfaces it — before this PR the
+    # daemon writer died silently and finish() returned success
+    with pytest.raises(RuntimeError, match="async writeback failed"):
+        table.finish()
+
+
+# --- serving registry: async load vs lookup ----------------------------------
+
+def test_registry_load_vs_find_window(devices8, tmp_path):
+    """The CREATING window, held open deterministically: lookups and
+    duplicate creates are rejected while the loader is parked pre-commit;
+    after release + join_loads the model serves."""
+    import jax.numpy as jnp
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as ckpt
+    from openembedding_tpu.meta import ModelStatus
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.serving.registry import ModelRegistry
+
+    mesh = create_mesh(2, 4, devices8)
+    spec = EmbeddingSpec(name="arr", input_dim=16, output_dim=2)
+    coll = EmbeddingCollection(
+        (spec,), mesh,
+        default_optimizer={"category": "sgd", "learning_rate": 1.0})
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "m")
+    ckpt.save_checkpoint(path, coll, states, model_sign="sign-1")
+
+    reg = ModelRegistry(mesh, default_hash_capacity=64)
+    gate = PointGate(["registry.load.commit"])
+    install_schedule(gate)
+    sign = reg.create_model(path, block=False)
+    assert gate.wait_arrival("registry.load.commit")
+    # parked pre-commit: status CREATING, pulls + duplicate creates bounce
+    assert reg.show_model(sign)["model_status"] == ModelStatus.CREATING
+    with pytest.raises(RuntimeError, match="CREATING"):
+        reg.find_model(sign)
+    with pytest.raises(ValueError, match="already being created"):
+        reg.create_model(path, block=False)
+    gate.open("registry.load.commit")
+    reg.join_loads()
+    clear_schedule()
+    assert reg.show_model(sign)["model_status"] == ModelStatus.NORMAL
+    model = reg.find_model(sign)
+    rows = model.lookup("arr", np.arange(4, dtype=np.int32))
+    assert np.asarray(rows).shape == (4, 2)
+    reg.close()
+
+
+def test_controller_server_graceful_stop(devices8):
+    """The JG104 fix applied to serving: stop() joins the accept-loop
+    thread (and quiesces registry loaders) instead of leaving a daemon
+    to die with the interpreter."""
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.serving.registry import ModelRegistry
+    from openembedding_tpu.serving.rest import ControllerServer
+
+    mesh = create_mesh(2, 4, devices8)
+    srv = ControllerServer(ModelRegistry(mesh), port=0).start()
+    assert srv._thread.is_alive()
+    srv.stop()
+    assert not srv._thread.is_alive()
+    # never-started server: stop() must return, not wedge on the
+    # serve_forever event that was never set (cleanup-after-failure path)
+    srv2 = ControllerServer(ModelRegistry(mesh), port=0)
+    srv2.stop()
+    assert not srv2._thread.is_alive()

@@ -1,0 +1,196 @@
+"""graftrace runtime plane: TracedLock lock-order-cycle detection,
+contention/hold counters, opt-in gating, and the observability surface.
+
+All synthetic — no jax, no mesh. The deterministic interleaving lane
+(test_interleaving.py) drives the REAL instrumented objects.
+"""
+
+import threading
+
+import pytest
+
+from openembedding_tpu.analysis import concurrency
+from openembedding_tpu.utils import observability
+
+
+@pytest.fixture()
+def traced():
+    concurrency.set_trace_locks(True)
+    concurrency.reset_runtime()
+    yield
+    concurrency.set_trace_locks(None)
+    concurrency.reset_runtime()
+
+
+def test_make_lock_is_plain_when_disabled():
+    concurrency.set_trace_locks(False)
+    try:
+        lk = concurrency.make_lock("x")
+        assert not isinstance(lk, concurrency.TracedLock)
+        rlk = concurrency.make_rlock("y")
+        assert not isinstance(rlk, concurrency.TracedLock)
+        # nothing recorded: production paths pay nothing
+        assert concurrency.lock_stats() == {}
+    finally:
+        concurrency.set_trace_locks(None)
+
+
+def test_env_var_arms_tracing(monkeypatch):
+    concurrency.set_trace_locks(None)
+    monkeypatch.setenv("OE_REPORT_TRACE_LOCKS", "1")
+    assert concurrency.trace_locks_enabled()
+    assert isinstance(concurrency.make_lock("z"), concurrency.TracedLock)
+    monkeypatch.setenv("OE_REPORT_TRACE_LOCKS", "0")
+    assert not concurrency.trace_locks_enabled()
+
+
+def test_lock_order_cycle_is_reported(traced):
+    a = concurrency.TracedLock("A")
+    b = concurrency.TracedLock("B")
+    # the A->B order, then the inverse — no two threads needed: a
+    # POTENTIAL deadlock is an order inversion, reported even though
+    # this schedule never wedged
+    with a:
+        with b:
+            pass
+    assert concurrency.potential_deadlocks() == []
+    with b:
+        with a:
+            pass
+    reports = concurrency.potential_deadlocks()
+    assert len(reports) == 1 and "A" in reports[0] and "B" in reports[0]
+    # the same inversion again does not spam a second report
+    with b:
+        with a:
+            pass
+    assert len(concurrency.potential_deadlocks()) == 1
+
+
+def test_consistent_order_is_silent(traced):
+    a = concurrency.TracedLock("A")
+    b = concurrency.TracedLock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert concurrency.potential_deadlocks() == []
+
+
+def test_contention_and_hold_counters(traced):
+    lk = concurrency.TracedLock("hot")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(10)
+
+    t = threading.Thread(target=holder, name="holder")
+    t.start()
+    assert held.wait(10)
+    # guaranteed contended: the holder provably has the lock right now
+    releaser = threading.Timer(0.05, release.set)
+    releaser.start()
+    with lk:
+        pass
+    t.join(10)
+    releaser.join()
+    st = concurrency.lock_stats()["hot"]
+    assert st["acquires"] == 2
+    assert st["contended"] == 1
+    assert st["wait_s"] > 0
+    assert st["hold_s"] > 0
+
+
+def test_rlock_reentrancy_counts_outermost_only(traced):
+    lk = concurrency.TracedRLock("re")
+    with lk:
+        with lk:
+            assert lk._depth_get() == 2
+    st = concurrency.lock_stats()["re"]
+    assert st["acquires"] == 1
+    # fully released: another thread can take (and release) it
+    ok = []
+
+    def grab():
+        ok.append(lk.acquire(timeout=1))
+        if ok[0]:
+            lk.release()
+
+    t = threading.Thread(target=grab)
+    t.start()
+    t.join(10)
+    assert ok == [True]
+    assert concurrency.lock_stats()["re"]["acquires"] == 2
+
+
+def test_rlock_locked_is_portable(traced):
+    # threading.RLock has no .locked() before Python 3.14 — the traced
+    # wrapper must still answer (offload._book advertises it)
+    lk = concurrency.TracedRLock("probe")
+    assert lk.locked() is False
+    with lk:
+        assert lk.locked() is True
+        with lk:
+            assert lk.locked() is True
+    assert lk.locked() is False
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(10)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(10)
+    assert lk.locked() is True          # held by ANOTHER thread
+    release.set()
+    t.join(10)
+    assert lk.locked() is False
+
+
+def test_observability_surface(traced):
+    with concurrency.TracedLock("obs.demo"):
+        pass
+    stats = observability.lock_stats()
+    assert stats["obs.demo"]["acquires"] == 1
+    text = observability.prometheus_text()
+    assert "oe_lock_obs_demo_acquires_total 1" in text
+    assert "oe_lock_obs_demo_contended_total 0" in text
+    assert concurrency.potential_deadlocks() == \
+        observability.potential_deadlocks()
+
+
+def test_cross_thread_release_closes_acquirer_entry(traced):
+    # threading.Lock may legally be released by a thread other than the
+    # acquirer (handoff/signaling patterns). The acquirer's held-stack
+    # entry must be closed anyway — left stale it would fabricate an
+    # order edge for every lock that thread acquires next
+    h = concurrency.TracedLock("H")
+    a = concurrency.TracedLock("A")
+    h.acquire()
+    t = threading.Thread(target=h.release)
+    t.start()
+    t.join(10)
+    assert concurrency.lock_stats()["H"]["hold_s"] > 0
+    with a:                           # would record a phantom H->A edge
+        pass                          # if the stale entry survived
+    assert "H" not in concurrency._ORDER
+    assert concurrency.potential_deadlocks() == []
+
+
+def test_reset_runtime_clears_everything(traced):
+    a, b = concurrency.TracedLock("A"), concurrency.TracedLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert concurrency.potential_deadlocks()
+    concurrency.reset_runtime()
+    assert concurrency.potential_deadlocks() == []
+    assert concurrency.lock_stats() == {}

@@ -1,0 +1,68 @@
+"""graftrace CLI: lock-discipline gate over the threaded host planes.
+
+    python -m tools.graftrace openembedding_tpu/ [more paths...]
+
+Exit 0 when clean, 1 with one ``path:line: RULE message`` per violation
+otherwise — CI runs this next to graftlint/graftcheck, and
+``tests/test_graftrace.py`` enforces a clean package from inside the
+suite as well. Rules (JG101-JG104), the per-class lockset semantics, and
+the inline ``# graftrace: disable=`` suppression syntax are documented
+in ``openembedding_tpu/analysis/concurrency.py`` (which also holds the
+runtime TracedLock detector and the interleaving harness this static
+pass complements).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, _ROOT)
+
+
+def _load_concurrency():
+    """Load analysis/concurrency.py standalone (stdlib-only by design):
+    going through `import openembedding_tpu` would pull jax in for a
+    pure AST walk and turn a sub-second CI gate into a multi-second one."""
+    path = os.path.join(_ROOT, "openembedding_tpu", "analysis",
+                        "concurrency.py")
+    spec = importlib.util.spec_from_file_location("_graftrace_impl", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod   # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+concurrency = _load_concurrency()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="lock-discipline linter (rules JG101-JG104)")
+    ap.add_argument("paths", nargs="+",
+                    help=".py files or directories to analyze")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated subset of rules to enforce "
+                         "(default: all)")
+    args = ap.parse_args(argv)
+    only = {r.strip() for r in args.rules.split(",") if r.strip()}
+    violations = concurrency.trace_paths(args.paths)
+    if only:
+        # JG100 (unparseable file) is never filterable: a gate that
+        # "passes" a file it analyzed zero lines of is no gate
+        violations = [v for v in violations
+                      if v.rule in only or v.rule == "JG100"]
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"graftrace: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
