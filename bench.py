@@ -178,7 +178,13 @@ def run_config(name, config, *, steps, warmup, repeats=5):
                                               batches[i % len(batches)])
             jax.block_until_ready(m["loss"])
 
-    # stage isolation: sparse pull / sparse update on the trained state
+    # stage isolation: sparse pull / sparse update on the trained state.
+    # Each stage is ONE jitted program (like inside the fused step), not
+    # an eager per-variable dispatch loop: a per-feature config launches
+    # 52 independent collective programs per eager call, and async
+    # interleaving of that many programs starves the CPU backend's
+    # device-thread pool into a rendezvous deadlock (observed wedging
+    # this box at `coll.pull`; single-program dispatch cannot deadlock)
     stage = {}
     try:
         sb = trainer.shard_batch(batches[0])
@@ -187,20 +193,23 @@ def run_config(name, config, *, steps, warmup, repeats=5):
         if isinstance(inputs, dict):
             inputs = {k: v for k, v in inputs.items() if k in coll.specs}
         if inputs:
-            rows = coll.pull(state.emb, inputs)
+            pull_fn = jax.jit(lambda st, inp: coll.pull(st, inp))
+            rows = pull_fn(state.emb, inputs)
             jax.block_until_ready(jax.tree.leaves(rows))
             t0 = time.perf_counter()
             for _ in range(steps):
-                rows = coll.pull(state.emb, inputs)
+                rows = pull_fn(state.emb, inputs)
             jax.block_until_ready(jax.tree.leaves(rows))
             stage["pull_ms"] = round(1000 * (time.perf_counter() - t0)
                                      / steps, 3)
             grads = {k: v for k, v in rows.items()}
-            emb = coll.apply_gradients(state.emb, inputs, grads)
+            upd_fn = jax.jit(
+                lambda st, inp, g: coll.apply_gradients(st, inp, g))
+            emb = upd_fn(state.emb, inputs, grads)
             jax.block_until_ready(jax.tree.leaves(emb))
             t0 = time.perf_counter()
             for _ in range(steps):
-                emb = coll.apply_gradients(state.emb, inputs, grads)
+                emb = upd_fn(state.emb, inputs, grads)
             jax.block_until_ready(jax.tree.leaves(emb))
             stage["update_ms"] = round(1000 * (time.perf_counter() - t0)
                                        / steps, 3)
@@ -856,11 +865,12 @@ def run_plane_parity(name, config, *, steps, warmup):
     cache = config.get("cache", 1 << 13)
     results = {}
     for plane_name in config.get("planes",
-                                 ("a2a", "psum", "hybrid", "offload")):
+                                 ("a2a", "a2a+grouped", "psum", "hybrid",
+                                  "offload")):
         mesh = create_mesh(1, n_dev)
         offload = None
         sparse_as_dense = None
-        if plane_name in ("a2a", "psum"):
+        if plane_name in ("a2a", "a2a+grouped", "psum"):
             coll = EmbeddingCollection(bounded_specs(plane_name), mesh)
         elif plane_name == "hybrid":
             sharded, dense_kept = split_sparse_dense(
@@ -1115,6 +1125,15 @@ CONFIGS = {
     "deepfm_dim9_per_feature": {"model": "deepfm", "dim": 9,
                                 "vocab": 1 << 18, "batch": 4096,
                                 "fused": False},
+    # grouped-exchange A/B against the entry above: IDENTICAL 52-variable
+    # per-feature layout (26 dim-9 + 26 dim-1 linear), but the collection
+    # batches each dim bucket into ONE routed exchange per step
+    # (parallel/grouped.py) instead of one pipeline per table — the
+    # heterogeneous-table counterpart of the fused single-table rescue
+    "deepfm_dim9_per_feature_grouped": {"model": "deepfm", "dim": 9,
+                                        "vocab": 1 << 18, "batch": 4096,
+                                        "fused": False,
+                                        "plane": "a2a+grouped"},
     "wdl_dim64": {"model": "wdl", "dim": 64, "vocab": 1 << 18,
                   "batch": 4096, "zipf": True},
     "xdeepfm_dim16": {"model": "xdeepfm", "dim": 16, "vocab": 1 << 20,

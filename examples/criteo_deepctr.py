@@ -47,10 +47,14 @@ def parse_args(argv=None):
                    "parallel params (the reference's --cache hybrid, "
                    "exb.py:617-632); needs --no-fused")
     p.add_argument("--plane", default="a2a",
-                   choices=["a2a", "psum", "a2a+cache"],
+                   choices=["a2a", "psum", "a2a+cache", "a2a+grouped"],
                    help="sparse data plane: owner-routed all-to-all "
-                   "(default), the psum/all_gather baseline, or a2a plus "
-                   "the hot-row replica cache (parallel/hot_cache.py)")
+                   "(default), the psum/all_gather baseline, a2a plus "
+                   "the hot-row replica cache (parallel/hot_cache.py), "
+                   "or the collection-batched grouped exchange — one "
+                   "routed round per same-shape table group per step "
+                   "(parallel/grouped.py; pair with --no-fused, where "
+                   "per-table pipelines are the cost being batched)")
     p.add_argument("--cache_k", type=int, default=0,
                    help="a2a+cache replica rows per variable (0 = default)")
     p.add_argument("--hist_len", type=int, default=0, metavar="L",

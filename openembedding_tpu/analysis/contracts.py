@@ -38,7 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Callable, Dict, List, Mapping, Optional, Tuple
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
                 "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
@@ -270,13 +270,43 @@ def _global_batch_rows(p: Mapping[str, int]) -> int:
                * ROW_ASSEMBLY_SLACK)
 
 
+def _grouped_a2a_ops(p: Mapping[str, int]) -> int:
+    # THE grouped-plane claim: the collective launch count is
+    # O(#groups), not O(#tables). ``a2a_ops_per_exchange`` is counted
+    # empirically from a single-table a2a program on the same mesh
+    # (programs.count_exchange_a2a) — a per-table loop would compile
+    # num_tables * that many all-to-alls and fail this cap.
+    return int(p["num_groups"] * p["a2a_ops_per_exchange"])
+
+
+def _grouped_row_assembly(p: Mapping[str, int]) -> int:
+    # grouped pull re-assembly: the concatenated stream carries every
+    # member table's entries at the group's padded bucket dim
+    return int(p["num_tables"] * p["batch_slice"] * p["dim_bucket"]
+               * p["itemsize"] * ROW_ASSEMBLY_SLACK)
+
+
+def _grouped_prereduce(p: Mapping[str, int]) -> int:
+    # grouped push overflow fallback: every peer's pre-reduced
+    # concatenated slice — entries gain up to 3 key words (lo, hi, tag)
+    # next to the padded-dim grad row
+    return int(p["num_tables"] * p["global_batch"] * (p["dim_bucket"] + 4)
+               * p["itemsize"] * ROW_ASSEMBLY_SLACK)
+
+
 @dataclasses.dataclass(frozen=True)
 class OpBudget:
     """Inventory entry for one collective op within one program."""
 
     min_count: int = 0
-    max_count: Optional[int] = None
+    # static cap, or a Bound of the program params (the grouped plane's
+    # cap is num_groups * per-exchange ops — param-dependent)
+    max_count: Optional[Any] = None
     max_buffer: Optional[Bound] = None   # bound on the largest single buffer
+    # bound on the SUMMED bytes across all ops of this type: catches a
+    # regression that splits O(global) traffic into many small buffers
+    # (e.g. one per-table gather each below the single-buffer bound)
+    max_total: Optional[Bound] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -299,10 +329,18 @@ class ProgramContract:
         collected = collect_collectives(hlo_text)
         summary: Dict[str, Tuple[int, int]] = {}
         largest: Dict[str, int] = {}
+        # per-op sum of each instance's LARGEST buffer: async -start
+        # tuples carry operand AND result, so summing all buffers
+        # (summary's total) would double-count on async backends; the
+        # largest single buffer equals the result for both sync and
+        # async forms, and its sum still exposes O(table) traffic split
+        # across many individually-small buffers
+        big_sum: Dict[str, int] = {}
         for op, b, big in collected:
             c, t = summary.get(op, (0, 0))
             summary[op] = (c + 1, t + b)
             largest[op] = max(largest.get(op, 0), big)
+            big_sum[op] = big_sum.get(op, 0) + big
         label = f"{self.plane}/{self.program}"
         for op in self.forbid:
             if op in summary:
@@ -316,10 +354,13 @@ class ProgramContract:
                     f"{label}: expected >= {budget.min_count} {op!r} "
                     f"op(s), found {count} (inventory: {summary}) — the "
                     "plane's exchange structure is gone")
-            if budget.max_count is not None and count > budget.max_count:
-                raise ContractViolation(
-                    f"{label}: {count} {op!r} op(s) > allowed "
-                    f"{budget.max_count} (inventory: {summary})")
+            if budget.max_count is not None:
+                cap = budget.max_count(params) if callable(budget.max_count) \
+                    else budget.max_count
+                if count > cap:
+                    raise ContractViolation(
+                        f"{label}: {count} {op!r} op(s) > allowed {cap} "
+                        f"(inventory: {summary}, params {dict(params)})")
             if budget.max_buffer is not None and op in largest:
                 bound = budget.max_buffer(params)
                 if largest[op] > bound:
@@ -328,6 +369,15 @@ class ProgramContract:
                         f"> bound {bound} (params "
                         f"{dict(params)}) — O(global_batch)/O(table) "
                         "traffic has reappeared")
+            if budget.max_total is not None and op in big_sum:
+                bound = budget.max_total(params)
+                total = big_sum[op]
+                if total > bound:
+                    raise ContractViolation(
+                        f"{label}: {op!r} ops total {total} bytes "
+                        f"> bound {bound} (params {dict(params)}) — "
+                        "O(global_batch)/O(table) traffic has reappeared "
+                        "split across buffers")
         if self.no_f64:
             check_no_f64(hlo_text)
         if self.no_host_transfers:
@@ -371,6 +421,26 @@ _register(ProgramContract(
     ops={"all-to-all": OpBudget(min_count=1),
          "all-gather": OpBudget(max_buffer=_global_prereduce),
          "all-reduce": OpBudget(max_buffer=_cache_psum)}))
+# The grouped plane: its EXTRA promise over plain a2a is the collective
+# LAUNCH COUNT — one exchange set per GROUP of same-shape tables, never
+# one per table (params carry num_groups and the empirically-counted
+# per-exchange op count; a per-table-loop regression multiplies the
+# all-to-all inventory by num_tables and fails the cap).
+_register(ProgramContract(
+    plane="a2a+grouped", program="pull",
+    ops={"all-to-all": OpBudget(min_count=1, max_count=_grouped_a2a_ops),
+         # max_total (not just max_buffer): a broken output annotation
+         # re-gathers each table's rows in a SEPARATE buffer, each below
+         # the concatenated-stream bound — the sum is what gives it away
+         "all-gather": OpBudget(max_buffer=_grouped_row_assembly,
+                                max_total=_grouped_row_assembly),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
+_register(ProgramContract(
+    plane="a2a+grouped", program="push",
+    ops={"all-to-all": OpBudget(min_count=1, max_count=_grouped_a2a_ops),
+         "all-gather": OpBudget(max_buffer=_grouped_prereduce,
+                                max_total=_grouped_prereduce),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
 _register(ProgramContract(
     plane="psum", program="pull",
     forbid=("all-to-all",),

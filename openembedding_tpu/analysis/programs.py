@@ -94,6 +94,117 @@ def lower_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
     return compiled.as_text(), contract_params(mesh, batch=batch, dim=dim)
 
 
+def _grouped_collection(mesh, *, tables: int, vocab: int, dim: int,
+                        use_hash: bool):
+    from ..embedding import EmbeddingCollection, EmbeddingSpec
+    if use_hash:
+        specs = tuple(
+            EmbeddingSpec(name=f"t{i}", input_dim=-1, output_dim=dim,
+                          hash_capacity=vocab, plane="a2a+grouped")
+            for i in range(tables))
+    else:
+        # distinct vocabs: heterogeneous tables that the per-table loop
+        # could never fuse, but the planner batches (same dim bucket)
+        specs = tuple(
+            EmbeddingSpec(name=f"t{i}", input_dim=vocab + 64 * i,
+                          output_dim=dim, plane="a2a+grouped")
+            for i in range(tables))
+    return EmbeddingCollection(specs, mesh)
+
+
+def count_exchange_a2a(mesh, program: str, *, vocab: int = 1 << 16,
+                       dim: int = 16, batch: int = 1024,
+                       use_hash: bool = False) -> int:
+    """All-to-all ops ONE single-table a2a exchange compiles to on this
+    mesh — the empirical per-exchange unit the grouped plane's launch-count
+    contract multiplies by ``num_groups``."""
+    from . import contracts
+    lower = lower_pull if program == "pull" else lower_push
+    txt, _ = lower(mesh, "a2a", vocab=vocab, dim=dim, batch=batch,
+                   use_hash=use_hash)
+    return contracts.summarize(txt).get("all-to-all", (0, 0))[0]
+
+
+def grouped_params(mesh, coll, names, *, batch: int, dim: int,
+                   program: str, a2a_ops: Optional[int] = None,
+                   itemsize: int = 4) -> Dict[str, int]:
+    """Contract params for a grouped-plane program: the base params plus
+    num_tables / num_groups (from the planner itself) / the padded bucket
+    dim / the per-exchange all-to-all count."""
+    from ..parallel import grouped
+    plans = grouped.plan_groups(coll, tuple(names), read_only=True)
+    if a2a_ops is None:
+        a2a_ops = count_exchange_a2a(mesh, program, batch=batch, dim=dim)
+    params = contract_params(mesh, batch=batch, dim=dim, itemsize=itemsize)
+    params.update({
+        "num_tables": len(names), "num_groups": len(plans),
+        "dim_bucket": max(p.bucket_dim for p in plans),
+        "a2a_ops_per_exchange": a2a_ops})
+    return params
+
+
+def lower_grouped_pull(mesh, *, tables: int = 3, vocab: int = 1 << 14,
+                       dim: int = 16, batch: int = 1024,
+                       use_hash: bool = False,
+                       a2a_ops: Optional[int] = None,
+                       out_replicated: bool = False
+                       ) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO of the COLLECTION-level grouped pull over ``tables``
+    same-dim tables (one exchange group). ``out_replicated=True`` breaks
+    the output annotation like :func:`lower_pull` — the negative test."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import DATA_AXIS
+    coll = _grouped_collection(mesh, tables=tables, vocab=vocab, dim=dim,
+                               use_hash=use_hash)
+    states = coll.init(jax.random.PRNGKey(0))
+    names = tuple(coll.specs)
+
+    def pull_fn(states, idxs):
+        return coll.pull(states, idxs)
+
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    idxs = {n: jax.device_put(jnp.zeros((batch,), jnp.int32), sh)
+            for n in names}
+    out_spec = P() if out_replicated else P(DATA_AXIS)
+    compiled = jax.jit(
+        pull_fn, out_shardings=NamedSharding(mesh, out_spec)
+    ).lower(states, idxs).compile()
+    return compiled.as_text(), grouped_params(
+        mesh, coll, names, batch=batch, dim=dim, program="pull",
+        a2a_ops=a2a_ops)
+
+
+def lower_grouped_push(mesh, *, tables: int = 3, vocab: int = 1 << 14,
+                       dim: int = 16, batch: int = 1024,
+                       use_hash: bool = False,
+                       a2a_ops: Optional[int] = None
+                       ) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO of the collection-level grouped push."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import DATA_AXIS
+    coll = _grouped_collection(mesh, tables=tables, vocab=vocab, dim=dim,
+                               use_hash=use_hash)
+    states = coll.init(jax.random.PRNGKey(0))
+    names = tuple(coll.specs)
+
+    def push_fn(states, idxs, grads):
+        return coll.apply_gradients(states, idxs, grads)
+
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+    idxs = {n: jax.device_put(jnp.zeros((batch,), jnp.int32), sh)
+            for n in names}
+    grads = {n: jax.device_put(jnp.zeros((batch, dim), jnp.float32), sh)
+             for n in names}
+    compiled = jax.jit(push_fn).lower(states, idxs, grads).compile()
+    return compiled.as_text(), grouped_params(
+        mesh, coll, names, batch=batch, dim=dim, program="push",
+        a2a_ops=a2a_ops)
+
+
 def lower_train_step(mesh, plane: str = "a2a", *, vocab: int = 4096,
                      dim: int = 8, batch: int = 256,
                      model: str = "deepfm"

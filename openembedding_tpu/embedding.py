@@ -77,6 +77,10 @@ class EmbeddingSpec:
     plane: str = "a2a"               # "a2a" owner-routed | "psum" baseline
                                      # | "a2a+cache" (a2a + hot-row replica,
                                      # parallel/hot_cache.py)
+                                     # | "a2a+grouped" (collection batches
+                                     # same-shape tables into ONE exchange
+                                     # per group per step,
+                                     # parallel/grouped.py)
     a2a_capacity: int = 0            # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0           # auto bucket = slack * mean
     cache_k: int = 0                 # hot-row replica slots; 0 = default
@@ -170,6 +174,12 @@ class EmbeddingCollection:
         """Variables on the ``"a2a+cache"`` plane (hot-row replica)."""
         return tuple(name for name, s in self._shardings.items()
                      if s.is_cached)
+
+    def grouped_names(self) -> tuple:
+        """Variables on the ``"a2a+grouped"`` plane (collection-batched
+        exchange, ``parallel/grouped.py``)."""
+        return tuple(name for name, s in self._shardings.items()
+                     if s.is_grouped)
 
     def make_hot_cache_manager(self, name: str):
         """Admission/refresh driver for one cached variable (the Trainer
@@ -291,12 +301,27 @@ class EmbeddingCollection:
         always a flat pair list, never a ``[B, L=2]`` sequence (a pooled
         spec's training-side heuristic would misread it).
         """
-        rows = {}
-        for name, idx in inputs.items():
-            spec = self.specs[name]
-            idx = self._widen(spec, idx,
+        widened = {
+            name: self._widen(self.specs[name], idx,
                               pair_ndim=2 if serving_rows else None)
-            if spec.use_hash:
+            for name, idx in inputs.items()}
+        # grouped-plane columns batch into ONE exchange per group
+        # (parallel/grouped.py) instead of one pipeline per table; the
+        # raw rows come back per name and pool below like any other
+        grouped_idx = {name: idx for name, idx in widened.items()
+                       if self._shardings[name].is_grouped}
+        raw = {}
+        if grouped_idx:
+            from .parallel import grouped
+            raw = grouped.pull_grouped(self, states, grouped_idx,
+                                       read_only=read_only,
+                                       batch_sharded=batch_sharded)
+        rows = {}
+        for name, idx in widened.items():
+            spec = self.specs[name]
+            if name in raw:
+                r = raw[name]
+            elif spec.use_hash:
                 r = sh.pull_sharded(
                     states[name], idx,
                     None if read_only else self._initializers[name],
@@ -376,6 +401,8 @@ class EmbeddingCollection:
         variables keep their state object unchanged.
         """
         new_states = dict(states)
+        grouped_idx: Dict[str, jnp.ndarray] = {}
+        grouped_grads: Dict[str, jnp.ndarray] = {}
         for name, g in row_grads.items():
             spec = self.specs[name]
             idx_in = self._widen(spec, inputs[name])
@@ -386,6 +413,13 @@ class EmbeddingCollection:
                     g, idx_in, spec.pooling, ragged.pad_id_for(spec),
                     self._pool_vocab(spec),
                     wide=spec.key_dtype == "wide")
+            if self._shardings[name].is_grouped:
+                # collection-batched push: one pre-reduced exchange per
+                # GROUP (parallel/grouped.py), per-table optimizers
+                # applied server-side
+                grouped_idx[name] = idx_in
+                grouped_grads[name] = g
+                continue
             if spec.use_hash:
                 new_states[name] = sh.apply_gradients_sharded(
                     states[name], self._optimizers[name],
@@ -397,4 +431,9 @@ class EmbeddingCollection:
                     states[name], self._optimizers[name], idx_in, g,
                     mesh=self.mesh, spec=self._shardings[name],
                     batch_sharded=batch_sharded)
+        if grouped_idx:
+            from .parallel import grouped
+            new_states.update(grouped.apply_gradients_grouped(
+                self, states, grouped_idx, grouped_grads,
+                batch_sharded=batch_sharded))
         return new_states

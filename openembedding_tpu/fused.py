@@ -23,6 +23,14 @@ tiles well onto the MXU pipeline.
 
 ``make_fused_specs`` + ``FusedMapper`` are the public surface; the model zoo
 accepts the fused layout directly (rows["fields"] of shape [B, F, dim]).
+
+Fusion requires HOMOGENEOUS features (one dim, one optimizer, one table
+config). The heterogeneous counterpart is the grouped exchange plane
+(``parallel/grouped.py``, ``plane="a2a+grouped"``): tables stay separate
+(per-table dims/optimizers/serving) but the collection batches each
+same-shape GROUP into one routed exchange per step, reusing exactly this
+disjoint-offset trick (``alltoall.segment_offsets``) for array groups.
+Prefer fused when you can, grouped when dims/configs differ.
 """
 
 from __future__ import annotations
@@ -34,6 +42,7 @@ import numpy as np
 
 from .analysis.lint import host_fn
 from .embedding import EmbeddingSpec
+from .parallel.alltoall import segment_offsets
 
 FUSED_NAME = "fields"
 LINEAR_SUFFIX = ":linear"
@@ -62,8 +71,10 @@ class FusedMapper:
 
     @property
     def offsets(self) -> np.ndarray:
-        return np.concatenate([[0], np.cumsum(self.vocab_sizes)[:-1]]).astype(
-            np.int64)
+        # the same static exclusive prefix sums the grouped exchange
+        # plane uses for its array-group bases (parallel/grouped.py)
+        return np.asarray(segment_offsets(self.vocab_sizes)[:-1],
+                          dtype=np.int64)
 
     @property
     def total_vocab(self) -> int:

@@ -78,38 +78,54 @@ def overflow_count(inverse: jnp.ndarray, capacity: int) -> jnp.ndarray:
     return jnp.sum(inverse >= capacity)
 
 
+def unique_rows(rows: jnp.ndarray, capacity: int | None = None,
+                fill_value: int = FILL
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Deduplicate composite keys: [n, K] integer rows, K >= 2.
+
+    Generalizes :func:`unique_pairs` to any column count — wide (lo, hi)
+    keys are K=2, the grouped exchange plane's table-tagged streams carry
+    (key..., table_id) rows at K=2 or 3 (``parallel/grouped.py``). Rows
+    are ranked lexicographically by K stable argsorts (minor column
+    first, major column last — a stable sort by the major key preserves
+    the minor order within equal majors), duplicates detected by
+    adjacent-row equality, and compacted into a fixed-capacity buffer.
+    Returns ``(uniq [capacity, K], inverse [n], valid [capacity])`` with
+    padding rows equal to ``fill_value`` in every column. Matching
+    :func:`unique_indices`'s contract, the sentinel group (padding rows,
+    LAST column == fill) is NOT a valid unique.
+    """
+    n, k = rows.shape
+    if capacity is None:
+        capacity = n
+    order = jnp.arange(n, dtype=jnp.int32)
+    for c in range(k):
+        order = order[jnp.argsort(rows[order, c], stable=True)]
+    srt = rows[order]
+    new_group = jnp.concatenate([
+        jnp.ones((1,), bool),
+        jnp.any(srt[1:] != srt[:-1], axis=1)])
+    # group ordinal per sorted row -> unique slot; first of group writes it
+    slot_sorted = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    inverse = jnp.zeros((n,), jnp.int32).at[order].set(slot_sorted)
+    fill = jnp.asarray(fill_value, rows.dtype)
+    uniq = jnp.full((capacity, k), fill, dtype=rows.dtype)
+    dst = jnp.where(new_group, slot_sorted, capacity)
+    uniq = uniq.at[dst].set(srt, mode="drop")
+    valid = (jnp.arange(capacity) <= (slot_sorted[-1] if n else -1)) \
+        & (uniq[:, -1] != fill)
+    return uniq, inverse, valid
+
+
 def unique_pairs(pairs: jnp.ndarray, capacity: int | None = None,
                  fill_value: int = FILL
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Deduplicate WIDE keys: [n, 2] int32 (lo, hi) rows, x64-off.
 
     The 64-bit twin of :func:`unique_indices` for processes without
-    ``jax_enable_x64`` (a jnp int64 pack is unavailable there): rows are
-    ranked lexicographically by two stable argsorts (lo then hi — stable
-    sort by the major word last), duplicates detected by adjacent-row
-    equality, and compacted into a fixed-capacity buffer. Returns
+    ``jax_enable_x64`` (a jnp int64 pack is unavailable there); the
+    K-column generalization lives in :func:`unique_rows`. Returns
     ``(uniq [capacity, 2], inverse [n], valid [capacity])`` with padding
     rows equal to ``(fill_value, fill_value)``.
     """
-    n = pairs.shape[0]
-    if capacity is None:
-        capacity = n
-    lo, hi = pairs[:, 0], pairs[:, 1]
-    order = jnp.argsort(lo, stable=True)
-    order = order[jnp.argsort(hi[order], stable=True)]
-    slo, shi = lo[order], hi[order]
-    new_group = jnp.concatenate([
-        jnp.ones((1,), bool),
-        (slo[1:] != slo[:-1]) | (shi[1:] != shi[:-1])])
-    # group ordinal per sorted row -> unique slot; first of group writes it
-    slot_sorted = jnp.cumsum(new_group.astype(jnp.int32)) - 1
-    inverse = jnp.zeros((n,), jnp.int32).at[order].set(slot_sorted)
-    fill = jnp.asarray(fill_value, pairs.dtype)
-    uniq = jnp.full((capacity, 2), fill, dtype=pairs.dtype)
-    dst = jnp.where(new_group, slot_sorted, capacity)
-    uniq = uniq.at[dst].set(jnp.stack([slo, shi], axis=1), mode="drop")
-    # match unique_indices' contract: the sentinel group (padding rows,
-    # hi == fill) is NOT a valid unique
-    valid = (jnp.arange(capacity) <= (slot_sorted[-1] if n else -1)) \
-        & (uniq[:, 1] != fill)
-    return uniq, inverse, valid
+    return unique_rows(pairs, capacity, fill_value)

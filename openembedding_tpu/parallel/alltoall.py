@@ -219,6 +219,26 @@ def split_slice_rows(rows: jnp.ndarray, num_parts: int, my_part: jnp.ndarray
     return lax.dynamic_slice(padded, starts, (m,) + rows.shape[1:])
 
 
+def segment_offsets(sizes: Sequence[int]) -> Tuple[int, ...]:
+    """Static exclusive prefix sums over per-segment entry counts.
+
+    The grouped exchange concatenates several tables' key streams into one
+    routed stream; these offsets carve each table's slice back out of the
+    concatenated result (all sizes are trace-time constants, so the carves
+    are static slices, not dynamic ops).
+    """
+    out = [0]
+    for s in sizes:
+        out.append(out[-1] + int(s))
+    return tuple(out)
+
+
+def carve_segments(rows: jnp.ndarray, sizes: Sequence[int]) -> list:
+    """Split ``rows`` [sum(sizes), ...] back into per-segment blocks."""
+    offs = segment_offsets(sizes)
+    return [rows[offs[i]:offs[i + 1]] for i in range(len(sizes))]
+
+
 def exchange_pull(flat_idx: jnp.ndarray,
                   resolve_fn: Callable[[jnp.ndarray], jnp.ndarray],
                   owner_fn: Callable[[jnp.ndarray], jnp.ndarray],
@@ -241,7 +261,10 @@ def exchange_pull(flat_idx: jnp.ndarray,
     ``owner_fn(keys)`` maps keys to shard ordinals (>= num_shards = do not
     send). The result is replicated over ``split_axes`` again (all_gather).
     WIDE keys ride as [n, 2] int32 (lo, hi) pairs (x64-off 64-bit space);
-    a pair is padding iff its hi word equals ``sentinel``.
+    a pair is padding iff its hi word equals ``sentinel``. Composite keys
+    generalize this to [n, K] rows (the grouped plane's table-tagged
+    streams, ``parallel/grouped.py``): padding rows carry ``sentinel`` in
+    every column and ``resolve_fn``/``owner_fn`` see the full K columns.
 
     Round 1 routes everything that fits the fixed-capacity buckets; any
     residue (structured key skew) loops through further rounds until the
@@ -252,10 +275,11 @@ def exchange_pull(flat_idx: jnp.ndarray,
     my_part = linear_shard_id(split_axes, split_sizes)
     n = flat_idx.shape[0]
     wide = flat_idx.ndim == 2
+    kw = flat_idx.shape[1] if wide else 1  # key words per entry
     sl, m = split_slice(flat_idx, math.prod(split_sizes), my_part, sentinel)
     if wide:
-        uniq, inverse, _valid = dedup.unique_pairs(sl, m,
-                                                   fill_value=sentinel)
+        uniq, inverse, _valid = dedup.unique_rows(sl, m,
+                                                  fill_value=sentinel)
     else:
         uniq, inverse, _valid = dedup.unique_indices(sl, m,
                                                      fill_value=sentinel)
@@ -266,7 +290,7 @@ def exchange_pull(flat_idx: jnp.ndarray,
         dest, ok = bucketize(pending, num_shards, cap)
         send = fill_buckets(uniq, dest, num_shards, cap, sentinel)
         req = grid_all_to_all(send, grid_axes, grid_sizes)
-        rows = resolve_fn(req.reshape((-1, 2)) if wide else req.ravel())
+        rows = resolve_fn(req.reshape((-1, kw)) if wide else req.ravel())
         resp = grid_all_to_all(rows.reshape((num_shards, cap, dim)),
                                grid_axes, grid_sizes)
         flat_resp = resp.reshape((num_shards * cap, dim))
@@ -354,8 +378,8 @@ def exchange_push(flat_idx: jnp.ndarray,
     sl, m = split_slice(flat_idx, parts, my_part, sentinel)
     g2 = split_slice_rows(grads.reshape((-1, dim)), parts, my_part)
     if wide:
-        uniq, inverse, _valid = dedup.unique_pairs(sl, m,
-                                                   fill_value=sentinel)
+        uniq, inverse, _valid = dedup.unique_rows(sl, m,
+                                                  fill_value=sentinel)
     else:
         uniq, inverse, _valid = dedup.unique_indices(sl, m,
                                                      fill_value=sentinel)
@@ -363,7 +387,7 @@ def exchange_push(flat_idx: jnp.ndarray,
     cap = bucket_capacity(m, num_shards, capacity, slack)
     owners = owner_fn(uniq)
     dest, ok = bucketize(owners, num_shards, cap)
-    kw = 2 if wide else 1  # key words per entry in the exchange buffer
+    kw = flat_idx.shape[1] if wide else 1  # key words per exchange entry
 
     def routed(st):
         ku = uniq if wide else uniq[:, None]

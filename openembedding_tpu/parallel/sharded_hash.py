@@ -45,7 +45,7 @@ class HashShardingSpec:
     max_probes: int = hash_lib.DEFAULT_MAX_PROBES
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
-    plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache"
+    plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache" | "a2a+grouped"
     a2a_capacity: int = 0
     a2a_slack: float = 2.0
     key_width: int = 32  # 64 = [n, 2] int32 (lo, hi) pairs, x64-off
@@ -56,8 +56,13 @@ class HashShardingSpec:
         return self.plane == "a2a+cache"
 
     @property
+    def is_grouped(self) -> bool:
+        """Collection-level multi-table exchange (``parallel/grouped.py``)."""
+        return self.plane == "a2a+grouped"
+
+    @property
     def shard_axes(self) -> tuple:
-        if self.plane in ("a2a", "a2a+cache"):
+        if self.plane in ("a2a", "a2a+cache", "a2a+grouped"):
             return (self.data_axis, self.model_axis)
         return (self.model_axis,)
 
@@ -97,7 +102,7 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
     ``plane="a2a+cache"``: a2a layout plus a ``cache_k``-row hot-row replica
     on every device (``parallel/hot_cache.py``); 0 picks the default size.
     """
-    if plane not in ("a2a", "psum", "a2a+cache"):
+    if plane not in ("a2a", "psum", "a2a+cache", "a2a+grouped"):
         raise ValueError(f"unknown plane {plane!r}")
     if key_width not in (32, 64):
         raise ValueError(f"key_width must be 32 or 64, got {key_width}")
@@ -316,7 +321,10 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                   record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
+    # a grouped-plane table addressed PER TABLE takes the plain a2a
+    # program — grouping only exists at the collection level
+    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+            or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
@@ -426,11 +434,14 @@ def pull_sharded(state,
         dim = table.weights.shape[-1]
         fn = _pull_program(mesh, spec, initializer, dim, batch_sharded,
                            record)
-        return fn(table.keys, table.weights, table.init_rng,
-                  state.cache.keys, state.cache.rows, indices)
+        return observability.plane_timed(
+            "pull", spec.plane, record, fn, table.keys, table.weights,
+            table.init_rng, state.cache.keys, state.cache.rows, indices)
     dim = state.weights.shape[-1]
     fn = _pull_program(mesh, spec, initializer, dim, batch_sharded, record)
-    return fn(state.keys, state.weights, state.init_rng, indices)
+    return observability.plane_timed(
+        "pull", spec.plane, record, fn, state.keys, state.weights,
+        state.init_rng, indices)
 
 
 @functools.lru_cache(maxsize=None)
@@ -440,7 +451,8 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                    slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
+    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+            or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
@@ -592,10 +604,12 @@ def apply_gradients_sharded(state,
         fn = _apply_program(mesh, spec, optimizer, initializer, dim,
                             batch_sharded, dedup_capacity,
                             tuple(table.slots), record)
-        keys, weights, slots, crows, cslots, failed = fn(
-            table.keys, table.weights, table.slots, table.init_rng,
-            state.cache.keys, state.cache.rows, state.cache.slots,
-            indices, grads)
+        keys, weights, slots, crows, cslots, failed = \
+            observability.plane_timed(
+                "push", spec.plane, record, fn,
+                table.keys, table.weights, table.slots, table.init_rng,
+                state.cache.keys, state.cache.rows, state.cache.slots,
+                indices, grads)
         new_table = hash_lib.HashTableState(
             keys=keys, weights=weights, slots=slots,
             init_rng=table.init_rng,
@@ -608,7 +622,8 @@ def apply_gradients_sharded(state,
     fn = _apply_program(mesh, spec, optimizer, initializer, dim,
                         batch_sharded, dedup_capacity, tuple(state.slots),
                         record)
-    keys, weights, slots, failed = fn(
+    keys, weights, slots, failed = observability.plane_timed(
+        "push", spec.plane, record, fn,
         state.keys, state.weights, state.slots, state.init_rng,
         indices, grads)
     return hash_lib.HashTableState(

@@ -39,6 +39,11 @@ Data planes (``ShardingSpec.plane``):
   keys are served locally with no exchange round, pushes pre-reduce
   locally and merge with one psum over the K cached rows — exactly
   equivalent to ``"a2a"``, built for Zipfian key streams.
+* ``"a2a+grouped"`` — the a2a layout, but the COLLECTION batches all
+  same-shape tables into one exchange per group per step
+  (``parallel/grouped.py``): a T-table model pays O(#groups) collective
+  rounds instead of O(T). Per-table calls on this plane (serving probes,
+  checkpoint paths) behave exactly like ``"a2a"``.
 """
 
 from __future__ import annotations
@@ -76,7 +81,7 @@ class ShardingSpec:
     layout: str = "mod"  # "mod" | "div"
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
-    plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache"
+    plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache" | "a2a+grouped"
     a2a_capacity: int = 0    # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0   # auto capacity = slack * mean bucket size
     cache_k: int = 0         # hot-row replica slots ("a2a+cache" plane)
@@ -86,9 +91,14 @@ class ShardingSpec:
         return self.plane == "a2a+cache"
 
     @property
+    def is_grouped(self) -> bool:
+        """Collection-level multi-table exchange (``parallel/grouped.py``)."""
+        return self.plane == "a2a+grouped"
+
+    @property
     def shard_axes(self) -> tuple:
         """Mesh axes the table's row dimension is sharded over."""
-        if self.plane in ("a2a", "a2a+cache"):
+        if self.plane in ("a2a", "a2a+cache", "a2a+grouped"):
             return (self.data_axis, self.model_axis)
         return (self.model_axis,)
 
@@ -129,7 +139,7 @@ def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
     """
     if layout not in ("mod", "div"):
         raise ValueError(f"unknown layout {layout!r}")
-    if plane not in ("a2a", "psum", "a2a+cache"):
+    if plane not in ("a2a", "psum", "a2a+cache", "a2a+grouped"):
         raise ValueError(f"unknown plane {plane!r}")
     want = mesh.shape[MODEL_AXIS] if plane == "psum" else mesh.size
     if num_shards == -1:
@@ -286,8 +296,12 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
     # single shard => nothing to route; the masked-local body below (whose
     # collectives are free over size-1 axes) skips the bucketing machinery
     # (~25% faster on one chip for the headline config). The cached plane
-    # always routes: its residue masking composes with the exchange.
-    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
+    # always routes: its residue masking composes with the exchange. A
+    # grouped-plane table addressed PER TABLE (serving probes, checkpoint
+    # paths) takes the plain a2a program — grouping only exists at the
+    # collection level.
+    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+            or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
         sentinel = dedup.FILL
@@ -384,11 +398,13 @@ def pull_sharded(state,
     if spec.is_cached:
         dim = state.table.weights.shape[-1]
         fn = _pull_program(mesh, spec, dim, batch_sharded, record)
-        return fn(state.table.weights, state.cache.keys, state.cache.rows,
-                  indices)
+        return observability.plane_timed(
+            "pull", spec.plane, record, fn, state.table.weights,
+            state.cache.keys, state.cache.rows, indices)
     dim = state.weights.shape[-1]
     fn = _pull_program(mesh, spec, dim, batch_sharded, record)
-    return fn(state.weights, indices)
+    return observability.plane_timed("pull", spec.plane, record, fn,
+                                     state.weights, indices)
 
 
 @functools.lru_cache(maxsize=None)
@@ -398,7 +414,8 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                    slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if (spec.plane == "a2a" and spec.num_shards > 1) or spec.is_cached:
+    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+            or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
@@ -534,7 +551,8 @@ def apply_gradients_sharded(state,
         dim = table.weights.shape[-1]
         fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
                             dedup_capacity, tuple(table.slots), record)
-        weights, slots, crows, cslots = fn(
+        weights, slots, crows, cslots = observability.plane_timed(
+            "push", spec.plane, record, fn,
             table.weights, table.slots, state.cache.keys, state.cache.rows,
             state.cache.slots, indices, grads)
         return hot_cache.CachedState(
@@ -544,5 +562,7 @@ def apply_gradients_sharded(state,
     dim = state.weights.shape[-1]
     fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
                         dedup_capacity, tuple(state.slots), record)
-    weights, slots = fn(state.weights, state.slots, indices, grads)
+    weights, slots = observability.plane_timed(
+        "push", spec.plane, record, fn,
+        state.weights, state.slots, indices, grads)
     return table_lib.TableState(weights=weights, slots=slots)

@@ -127,6 +127,62 @@ def cache_stats(accumulator: Optional[Accumulator] = None
             "cache_hit_rate": hits / total if total else 0.0}
 
 
+def under_trace(tree) -> bool:
+    """True when any leaf of ``tree`` is a JAX tracer — host-side
+    timers/counters must not record during an outer trace (the host code
+    runs once per COMPILE there, so a record would claim one trace-time
+    sample instead of per-step figures; run-time recording inside a
+    jitted region needs ``jax.debug.callback``, cf. alltoall.record_stat)."""
+    import jax
+    return any(isinstance(leaf, jax.core.Tracer)
+               for leaf in jax.tree.leaves(tree))
+
+
+def plane_timed(verb: str, plane: str, enabled: bool, fn, *args):
+    """Run one data-plane dispatch with a gated per-plane wall timer.
+
+    ``enabled`` is the caller's snapshot of :func:`evaluate_performance`
+    (off by default — the timer BLOCKS on the result, which would serialize
+    the async dispatch pipeline every step). Timings land under
+    ``<verb>/<plane>`` (e.g. ``pull/a2a+grouped``) so A/B runs attribute
+    step time to the exchange plane, not the whole step — read them back
+    with :func:`plane_timings`. Dispatches reached inside an OUTER jit
+    (``Trainer`` fused steps) skip recording: there the plane's wall time
+    is not separable from the step program's, and the eager stage-isolation
+    loops (bench.py) are the measurement surface instead.
+    """
+    if not enabled or under_trace(args):
+        return fn(*args)
+    import jax
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    GLOBAL.add_time(f"{verb}/{plane}", time.perf_counter() - t0)
+    return out
+
+
+def plane_timings(accumulator: Optional[Accumulator] = None
+                  ) -> Dict[str, Dict[str, float]]:
+    """Per-plane pull/push wall-time split recorded by :func:`plane_timed`.
+
+    Returns ``{plane: {"pull_ms": avg, "pull_calls": n, "push_ms": ...}}``
+    — empty unless :func:`set_evaluate_performance` was on while the
+    plane dispatches ran (``cache_stats``-style gating).
+    """
+    snap = (accumulator or GLOBAL).snapshot()
+    out: Dict[str, Dict[str, float]] = {}
+    for name, fields in snap.items():
+        if "/" not in name:
+            continue
+        verb, plane = name.split("/", 1)
+        if verb not in ("pull", "push") or "calls" not in fields:
+            continue
+        d = out.setdefault(plane, {})
+        d[f"{verb}_ms"] = fields.get("avg_ms", 0.0)
+        d[f"{verb}_calls"] = fields["calls"]
+    return out
+
+
 def lock_stats() -> Dict[str, Dict[str, float]]:
     """Per-lock runtime counters from the graftrace detector
     (``analysis/concurrency.py`` TracedLock): ``acquires``, ``contended``

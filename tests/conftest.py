@@ -25,7 +25,51 @@ except AttributeError:
     # older JAX: only the XLA_FLAGS path set above exists
     pass
 
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+_SESSION_T0 = time.time()
+
+# Tier-1 wall-time guard: the CI window hard-kills the `not slow` lane at
+# 870 s, which once silently truncated it mid-serving — every test past
+# the cut reported neither pass nor fail. With OE_TIER1_BUDGET_S set
+# (CI: 750) the session itself gets loud *before* the window does:
+# a banner plus, with OE_TIER1_BUDGET_HARD=1, a nonzero exit so the lane
+# FAILS instead of silently shrinking. Pair with --durations=10 so the
+# offenders to slow-mark are in the same log.
+
+
+def _tier1_budget() -> float:
+    try:
+        return float(os.environ.get("OE_TIER1_BUDGET_S", "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    budget = _tier1_budget()
+    if not budget:
+        return
+    elapsed = time.time() - _SESSION_T0
+    if elapsed <= budget:
+        terminalreporter.write_line(
+            f"tier-1 budget: {elapsed:.0f}s of {budget:.0f}s used")
+        return
+    terminalreporter.write_sep(
+        "=", f"TIER-1 BUDGET EXCEEDED: {elapsed:.0f}s > {budget:.0f}s",
+        red=True, bold=True)
+    terminalreporter.write_line(
+        "the 870s CI window will truncate this lane mid-run; slow-mark "
+        "the top --durations offenders (see above) to get back under "
+        "budget", red=True)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    budget = _tier1_budget()
+    if (budget and time.time() - _SESSION_T0 > budget
+            and os.environ.get("OE_TIER1_BUDGET_HARD")):
+        session.exitstatus = 3
 
 
 @pytest.fixture(scope="session")

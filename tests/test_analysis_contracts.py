@@ -44,6 +44,62 @@ def test_pull_push_contracts_hash(devices8, plane):
     contracts.check_program(txt, plane, "push", **params)
 
 
+def test_grouped_one_exchange_set_per_group(devices8):
+    """THE grouped-plane claim: a 3-table collection compiles to exactly
+    ONE exchange collective set (num_groups == 1), not one per table —
+    the all-to-all inventory equals a single-table a2a program's, where
+    the per-table loop would compile 3x that."""
+    mesh = create_mesh(2, 4, devices8)
+    a2a_ops = programs.count_exchange_a2a(mesh, "pull", batch=B, dim=DIM)
+    txt, params = programs.lower_grouped_pull(mesh, tables=3, batch=B,
+                                              dim=DIM, a2a_ops=a2a_ops)
+    assert params["num_groups"] == 1 and params["num_tables"] == 3
+    summary = contracts.check_program(txt, "a2a+grouped", "pull", **params)
+    assert summary["all-to-all"][0] == a2a_ops
+    assert summary["all-to-all"][0] < params["num_tables"] * a2a_ops
+
+
+@pytest.mark.slow
+def test_grouped_push_contract(devices8):
+    """Push half of the launch-count claim (tier-1 keeps the pull half;
+    `tools/graftcheck` audits both in CI)."""
+    mesh = create_mesh(2, 4, devices8)
+    push_ops = programs.count_exchange_a2a(mesh, "push", batch=B, dim=DIM)
+    txt, params = programs.lower_grouped_push(mesh, tables=3, batch=B,
+                                              dim=DIM, a2a_ops=push_ops)
+    summary = contracts.check_program(txt, "a2a+grouped", "push", **params)
+    assert summary["all-to-all"][0] == push_ops
+
+
+def test_grouped_broken_annotation_caught(devices8):
+    """Replicating the grouped pull output re-gathers each table's rows
+    in a separate buffer — each below the single-buffer bound, so the
+    TOTAL-bytes budget is what must catch it."""
+    mesh = create_mesh(2, 4, devices8)
+    txt, params = programs.lower_grouped_pull(mesh, tables=3, batch=B,
+                                              dim=DIM, a2a_ops=8,
+                                              out_replicated=True)
+    with pytest.raises(contracts.ContractViolation, match="total"):
+        contracts.check_program(txt, "a2a+grouped", "pull", **params)
+
+
+@pytest.mark.slow
+def test_grouped_contracts_hash(devices8):
+    """Hash groups carry an explicit (key..., tag) column stream; same
+    launch-count contract. Slow lane like the other hash lowerings."""
+    mesh = create_mesh(2, 4, devices8)
+    a2a_ops = programs.count_exchange_a2a(mesh, "pull", batch=B, dim=DIM)
+    txt, params = programs.lower_grouped_pull(mesh, tables=3, batch=B,
+                                              dim=DIM, use_hash=True,
+                                              a2a_ops=a2a_ops)
+    contracts.check_program(txt, "a2a+grouped", "pull", **params)
+    push_ops = programs.count_exchange_a2a(mesh, "push", batch=B, dim=DIM)
+    txt, params = programs.lower_grouped_push(mesh, tables=3, batch=B,
+                                              dim=DIM, use_hash=True,
+                                              a2a_ops=push_ops)
+    contracts.check_program(txt, "a2a+grouped", "push", **params)
+
+
 def test_broken_sharding_annotation_caught(devices8):
     """Replicating the pull output (a one-line sharding regression)
     forces a global-batch gather — the contract must fail it."""

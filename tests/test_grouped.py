@@ -1,0 +1,333 @@
+"""Grouped multi-table exchange plane (``parallel/grouped.py``).
+
+``plane="a2a+grouped"`` must be EXACTLY equivalent to the per-table
+``"a2a"`` loop — the grouping only changes how many collective rounds a
+step launches (one per GROUP of same-shape tables, not one per table).
+The parity matrix drives both planes through the public collection API
+on identical data + seeds: zipf/uniform streams x array/hash32/hash-wide
+tables x mixed dims in one group x a pooled member, pulls and optimizer
+state compared allclose every step. Planner unit tests pin the static
+grouping key; counter tests pin the observability surface.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+from openembedding_tpu import hash_table as hash_lib
+from openembedding_tpu.parallel import grouped
+from openembedding_tpu.parallel.mesh import create_mesh
+from openembedding_tpu.utils import observability
+
+OPT = {"category": "adagrad", "learning_rate": 0.1}
+INIT = {"category": "constant", "value": 0.25}
+B, L = 32, 4
+
+
+def _specs(kind, plane):
+    """Four tables: dims 3+4 share one bucket (mixed dims in ONE group),
+    dim 6 forms a second bucket, plus a pooled dim-3 member riding the
+    first group — every satellite axis inside one collection."""
+    common = dict(optimizer=OPT, initializer=INIT, plane=plane)
+    if kind == "array":
+        return (
+            EmbeddingSpec(name="t3", input_dim=64, output_dim=3, **common),
+            EmbeddingSpec(name="t4", input_dim=96, output_dim=4, **common),
+            EmbeddingSpec(name="t6", input_dim=48, output_dim=6, **common),
+            EmbeddingSpec(name="tp", input_dim=64, output_dim=3,
+                          pooling="mean", **common),
+        )
+    key_dtype = "int32" if kind == "hash32" else "wide"
+    hk = dict(input_dim=-1, hash_capacity=4096, key_dtype=key_dtype,
+              **common)
+    return (
+        EmbeddingSpec(name="t3", output_dim=3, **hk),
+        EmbeddingSpec(name="t4", output_dim=4, **hk),
+        EmbeddingSpec(name="t6", output_dim=6, **hk),
+        EmbeddingSpec(name="tp", output_dim=3, pooling="sum", **hk),
+    )
+
+
+def _draw(rng, dist, hi, size):
+    if dist == "uniform":
+        return rng.randint(0, hi, size).astype(np.int64)
+    ranks = np.arange(1, hi + 1, dtype=np.float64)
+    probs = ranks ** -1.1
+    probs /= probs.sum()
+    return rng.choice(hi, size=size, p=probs).astype(np.int64)
+
+
+def _batch(rng, kind, dist):
+    """Flat id columns for t3/t4/t6 + a padded [B, L] matrix for tp.
+    Array streams include OUT-OF-RANGE ids (negative and beyond vocab):
+    the per-table path zero-rows/drops them and grouped must too."""
+    if kind == "array":
+        vocab = {"t3": 64, "t4": 96, "t6": 48}
+        out = {n: _draw(rng, dist, v, B).astype(np.int32)
+               for n, v in vocab.items()}
+        out["t4"][::7] = -1
+        out["t4"][1::9] = 96 + 5
+        pool = _draw(rng, dist, 64, (B, L)).astype(np.int32)
+        pool[:, -1] = -1          # ragged padding
+        out["tp"] = pool
+        return out
+    out = {n: _draw(rng, dist, 100_000, B) for n in ("t3", "t4", "t6")}
+    pad = hash_lib.empty_key(np.int64)
+    pool = _draw(rng, dist, 100_000, (B, L))
+    pool[:, -1] = pad
+    out["tp"] = pool
+    return out
+
+
+def _assert_state_close(sg, sa, kind, msg):
+    for n in ("t3", "t4", "t6", "tp"):
+        np.testing.assert_allclose(
+            np.asarray(sg[n].weights), np.asarray(sa[n].weights),
+            rtol=1e-5, atol=1e-6, err_msg=f"{msg}:{n}:weights")
+        for slot in sg[n].slots:
+            np.testing.assert_allclose(
+                np.asarray(sg[n].slots[slot]),
+                np.asarray(sa[n].slots[slot]),
+                rtol=1e-5, atol=1e-6, err_msg=f"{msg}:{n}:{slot}")
+        if kind != "array":
+            assert int(sg[n].insert_failures) == \
+                int(sa[n].insert_failures), n
+
+
+# the full 6-cell matrix; two cells ride tier-1 (one array, one wide
+# hash — the two exchange encodings), the re-compiled rest (same code
+# paths, different key streams/dtypes) rides the slow lane for budget
+_MATRIX = [("array", "zipf"), ("wide", "zipf"),
+           pytest.param("hash32", "uniform", marks=pytest.mark.slow),
+           pytest.param("array", "uniform", marks=pytest.mark.slow),
+           pytest.param("hash32", "zipf", marks=pytest.mark.slow),
+           pytest.param("wide", "uniform", marks=pytest.mark.slow)]
+
+
+@pytest.mark.parametrize("kind,dist", _MATRIX)
+def test_grouped_matches_per_table(devices8, kind, dist):
+    mesh = create_mesh(2, 4, devices8)
+    cg = EmbeddingCollection(_specs(kind, "a2a+grouped"), mesh)
+    ca = EmbeddingCollection(_specs(kind, "a2a"), mesh)
+    assert cg.grouped_names() == ("t3", "t4", "t6", "tp")
+    sg = cg.init(jax.random.PRNGKey(3))
+    sa = ca.init(jax.random.PRNGKey(3))
+    rng = np.random.RandomState(7)
+    for step in range(2):
+        inp = _batch(rng, kind, dist)
+        rg, ra = cg.pull(sg, inp), ca.pull(sa, inp)
+        for n in inp:
+            np.testing.assert_allclose(
+                np.asarray(rg[n]), np.asarray(ra[n]),
+                rtol=1e-5, atol=1e-6, err_msg=f"pull:{n}")
+        grads = {n: jnp.asarray(
+            rng.randn(*np.asarray(ra[n]).shape).astype(np.float32))
+            for n in inp}
+        sg = cg.apply_gradients(sg, inp, grads)
+        sa = ca.apply_gradients(sa, inp, grads)
+    _assert_state_close(sg, sa, kind, f"{kind}/{dist}")
+    if kind != "array":
+        # read-only (serving) contract: missing keys -> zeros, grouped too
+        probe = {"t3": np.arange(50, 150).astype(np.int64)}
+        pg = cg.pull(sg, probe, read_only=True)["t3"]
+        pa = ca.pull(sa, probe, read_only=True)["t3"]
+        np.testing.assert_allclose(np.asarray(pg), np.asarray(pa),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_plan_groups_static_key(devices8):
+    """The planner's grouping key: dim BUCKET (3 and 4 share 4; 6 takes
+    8), array vs hash, key width — members keep registration order and
+    array groups carry fused-style offset bases over padded vocabs."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = EmbeddingCollection(_specs("array", "a2a+grouped"), mesh)
+    plans = grouped.plan_groups(coll, ("t3", "t4", "t6", "tp"))
+    shape = [(p.kind, p.bucket_dim, tuple(m.name for m in p.members))
+             for p in plans]
+    assert shape == [("array", 4, ("t3", "t4", "tp")),
+                     ("array", 8, ("t6",))]
+    # bases = exclusive prefix sums of PADDED vocabs (64 -> 64, 96 -> 96
+    # on 8 shards, 64 -> 64)
+    assert plans[0].bases == (0, 64, 160, 224)
+
+    mixed = EmbeddingCollection(
+        _specs("hash32", "a2a+grouped")[:2]
+        + _specs("wide", "a2a+grouped")[2:], mesh)
+    plans = grouped.plan_groups(mixed, ("t3", "t4", "t6", "tp"))
+    key = {tuple(m.name for m in p.members): (p.kind, p.key_dtype)
+           for p in plans}
+    # int32 and wide keys can never share a stream; dim 6 buckets apart
+    assert key == {("t3", "t4"): ("hash", "int32"),
+                   ("t6",): ("hash", "wide"),
+                   ("tp",): ("hash", "wide")}
+
+
+def test_plan_groups_offset_span_split(devices8):
+    """Array groups split when the concatenated padded vocabs would
+    overflow the int32 offset space — no silent aliasing at scale."""
+    mesh = create_mesh(2, 4, devices8)
+    specs = tuple(
+        EmbeddingSpec(name=f"big{i}", input_dim=1 << 30, output_dim=4,
+                      optimizer=OPT, initializer=INIT, plane="a2a+grouped")
+        for i in range(3))
+    coll = EmbeddingCollection(specs, mesh)
+    plans = grouped.plan_groups(coll, tuple(s.name for s in specs))
+    assert [len(p.members) for p in plans] == [1, 1, 1]
+    assert all(p.bases[-1] <= 2**31 - 1 for p in plans)
+
+
+def test_plan_groups_rejects_other_planes(devices8):
+    mesh = create_mesh(2, 4, devices8)
+    coll = EmbeddingCollection(_specs("array", "a2a"), mesh)
+    with pytest.raises(ValueError, match="a2a\\+grouped"):
+        grouped.plan_groups(coll, ("t3",))
+
+
+def test_grouped_composes_with_cache_plane(devices8):
+    """Mixed-plane collection: grouped tables batch, a cached table keeps
+    its replica path, a psum table keeps its ablation program — state
+    parity vs the all-a2a baseline on every variable."""
+    mesh = create_mesh(2, 4, devices8)
+
+    def specs(planes):
+        return tuple(
+            EmbeddingSpec(name=n, input_dim=64, output_dim=4,
+                          optimizer=OPT, initializer=INIT, plane=p)
+            for n, p in planes.items())
+
+    mixed = {"g1": "a2a+grouped", "g2": "a2a+grouped",
+             "hot": "a2a+cache", "base": "psum"}
+    cm = EmbeddingCollection(specs(mixed), mesh)
+    ca = EmbeddingCollection(specs({n: "a2a" for n in mixed}), mesh)
+    assert cm.grouped_names() == ("g1", "g2")
+    sm, sa = cm.init(jax.random.PRNGKey(5)), ca.init(jax.random.PRNGKey(5))
+    rng = np.random.RandomState(11)
+    for _ in range(2):
+        inp = {n: rng.randint(0, 64, B).astype(np.int32) for n in mixed}
+        rm, ra = cm.pull(sm, inp), ca.pull(sa, inp)
+        for n in mixed:
+            np.testing.assert_allclose(np.asarray(rm[n]),
+                                       np.asarray(ra[n]),
+                                       rtol=1e-5, atol=1e-6, err_msg=n)
+        grads = {n: jnp.asarray(rng.randn(B, 4).astype(np.float32))
+                 for n in mixed}
+        sm = cm.apply_gradients(sm, inp, grads)
+        sa = ca.apply_gradients(sa, inp, grads)
+    # final-state parity via a full-vocab probe pull: the psum member
+    # stores rows in a different physical shard interleaving (4 model
+    # shards vs 8 whole-mesh shards), so raw weights are not comparable
+    # across planes — logical rows are
+    probe = {n: np.arange(64, dtype=np.int32) for n in mixed}
+    pm, pa = cm.pull(sm, probe), ca.pull(sa, probe)
+    for n in mixed:
+        np.testing.assert_allclose(np.asarray(pm[n]), np.asarray(pa[n]),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_per_table_fallback_on_grouped_spec(devices8):
+    """A grouped-plane table addressed PER TABLE (serving probes, the
+    checkpoint loader, hot-cache style direct calls) takes the plain a2a
+    program — same rows, same updates as an a2a-spec table."""
+    from openembedding_tpu.meta import EmbeddingVariableMeta
+    from openembedding_tpu.parallel import sharded_table as st
+
+    mesh = create_mesh(2, 4, devices8)
+    meta = EmbeddingVariableMeta(embedding_dim=4, vocabulary_size=64)
+    states, specs = {}, {}
+    for plane in ("a2a", "a2a+grouped"):
+        specs[plane] = st.make_sharding_spec(meta, mesh, plane=plane)
+        states[plane] = st.create_sharded_table(
+            meta, OPT, INIT, mesh=mesh, spec=specs[plane],
+            rng=jax.random.PRNGKey(2))
+    idx = np.arange(64, dtype=np.int32)
+    rows = {p: st.pull_sharded(states[p], idx, mesh=mesh, spec=specs[p])
+            for p in specs}
+    np.testing.assert_allclose(np.asarray(rows["a2a+grouped"]),
+                               np.asarray(rows["a2a"]), rtol=1e-5)
+    g = jnp.asarray(np.random.RandomState(0)
+                    .randn(64, 4).astype(np.float32))
+    from openembedding_tpu.optim.optimizers import make_optimizer
+    for p in specs:
+        states[p] = st.apply_gradients_sharded(
+            states[p], make_optimizer(OPT), idx, g, mesh=mesh,
+            spec=specs[p])
+    np.testing.assert_allclose(np.asarray(states["a2a+grouped"].weights),
+                               np.asarray(states["a2a"].weights),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grouped_counters_and_plane_timings(devices8):
+    """Gated observability: grouped_groups / grouped_exchange_bytes count
+    per dispatch, and the per-plane pull/push wall-time split lands under
+    pull/a2a+grouped so A/B runs attribute time to the exchange."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = EmbeddingCollection(_specs("array", "a2a+grouped"), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    inp = _batch(rng, "array", "uniform")
+    observability.GLOBAL.reset()
+    rows = coll.pull(states, inp)          # gate off: nothing recorded
+    assert "grouped_groups" not in observability.GLOBAL.snapshot()
+    observability.set_evaluate_performance(True)
+    try:
+        rows = coll.pull(states, inp)
+        grads = {n: jnp.asarray(
+            rng.randn(*np.asarray(rows[n]).shape).astype(np.float32))
+            for n in inp}
+        coll.apply_gradients(states, inp, grads)
+    finally:
+        observability.set_evaluate_performance(False)
+    snap = observability.GLOBAL.snapshot()
+    # 2 groups per dispatch (bucket 4 + bucket 8), pull + push = 4
+    assert snap["grouped_groups"]["count"] == 4
+    assert snap["grouped_exchange_bytes"]["count"] > 0
+    timings = observability.plane_timings()
+    assert timings["a2a+grouped"]["pull_calls"] == 2
+    assert timings["a2a+grouped"]["push_calls"] == 2
+    assert timings["a2a+grouped"]["pull_ms"] >= 0.0
+    observability.GLOBAL.reset()
+
+
+@pytest.mark.slow
+def test_trainer_loss_parity_grouped_vs_a2a(devices8):
+    """End-to-end: a DeepFM Trainer on the grouped plane reproduces the
+    per-table plane's loss trajectory exactly (sgd + constant init)."""
+    import optax
+    from openembedding_tpu import Trainer
+    from openembedding_tpu.models import deepctr
+
+    mesh = create_mesh(2, 4, devices8)
+    feats = ("c0", "c1")
+    rng = np.random.RandomState(0)
+    vocab = 512
+    batches = []
+    for _ in range(5):
+        sparse = {f: rng.randint(0, vocab, 64).astype(np.int32)
+                  for f in feats}
+        for f in feats:
+            sparse[f + deepctr.LINEAR_SUFFIX] = sparse[f]
+        batches.append({
+            "label": (rng.rand(64) > 0.5).astype(np.float32),
+            "dense": rng.randn(64, 4).astype(np.float32),
+            "sparse": sparse})
+    losses = {}
+    for plane in ("a2a", "a2a+grouped"):
+        specs = deepctr.make_feature_specs(
+            feats, vocab, 8, plane=plane,
+            optimizer={"category": "sgd", "learning_rate": 0.1},
+            initializer={"category": "constant", "value": 0.0})
+        coll = EmbeddingCollection(specs, mesh)
+        trainer = Trainer(deepctr.DeepFM(feature_names=feats), coll,
+                          optax.sgd(0.1))
+        state = trainer.init(jax.random.PRNGKey(1),
+                             trainer.shard_batch(batches[0]))
+        curve = []
+        for b in batches:
+            state, m = trainer.train_step(state, b)
+            curve.append(float(m["loss"]))
+        losses[plane] = curve
+    np.testing.assert_allclose(losses["a2a+grouped"], losses["a2a"],
+                               rtol=1e-5, atol=1e-6)

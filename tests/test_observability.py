@@ -36,6 +36,46 @@ def test_batch_stats_gated():
         obs.set_evaluate_performance(False)
 
 
+def test_plane_timed_and_timings():
+    """Per-plane pull/push wall-time split: gated off -> no record, on ->
+    timings land under <verb>/<plane> and read back via plane_timings."""
+    obs.GLOBAL.reset()
+    out = obs.plane_timed("pull", "a2a", False, lambda x: x + 1, 1)
+    assert out == 2 and obs.plane_timings() == {}
+    obs.plane_timed("pull", "a2a+grouped", True,
+                    lambda: np.arange(4))
+    obs.plane_timed("pull", "a2a+grouped", True,
+                    lambda: np.arange(4))
+    obs.plane_timed("push", "a2a+grouped", True,
+                    lambda: np.arange(4))
+    obs.GLOBAL.add_time("not_a_plane_timer", 1.0)   # must be ignored
+    t = obs.plane_timings()
+    assert set(t) == {"a2a+grouped"}
+    assert t["a2a+grouped"]["pull_calls"] == 2
+    assert t["a2a+grouped"]["push_calls"] == 1
+    assert t["a2a+grouped"]["pull_ms"] >= 0.0
+    obs.GLOBAL.reset()
+
+
+def test_plane_timed_skips_recording_under_trace():
+    """Inside an outer jit the dispatch body runs once per COMPILE, so a
+    wall-time record there would report trace time as a step figure —
+    the under_trace guard must skip recording (the compiled fn still
+    computes)."""
+    import jax
+    import jax.numpy as jnp
+
+    obs.GLOBAL.reset()
+
+    def f(x):
+        return obs.plane_timed("pull", "a2a", True, lambda y: y * 2, x)
+
+    out = jax.jit(f)(jnp.ones((4,)))
+    assert float(out[0]) == 2.0
+    assert obs.plane_timings() == {}
+    obs.GLOBAL.reset()
+
+
 def test_reporter_periodic():
     acc = obs.Accumulator()
     acc.add("x", 1)

@@ -76,6 +76,21 @@ def main(argv=None) -> int:
                                                    **params)
                 audit(f"{plane}/{prog} ({kind})", run)
 
+    # grouped plane: collection-level lowering over 3 heterogeneous
+    # same-dim tables (one exchange group) — the contract caps the
+    # all-to-all launch count at num_groups * per-exchange ops, which a
+    # per-table-loop regression (3x the ops) fails
+    for use_hash in (False, True):
+        kind = "hash" if use_hash else "array"
+        for prog, lower in (("pull", programs.lower_grouped_pull),
+                            ("push", programs.lower_grouped_push)):
+            def run(prog=prog, lower=lower, use_hash=use_hash):
+                txt, params = lower(mesh, tables=3, batch=args.batch,
+                                    dim=args.dim, use_hash=use_hash)
+                return contracts.check_program(txt, "a2a+grouped", prog,
+                                               **params)
+            audit(f"a2a+grouped/{prog} ({kind}, 3 tables)", run)
+
     if not args.skip_step:
         def run_step():
             # vocab/dim sized so each table shard dwarfs every dense
