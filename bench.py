@@ -1471,10 +1471,29 @@ def main(argv=None):
                         "train/offload-throughput config; TensorBoard/"
                         "Perfetto viewable) — the reference benchmark's "
                         "--profile flag")
+    p.add_argument("--trace", default="",
+                   help="write a graftscope Chrome-trace/Perfetto JSON of "
+                        "this invocation's host spans (step/pull/push/"
+                        "offload/checkpoint) to this path. Full traces "
+                        "come from the in-process modes (--configs / "
+                        "headline); --suite children run in subprocesses "
+                        "and do not inherit it (the parent's few spans "
+                        "are still written). Every bench entry can ship "
+                        "its trace.")
     args = p.parse_args(argv)
     if args.profile:
         global PROFILE_DIR
         PROFILE_DIR = args.profile
+    if args.trace:
+        from openembedding_tpu.analysis import scope as _scope
+        _scope.set_tracing(True)
+
+    def _export_trace():
+        # every exit path writes the file when --trace was given — a
+        # silent no-op (suite/probe modes) would read as "no spans"
+        if args.trace:
+            from openembedding_tpu.analysis import scope as _scope
+            _scope.export_chrome_trace(args.trace)
 
     if args.probe:
         t0 = time.time()
@@ -1484,6 +1503,7 @@ def main(argv=None):
         print(json.dumps({"ok": True, "init_s": round(time.time() - t0, 1),
                           "n_devices": len(devs),
                           "platform": devs[0].platform}), flush=True)
+        _export_trace()
         return 0
 
     if args.suite:
@@ -1517,6 +1537,7 @@ def main(argv=None):
                                                  if "error" not in r)),
                               "unit": "configs", "vs_baseline": 0.0}),
                   flush=True)
+            _export_trace()
             return 1
         # device configs FIRST: if the chip wedges mid-suite, the
         # throughput matrix is already captured — the deviceless tail is
@@ -1529,6 +1550,9 @@ def main(argv=None):
                            "bench_suite.json")
         with open(out, "w") as f:
             json.dump(results, f, indent=2)
+        # parent-process spans only: --suite children are subprocesses
+        # and write no trace (documented in --help)
+        _export_trace()
         return 1 if any("error" in r for r in results) else 0
 
     if not args.configs:
@@ -1589,6 +1613,7 @@ def main(argv=None):
             print(json.dumps(r), flush=True)
     if not args.configs:
         print(json.dumps(results[0]))
+    _export_trace()
     # a failed config must fail the invocation — a driver/CI gating on the
     # exit status should not see a silent benchmark regression
     return 1 if any("error" in r for r in results) else 0

@@ -17,16 +17,23 @@ Three enforcement layers, all mechanical (ISSUE 3):
   (``sync_point``/``SerialSchedule``/``PointGate``).
 * :mod:`.retrace` — a runtime guard that counts XLA compilations around
   a training loop and fails past a declared budget.
+* :mod:`.scope` — graftscope (ISSUE 6): span tracing into per-thread
+  ring buffers (Chrome-trace/Perfetto export), the log-bucket histogram
+  registry behind the ``/metrics`` ``_bucket``/``_sum``/``_count``
+  series, and the expected-vs-measured collective-byte ledger (CLI
+  ``python -m tools.graftscope``).
 
-Import discipline: ``contracts``, ``lint``, and ``concurrency`` are
-stdlib-only and imported eagerly, so every subsystem module (and the
-graftlint/graftrace CLIs) can use ``@host_fn`` / ``make_lock`` /
-``sync_point`` without paying for jax. ``retrace`` (imports jax) and
-``programs`` (lowers real programs) load lazily via module
-``__getattr__`` — the public surface is unchanged.
+Import discipline: ``contracts``, ``lint``, ``concurrency``, and
+``scope`` are stdlib-only at import time and imported eagerly, so every
+subsystem module (and the graftlint/graftrace CLIs) can use
+``@host_fn`` / ``make_lock`` / ``sync_point`` / ``span`` without paying
+for jax (``scope`` looks jax up lazily, and only when something else
+already imported it). ``retrace`` (imports jax) and ``programs``
+(lowers real programs) load lazily via module ``__getattr__`` — the
+public surface is unchanged.
 """
 
-from . import concurrency, contracts, lint
+from . import concurrency, contracts, lint, scope
 from .concurrency import (TraceViolation, TracedLock, TracedRLock,
                           make_lock, make_rlock, sync_point,
                           trace_paths, trace_source)
@@ -34,6 +41,8 @@ from .contracts import (ContractViolation, ProgramContract, OpBudget,
                         REGISTRY, check_program, collect_collectives,
                         summarize, check_a2a_pull_hlo)
 from .lint import LintViolation, host_fn, lint_paths, lint_source
+from .scope import (HISTOGRAMS, HistogramRegistry, Span,
+                    export_chrome_trace, span, step_span)
 
 _LAZY = {
     "retrace": ".retrace", "programs": ".programs",
@@ -52,7 +61,9 @@ def __getattr__(name):  # PEP 562: defer the jax-importing submodules
 
 
 __all__ = [
-    "concurrency", "contracts", "lint", "retrace", "programs",
+    "concurrency", "contracts", "lint", "retrace", "programs", "scope",
+    "HISTOGRAMS", "HistogramRegistry", "Span", "export_chrome_trace",
+    "span", "step_span",
     "ContractViolation", "ProgramContract", "OpBudget", "REGISTRY",
     "check_program", "collect_collectives", "summarize",
     "check_a2a_pull_hlo",

@@ -101,12 +101,17 @@ def collect_collectives(hlo_text: str) -> List[Tuple[str, int, int]]:
     return out
 
 
-def summarize(hlo_text: str) -> Dict[str, Tuple[int, int]]:
-    """op -> (count, total result bytes)."""
+def summarize(hlo_text: str, *,
+              largest: bool = False) -> Dict[str, Tuple[int, int]]:
+    """op -> (count, bytes). Default bytes sum every result buffer;
+    ``largest=True`` sums each instance's LARGEST single buffer instead —
+    the async-safe accounting (``-start`` tuples carry operand AND
+    result) shared by the contract byte bounds and the graftscope
+    ledger. One fold so the accounting rule lives in one place."""
     out: Dict[str, Tuple[int, int]] = {}
-    for op, b, _largest in collect_collectives(hlo_text):
+    for op, b, big in collect_collectives(hlo_text):
         c, t = out.get(op, (0, 0))
-        out[op] = (c + 1, t + b)
+        out[op] = (c + 1, t + (big if largest else b))
     return out
 
 

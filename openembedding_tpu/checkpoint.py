@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import serialization
 
+from .analysis import scope
 from .embedding import EmbeddingCollection
 from .meta import ModelMeta
 from . import hash_table as hash_lib
@@ -161,6 +162,21 @@ def save_checkpoint(path: str,
     serving library (``native/oe_serving.cc``) needs raw ``.npy`` — keep
     serving dumps uncompressed.
     """
+    with scope.span("checkpoint.save"):
+        return _save_checkpoint_impl(
+            path, collection, states, dense_state=dense_state,
+            include_optimizer=include_optimizer, model_sign=model_sign,
+            compress=compress)
+
+
+def _save_checkpoint_impl(path: str,
+                          collection: EmbeddingCollection,
+                          states: Dict[str, Any],
+                          *,
+                          dense_state: Any,
+                          include_optimizer: bool,
+                          model_sign: str,
+                          compress: str) -> None:
     from .utils import compress as compress_lib
     compress = compress_lib.check(compress)
     nproc = jax.process_count()
@@ -746,6 +762,18 @@ def load_checkpoint(path: str,
     (local row ``l`` holds global id ``l * G + k``); hash variables keep
     their keys verbatim and simply skip non-owned ones.
     """
+    with scope.span("checkpoint.load"):
+        return _load_checkpoint_impl(
+            path, collection, dense_state_template=dense_state_template,
+            rng=rng, shard_slice=shard_slice)
+
+
+def _load_checkpoint_impl(path: str,
+                          collection: EmbeddingCollection,
+                          *,
+                          dense_state_template: Any,
+                          rng: Optional[jax.Array],
+                          shard_slice: Optional[tuple]):
     meta = _check_meta(path, collection, shard_slice=shard_slice)
     with_opt = bool(meta.extra.get("include_optimizer", True))
     dump_meta = {v.name: v.meta for v in meta.variables}

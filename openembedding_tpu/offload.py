@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .analysis import scope
 from .analysis.concurrency import make_rlock, sync_point
 from .embedding import EmbeddingSpec
 from .meta import EmbeddingVariableMeta
@@ -574,26 +575,28 @@ class ShardedOffloadedTable:
         def _run():
             try:
                 sync_point("offload.writeback.run")
-                host = {k: np.asarray(jax.device_get(v))
-                        for k, v in arrays.items()}
-                keys = host["keys"]
-                # the jitted step auto-inserts whatever batch keys it sees;
-                # out-of-range ids must not index the vocab-sized host store
-                # (negative would alias a real row — silent corruption)
-                live = (keys != hash_lib.empty_key(keys.dtype)) \
-                    & (keys >= 0) & (keys < self.vocab)
-                ids = keys[live]
-                mask = np.zeros(self.vocab, bool)
-                mask[dirty_ids] = True
-                sel = mask[ids]
-                ids = ids[sel]
-                sync_point("offload.writeback.scatter")
-                if ids.size:
-                    self.host_weights[ids] = host["weights"][live][sel]
-                    for sname in self.host_slots:
-                        self.host_slots[sname][ids] = \
-                            host[f"slot_{sname}"][live][sel]
-                    self.host_work_id[ids] = work
+                with scope.span("offload.writeback", table=self.name):
+                    host = {k: np.asarray(jax.device_get(v))
+                            for k, v in arrays.items()}
+                    keys = host["keys"]
+                    # the jitted step auto-inserts whatever batch keys it
+                    # sees; out-of-range ids must not index the vocab-sized
+                    # host store (negative would alias a real row — silent
+                    # corruption)
+                    live = (keys != hash_lib.empty_key(keys.dtype)) \
+                        & (keys >= 0) & (keys < self.vocab)
+                    ids = keys[live]
+                    mask = np.zeros(self.vocab, bool)
+                    mask[dirty_ids] = True
+                    sel = mask[ids]
+                    ids = ids[sel]
+                    sync_point("offload.writeback.scatter")
+                    if ids.size:
+                        self.host_weights[ids] = host["weights"][live][sel]
+                        for sname in self.host_slots:
+                            self.host_slots[sname][ids] = \
+                                host[f"slot_{sname}"][live][sel]
+                        self.host_work_id[ids] = work
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 # _writer_err_dirty re-marks the rows AT THE JOIN (see
                 # __init__: the writer must not take _book itself)
@@ -907,44 +910,48 @@ class ShardedOffloadedTable:
         survivors, rebuild the cache with them (open-addressing tables
         never delete, so eviction = writeback + rebuild-from-host)."""
         sync_point("offload.evict")
-        self._join_writeback()
-        # eviction DISCARDS the cache (create_cache zeroes the cumulative
-        # insert_failures) — read the pending overflow evidence from the
-        # LIVE counter first (the _overflow_latest copy misses failures
-        # the jitted step accumulated after the last host-side insert),
-        # or an overflow between the last join point and this rebuild
-        # would vanish; eviction is already a synchronous join, so the
-        # device round trip costs nothing extra here
-        self.check_overflow(cache)
-        resident_ids = np.nonzero(self._resident)[0]
-        keep_target = max(0, min(int(self.keep_fraction * budget),
-                                 budget - incoming))
-        prot = np.zeros(self.vocab, bool)
-        prot[protect] = True
-        candidates = resident_ids[~prot[resident_ids]]
-        order = np.argsort(self._last_touch[candidates], kind="stable")
-        keep_protected = resident_ids[prot[resident_ids]]
-        n_keep = max(0, keep_target - keep_protected.size)
-        keep = np.concatenate([keep_protected, candidates[order][::-1][:n_keep]])
-        # writeback every dirty resident row (host becomes fully current),
-        # synchronously — the rebuild below must read current host rows
-        dirty_ids = resident_ids[self._dirty[resident_ids]]
-        self._start_writeback(cache, dirty_ids)
-        self._join_writeback()
-        cache = self.create_cache(jax.random.PRNGKey(int(self.work_id)))
-        self._resident[:] = False
-        self._resident_count = 0
-        # invalidate every in-flight prepare: their miss sets were computed
-        # against the residency this rebuild just dropped
-        self._gen += 1
-        self._planned[:] = False
-        self._planned_count = 0
-        self.evictions += 1
-        if keep.size:
-            cache = self._insert_from_host(cache, np.sort(keep))
-            self._resident[keep] = True
-            self._resident_count = int(keep.size)
-        return cache
+        with scope.span("offload.evict", table=self.name):
+            self._join_writeback()
+            # eviction DISCARDS the cache (create_cache zeroes the
+            # cumulative insert_failures) — read the pending overflow
+            # evidence from the LIVE counter first (the _overflow_latest
+            # copy misses failures the jitted step accumulated after the
+            # last host-side insert), or an overflow between the last
+            # join point and this rebuild would vanish; eviction is
+            # already a synchronous join, so the device round trip costs
+            # nothing extra here
+            self.check_overflow(cache)
+            resident_ids = np.nonzero(self._resident)[0]
+            keep_target = max(0, min(int(self.keep_fraction * budget),
+                                     budget - incoming))
+            prot = np.zeros(self.vocab, bool)
+            prot[protect] = True
+            candidates = resident_ids[~prot[resident_ids]]
+            order = np.argsort(self._last_touch[candidates], kind="stable")
+            keep_protected = resident_ids[prot[resident_ids]]
+            n_keep = max(0, keep_target - keep_protected.size)
+            keep = np.concatenate([keep_protected,
+                                   candidates[order][::-1][:n_keep]])
+            # writeback every dirty resident row (host becomes fully
+            # current), synchronously — the rebuild below must read
+            # current host rows
+            dirty_ids = resident_ids[self._dirty[resident_ids]]
+            self._start_writeback(cache, dirty_ids)
+            self._join_writeback()
+            cache = self.create_cache(jax.random.PRNGKey(int(self.work_id)))
+            self._resident[:] = False
+            self._resident_count = 0
+            # invalidate every in-flight prepare: their miss sets were
+            # computed against the residency this rebuild just dropped
+            self._gen += 1
+            self._planned[:] = False
+            self._planned_count = 0
+            self.evictions += 1
+            if keep.size:
+                cache = self._insert_from_host(cache, np.sort(keep))
+                self._resident[keep] = True
+                self._resident_count = int(keep.size)
+            return cache
 
     # --- step bookkeeping ---------------------------------------------------
     def note_update(self, ids, *, uniq: Optional[np.ndarray] = None) -> None:
@@ -977,14 +984,15 @@ class ShardedOffloadedTable:
         Raises any error a PREVIOUS async writeback stored, even when
         nothing is dirty now (the join below would otherwise be skipped
         and a dead writer's exception would sit unread until finish)."""
-        self._join_writeback()
-        self.check_overflow(cache)
-        sync_point("offload.flush")
-        with self._book:
-            dirty_ids = np.nonzero(self._dirty)[0]
-        if dirty_ids.size:
-            self._start_writeback(cache, dirty_ids)
-        return int(dirty_ids.size)
+        with scope.span("offload.flush", table=self.name):
+            self._join_writeback()
+            self.check_overflow(cache)
+            sync_point("offload.flush")
+            with self._book:
+                dirty_ids = np.nonzero(self._dirty)[0]
+            if dirty_ids.size:
+                self._start_writeback(cache, dirty_ids)
+            return int(dirty_ids.size)
 
     @property
     def should_persist(self) -> bool:
@@ -1038,22 +1046,25 @@ class ShardedOffloadedTable:
         self.persisted_work = self.work_id
         self._batches_since_persist = 0
         if blocking:
-            return _persist_store(
-                path, vocab=self.vocab, meta=self.meta, work_id=work,
-                persisted_work=persisted,
-                host_weights=self.host_weights, host_slots=self.host_slots,
-                host_work_id=self.host_work_id,
-                compress=self.persist_compress)
-
-        def _run():
-            try:
-                _persist_store(
+            with scope.span("offload.persist", table=self.name):
+                return _persist_store(
                     path, vocab=self.vocab, meta=self.meta, work_id=work,
                     persisted_work=persisted,
                     host_weights=self.host_weights,
                     host_slots=self.host_slots,
                     host_work_id=self.host_work_id,
                     compress=self.persist_compress)
+
+        def _run():
+            try:
+                with scope.span("offload.persist", table=self.name):
+                    _persist_store(
+                        path, vocab=self.vocab, meta=self.meta,
+                        work_id=work, persisted_work=persisted,
+                        host_weights=self.host_weights,
+                        host_slots=self.host_slots,
+                        host_work_id=self.host_work_id,
+                        compress=self.persist_compress)
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 self._persister_err = e
                 self.persisted_work = persisted

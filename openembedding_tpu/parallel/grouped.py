@@ -72,6 +72,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import hash_table as hash_lib
 from .. import table as table_lib
+from ..analysis import scope
 from ..ops import dedup
 from ..utils import observability
 from ..utils.jaxcompat import shard_map
@@ -615,10 +616,12 @@ def _record_group(plan: GroupPlan, idxs, itemsize: int) -> None:
     else:
         kc = 1
         n = sum(int(i.size) for i in idxs)
+    nbytes = n * (plan.bucket_dim * itemsize + kc * 4)
     observability.GLOBAL.add("grouped_groups", 1)
-    observability.GLOBAL.add(
-        "grouped_exchange_bytes",
-        n * (plan.bucket_dim * itemsize + kc * 4))
+    observability.GLOBAL.add("grouped_exchange_bytes", nbytes)
+    # distribution next to the sum: the histogram separates "one huge
+    # group" from "many small ones" — the sum alone cannot
+    scope.HISTOGRAMS.observe("grouped_exchange_bytes", float(nbytes))
 
 
 def pull_grouped(collection, states, idx_map: Dict[str, jnp.ndarray], *,

@@ -59,6 +59,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import hash_table as hash_lib
 from .. import table as table_lib
+from ..analysis import scope
 from ..analysis.lint import host_fn
 from ..utils.jaxcompat import shard_map
 from . import alltoall as a2a
@@ -602,13 +603,14 @@ class HotCacheManager:
     def refresh(self, state: CachedState) -> CachedState:
         """New CachedState with the current top-K admitted (table rows are
         authoritative, so no writeback happens — this is a pure re-gather)."""
-        self._since = 0
-        self.refreshes += 1
-        cand = self.sketch.topk(self.k)
-        if self._owns_sketch:
-            # a shared sketch decays once per window (at its owner's
-            # refresh), not once per sharing variable
-            self.sketch.decay()
-        cache = build_cache(state.table, cand, self.k, mesh=self.mesh,
-                            spec=self.spec)
-        return CachedState(table=state.table, cache=cache)
+        with scope.span("cache.refresh"):
+            self._since = 0
+            self.refreshes += 1
+            cand = self.sketch.topk(self.k)
+            if self._owns_sketch:
+                # a shared sketch decays once per window (at its owner's
+                # refresh), not once per sharing variable
+                self.sketch.decay()
+            cache = build_cache(state.table, cand, self.k, mesh=self.mesh,
+                                spec=self.spec)
+            return CachedState(table=state.table, cache=cache)

@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import scope
 from ..analysis.concurrency import make_lock, sync_point
 from ..embedding import EmbeddingCollection, EmbeddingSpec
 from ..meta import ModelMeta, ModelStatus, UNBOUNDED_VOCAB
@@ -165,9 +166,11 @@ class ServingModel:
                 from .. import hash_table as hash_lib
                 empty = hash_lib.empty_key(idx.dtype)
                 idx = jnp.where(idx % G == k, idx, empty)
-        rows = self.collection.pull(self.states, {name: idx},
-                                    batch_sharded=False, read_only=True,
-                                    serving_rows=as_rows)
+        with scope.span("serving.lookup", table=name):
+            rows = self.collection.pull(self.states, {name: idx},
+                                        batch_sharded=False,
+                                        read_only=True,
+                                        serving_rows=as_rows)
         return rows[name]
 
 
@@ -258,13 +261,15 @@ class ModelRegistry:
         def _load():
             try:
                 sync_point("registry.load.start")
-                specs = _specs_from_meta(meta, self.default_hash_capacity,
-                                         num_shards, shard_slice)
-                coll = EmbeddingCollection(specs, self.mesh)
-                states = ckpt_lib.load_checkpoint(model_uri, coll,
-                                                  shard_slice=shard_slice)
-                model = ServingModel(sign, coll, states, meta,
-                                     shard_slice=shard_slice)
+                with scope.span("registry.load", detail={"sign": sign}):
+                    specs = _specs_from_meta(meta,
+                                             self.default_hash_capacity,
+                                             num_shards, shard_slice)
+                    coll = EmbeddingCollection(specs, self.mesh)
+                    states = ckpt_lib.load_checkpoint(
+                        model_uri, coll, shard_slice=shard_slice)
+                    model = ServingModel(sign, coll, states, meta,
+                                         shard_slice=shard_slice)
                 sync_point("registry.load.commit")
                 with self._lock:
                     self._models[sign] = model

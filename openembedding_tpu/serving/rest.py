@@ -29,9 +29,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..analysis import scope
 from .registry import ModelRegistry
 
 DEFAULT_PORT = 8010
+
+
+def _route(path: str) -> str:
+    """Low-cardinality route label for request spans: the first path
+    segment (``/models/<sign>/lookup`` -> ``/models``) — per-sign labels
+    would explode the histogram registry on a long-lived server."""
+    seg = path.lstrip("/").split("?", 1)[0].split("/", 1)[0]
+    return "/" + seg if seg else "/"
 
 
 def probe_health(endpoint: str, timeout: float = 1.0):
@@ -86,6 +95,14 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
             return json.loads(self.rfile.read(n) or b"{}")
 
         def do_GET(self):
+            # graftscope request span: every verb/route pair feeds the
+            # span_http_seconds histogram exposed right back on /metrics
+            with scope.span("http", method="GET",
+                            route=_route(self.path),
+                            detail={"path": self.path}):
+                self._handle_GET()
+
+        def _handle_GET(self):
             try:
                 if self.path == "/health":
                     # liveness + model catalog: peers restore from this
@@ -177,6 +194,12 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 self._send(500, {"error": str(e)})
 
         def do_POST(self):
+            with scope.span("http", method="POST",
+                            route=_route(self.path),
+                            detail={"path": self.path}):
+                self._handle_POST()
+
+        def _handle_POST(self):
             try:
                 if self.path == "/models":
                     req = self._body()
@@ -246,6 +269,12 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 self._send(500, {"error": str(e)})
 
         def do_DELETE(self):
+            with scope.span("http", method="DELETE",
+                            route=_route(self.path),
+                            detail={"path": self.path}):
+                self._handle_DELETE()
+
+        def _handle_DELETE(self):
             try:
                 m = re.fullmatch(r"/models/([^/]+)", self.path)
                 if m:

@@ -30,8 +30,10 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .analysis import scope
 from .analysis.concurrency import sync_point
 from .analysis.retrace import RetraceGuard
+from .utils import observability
 from .embedding import EmbeddingCollection
 from .parallel.mesh import DATA_AXIS
 
@@ -138,6 +140,10 @@ class Trainer:
         # CHAINS on the previous one, so host_prepare calls run strictly
         # in batch order (the planned-residency bookkeeping requires it)
         self._preps: "deque" = deque()
+        # host-side step counter for graftscope step spans (the device
+        # state.step is a device array — reading it back per step would
+        # add a sync round trip to every step)
+        self._host_step = 0
 
     # --- initialization ----------------------------------------------------
     def _split_sparse(self, sparse: Dict[str, Any]):
@@ -233,14 +239,31 @@ class Trainer:
         """
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        state, uniqs = self._apply_prepared_offload(state, batch)
-        state, metrics = self._train_step(state, self.shard_batch(batch))
-        for name, table in self.offload.items():
-            table.note_update(batch["sparse"][name], uniq=uniqs.get(name))
-        state = self._note_hot_cache(state, batch)
-        if next_batch is not None and self.offload \
-                and not self._prep_started(next_batch):
-            self._start_host_prepare(next_batch)
+        # graftscope: one span per whole host-visible step, with
+        # StepTraceAnnotation pass-through so a concurrent jax.profiler
+        # device trace attributes its work to the same step numbers
+        try:
+            with scope.step_span(self._host_step):
+                # per-table batch-shape stats (pull_indices/pull_unique
+                # counters + pull_rows/unique_ratio/key_skew histograms);
+                # gated inside — a host np.unique per column, off by
+                # default like the reference's accumulators
+                observability.record_batch_stats(batch["sparse"])
+                state, uniqs = self._apply_prepared_offload(state, batch)
+                state, metrics = self._train_step(state,
+                                                  self.shard_batch(batch))
+                for name, table in self.offload.items():
+                    table.note_update(batch["sparse"][name],
+                                      uniq=uniqs.get(name))
+                state = self._note_hot_cache(state, batch)
+                if next_batch is not None and self.offload \
+                        and not self._prep_started(next_batch):
+                    self._start_host_prepare(next_batch)
+        finally:
+            # advance on ERROR exits too: a caller that catches and
+            # retries must not reuse the step number (duplicate ids in
+            # the trace + wrong device-profile attribution)
+            self._host_step += 1
         return state, metrics
 
     def _note_hot_cache(self, state: TrainState, batch) -> TrainState:
@@ -303,8 +326,9 @@ class Trainer:
             try:
                 sync_point("trainer.prep.run")
                 for name, table in self.offload.items():
-                    results[name] = table.host_prepare(
-                        batch["sparse"][name])
+                    with scope.span("lookahead.prepare", table=name):
+                        results[name] = table.host_prepare(
+                            batch["sparse"][name])
             except BaseException as e:  # noqa: BLE001 — re-raised at join
                 err.append(e)
 
@@ -392,7 +416,14 @@ class Trainer:
     def eval_step(self, state: TrainState, batch) -> jnp.ndarray:
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
-        return self._eval_step(state, self.shard_batch(batch))
+        if not observability.evaluate_performance():
+            # default path stays async — the span would otherwise need a
+            # block_until_ready, serializing the dispatch pipeline
+            return self._eval_step(state, self.shard_batch(batch))
+        with scope.span("eval"):
+            out = self._eval_step(state, self.shard_batch(batch))
+            jax.block_until_ready(out)
+            return out
 
     # --- helpers -------------------------------------------------------------
     def shard_batch(self, batch):

@@ -24,6 +24,9 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from ..analysis import scope
+from ..analysis.concurrency import make_lock, sync_point
+
 _EVALUATE_PERFORMANCE = False
 
 
@@ -100,8 +103,19 @@ def record_batch_stats(sparse: Dict[str, np.ndarray],
     acc = accumulator or GLOBAL
     for name, idx in sparse.items():
         arr = np.asarray(idx).ravel()
+        _uniq, counts = np.unique(arr, return_counts=True)
         acc.add("pull_indices", arr.size)
-        acc.add("pull_unique", np.unique(arr).size)
+        acc.add("pull_unique", _uniq.size)
+        if arr.size:
+            # per-table batch-shape distributions (graftscope histogram
+            # registry -> /metrics _bucket series): rows per batch, the
+            # dedup win, and key skew as the top-1 key's share
+            scope.HISTOGRAMS.observe("pull_rows", float(arr.size),
+                                     table=name)
+            scope.HISTOGRAMS.observe("pull_unique_ratio",
+                                     _uniq.size / arr.size, table=name)
+            scope.HISTOGRAMS.observe("pull_key_skew",
+                                     counts.max() / arr.size, table=name)
 
 
 def cache_stats(accumulator: Optional[Accumulator] = None
@@ -155,9 +169,21 @@ def plane_timed(verb: str, plane: str, enabled: bool, fn, *args):
         return fn(*args)
     import jax
     t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    GLOBAL.add_time(f"{verb}/{plane}", time.perf_counter() - t0)
+    try:
+        out = fn(*args)
+        jax.block_until_ready(out)
+    except BaseException as e:
+        # a raising dispatch still consumed its wall time — record the
+        # span with an error tag instead of dropping the sample (a plane
+        # that fails every Nth step must not look N/(N-1)x faster)
+        dt = time.perf_counter() - t0
+        GLOBAL.add_time(f"{verb}/{plane}", dt)
+        scope.record_span(verb, t0, dt, {"plane": plane},
+                          error=type(e).__name__)
+        raise
+    dt = time.perf_counter() - t0
+    GLOBAL.add_time(f"{verb}/{plane}", dt)
+    scope.record_span(verb, t0, dt, {"plane": plane})
     return out
 
 
@@ -208,13 +234,19 @@ def _prom_name(name: str) -> str:
 
 
 def prometheus_text(accumulator: Optional[Accumulator] = None,
-                    prefix: str = "oe") -> str:
+                    prefix: str = "oe",
+                    include_scope: bool = True) -> str:
     """Render the accumulator in Prometheus text exposition format.
 
     The serving controller exposes this at GET /metrics — parity with the
     reference PS daemon's prometheus exposer (entry/server.cc:32-36,
     --enable_metrics/--metrics_url). Counters become ``<prefix>_<name>_total``;
-    timers contribute ``_seconds_total`` and ``_calls_total`` pairs.
+    timers contribute ``_seconds_total`` and ``_calls_total`` pairs. Every
+    series carries ``# HELP``/``# TYPE`` headers and label values are
+    escaped, so a real Prometheus scraper parses the page (golden-tested
+    in ``tests/test_observability.py``). ``include_scope`` appends the
+    graftscope histogram registry as proper ``_bucket``/``_sum``/
+    ``_count`` series (span latencies, per-table pull distributions).
     """
     acc = accumulator or GLOBAL
     lines = []
@@ -223,24 +255,35 @@ def prometheus_text(accumulator: Optional[Accumulator] = None,
         base = f"{prefix}_{_prom_name(name)}"
         fields = snap[name]
         if "count" in fields:
+            lines.append(f"# HELP {base}_total accumulated count of "
+                         f"`{name}`")
             lines.append(f"# TYPE {base}_total counter")
             lines.append(f"{base}_total {fields['count']:.10g}")
         if "seconds" in fields:
+            lines.append(f"# HELP {base}_seconds_total accumulated "
+                         f"wall seconds of `{name}`")
             lines.append(f"# TYPE {base}_seconds_total counter")
             lines.append(f"{base}_seconds_total {fields['seconds']:.10g}")
+            lines.append(f"# HELP {base}_calls_total timed calls of "
+                         f"`{name}`")
             lines.append(f"# TYPE {base}_calls_total counter")
             lines.append(f"{base}_calls_total {fields['calls']}")
     # graftrace traced-lock counters (empty unless OE_REPORT_TRACE_LOCKS)
     for name, st in sorted(lock_stats().items()):
         base = f"{prefix}_lock_{_prom_name(name)}"
-        lines.append(f"# TYPE {base}_acquires_total counter")
-        lines.append(f"{base}_acquires_total {st['acquires']:.10g}")
-        lines.append(f"# TYPE {base}_contended_total counter")
-        lines.append(f"{base}_contended_total {st['contended']:.10g}")
-        lines.append(f"# TYPE {base}_wait_seconds_total counter")
-        lines.append(f"{base}_wait_seconds_total {st['wait_s']:.10g}")
-        lines.append(f"# TYPE {base}_hold_seconds_total counter")
-        lines.append(f"{base}_hold_seconds_total {st['hold_s']:.10g}")
+        for suffix, key, help_txt in (
+                ("acquires_total", "acquires", "lock acquisitions"),
+                ("contended_total", "contended",
+                 "acquisitions that found the lock held"),
+                ("wait_seconds_total", "wait_s",
+                 "seconds blocked acquiring"),
+                ("hold_seconds_total", "hold_s", "seconds held")):
+            lines.append(f"# HELP {base}_{suffix} {help_txt} of traced "
+                         f"lock `{name}`")
+            lines.append(f"# TYPE {base}_{suffix} counter")
+            lines.append(f"{base}_{suffix} {st[key]:.10g}")
+    if include_scope:
+        lines.extend(scope.HISTOGRAMS.prometheus_lines(prefix))
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -248,7 +291,11 @@ class Reporter:
     """Rank-0 periodic metrics printer (WorkerContext reporter thread).
 
     ``report_interval`` seconds between dumps; 0 disables (the reference's
-    server.report_interval default semantics)."""
+    server.report_interval default semantics). Thread discipline matches
+    the other host daemons (graftrace coverage): the shared tick counter
+    is guarded by a ``make_lock`` lock, the loop carries ``sync_point``
+    markers so the deterministic interleaving harness can park it, the
+    thread is named ``oe-reporter``, and ``stop()`` joins it."""
 
     def __init__(self, interval: float,
                  accumulator: Optional[Accumulator] = None,
@@ -256,21 +303,34 @@ class Reporter:
         self.interval = interval
         self.acc = accumulator or GLOBAL
         self.sink = sink
+        self._lock = make_lock("observability.reporter")
+        self._ticks = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "Reporter":
         if self.interval and self.interval > 0:
-            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="oe-reporter")
             self._thread.start()
         return self
 
     def _run(self):
         while not self._stop.wait(self.interval):
+            sync_point("reporter.tick")
             self.report()
+        sync_point("reporter.exit")
+
+    @property
+    def ticks(self) -> int:
+        """Reports emitted so far (reporter thread + direct calls)."""
+        with self._lock:
+            return self._ticks
 
     def report(self):
         snap = self.acc.snapshot()
+        with self._lock:
+            self._ticks += 1
         if snap:
             parts = []
             for name in sorted(snap):

@@ -3,7 +3,9 @@
 import time
 
 import numpy as np
+import pytest
 
+from openembedding_tpu.analysis import scope
 from openembedding_tpu.utils import observability as obs
 
 
@@ -57,6 +59,28 @@ def test_plane_timed_and_timings():
     obs.GLOBAL.reset()
 
 
+def test_plane_timed_records_span_on_error_and_reraises():
+    """Regression (ISSUE 6 satellite): a raising dispatch used to DROP
+    its timing entirely — it must record the span with an error tag and
+    re-raise."""
+    obs.GLOBAL.reset()
+    scope.HISTOGRAMS.reset()
+
+    def boom():
+        raise RuntimeError("dispatch died")
+
+    with pytest.raises(RuntimeError, match="dispatch died"):
+        obs.plane_timed("pull", "a2a", True, boom)
+    t = obs.plane_timings()
+    assert t["a2a"]["pull_calls"] == 1          # wall time not dropped
+    assert scope.HISTOGRAMS.count("span_pull_seconds", plane="a2a") == 1
+    lines = scope.HISTOGRAMS.prometheus_lines()
+    assert any("span_errors_total" in ln and 'kind="pull"' in ln
+               for ln in lines)
+    obs.GLOBAL.reset()
+    scope.HISTOGRAMS.reset()
+
+
 def test_plane_timed_skips_recording_under_trace():
     """Inside an outer jit the dispatch body runs once per COMPILE, so a
     wall-time record there would report trace time as a step figure —
@@ -84,6 +108,36 @@ def test_reporter_periodic():
     time.sleep(0.2)
     rep.stop()
     assert lines and "x[count=1]" in lines[0]
+    assert rep.ticks == len(lines)
+
+
+def test_reporter_interleaving_harness_coverage():
+    """The reporter daemon is schedulable like the other host threads:
+    PointGate parks it at ``reporter.tick`` BEFORE any report lands, and
+    opening the gate releases the (named) thread."""
+    import threading
+    from openembedding_tpu.analysis import concurrency
+
+    acc = obs.Accumulator()
+    acc.add("x", 1)
+    lines = []
+    gate = concurrency.PointGate(["reporter.tick"])
+    concurrency.install_schedule(gate)
+    rep = obs.Reporter(0.01, acc, sink=lines.append)
+    try:
+        rep.start()
+        assert gate.wait_arrival("reporter.tick", timeout=10)
+        assert rep.ticks == 0 and not lines      # parked pre-report
+        assert any(t.name == "oe-reporter"
+                   for t in threading.enumerate())
+        gate.open("reporter.tick")
+        deadline = time.time() + 10
+        while rep.ticks == 0 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        rep.stop()
+        concurrency.clear_schedule()
+    assert rep.ticks >= 1 and lines
 
 
 def test_streaming_auc_exact_cases():
@@ -111,6 +165,46 @@ def test_streaming_auc_exact_cases():
     auc4 = obs.StreamingAUC()
     auc4.update([1, 1], [0.5, 0.6])
     assert auc4.result() == 0.5
+
+
+def test_prometheus_text_golden():
+    """Golden exposition output: every series carries # HELP/# TYPE, the
+    graftscope histograms render as _bucket/_sum/_count, and label
+    values are escaped — the page must stay parseable by a real
+    Prometheus scraper (satellite: metric hygiene)."""
+    acc = obs.Accumulator()
+    acc.add("pull_indices", 512)
+    acc.add_time("train_step", 0.5)
+    scope.HISTOGRAMS.reset()
+    scope.HISTOGRAMS.observe("span_pull_seconds", 0.25, plane="a2a")
+    got = obs.prometheus_text(acc)
+    want = """\
+# HELP oe_pull_indices_total accumulated count of `pull_indices`
+# TYPE oe_pull_indices_total counter
+oe_pull_indices_total 512
+# HELP oe_train_step_seconds_total accumulated wall seconds of `train_step`
+# TYPE oe_train_step_seconds_total counter
+oe_train_step_seconds_total 0.5
+# HELP oe_train_step_calls_total timed calls of `train_step`
+# TYPE oe_train_step_calls_total counter
+oe_train_step_calls_total 1
+# HELP oe_span_pull_seconds graftscope histogram `span_pull_seconds` (log-spaced buckets)
+# TYPE oe_span_pull_seconds histogram
+oe_span_pull_seconds_bucket{plane="a2a",le="0.3162"} 1
+oe_span_pull_seconds_bucket{plane="a2a",le="+Inf"} 1
+oe_span_pull_seconds_sum{plane="a2a"} 0.25
+oe_span_pull_seconds_count{plane="a2a"} 1
+"""
+    assert got == want
+    # minimal scraper-side parse: every non-comment line is
+    # `name{labels} value` with a float value
+    for ln in got.strip().splitlines():
+        if ln.startswith("#"):
+            continue
+        name_part, value = ln.rsplit(" ", 1)
+        float(value)
+        assert name_part.startswith("oe_")
+    scope.HISTOGRAMS.reset()
 
 
 def test_prometheus_text_and_endpoint(devices8):
@@ -141,6 +235,17 @@ def test_prometheus_text_and_endpoint(devices8):
             assert r.headers["Content-Type"].startswith("text/plain")
             body = r.read().decode()
         assert "oe_pull_indices_total 512" in body
+        # the scrape itself ran under a request span — the SECOND scrape
+        # must expose the http latency histogram series
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            body2 = r.read().decode()
+        assert "# TYPE oe_span_http_seconds histogram" in body2
+        assert 'oe_span_http_seconds_bucket{method="GET",' \
+               'route="/metrics",le="+Inf"}' in body2
+        assert 'oe_span_http_seconds_count{method="GET",' \
+               'route="/metrics"}' in body2
     finally:
         srv.stop()
         obs.GLOBAL.reset()
+        scope.HISTOGRAMS.reset()
