@@ -1480,6 +1480,13 @@ def main(argv=None):
                         "and do not inherit it (the parent's few spans "
                         "are still written). Every bench entry can ship "
                         "its trace.")
+    p.add_argument("--trajectory", default="",
+                   help="append this invocation's throughput results as "
+                        "schema-versioned graftwatch records (git sha + "
+                        "hardware fingerprint + eps band) to this JSONL "
+                        "path — the same trajectory `python -m "
+                        "tools.graftwatch --gate` reads. In-process "
+                        "modes only, like --trace.")
     args = p.parse_args(argv)
     if args.profile:
         global PROFILE_DIR
@@ -1593,6 +1600,28 @@ def main(argv=None):
     else:
         names = [HEADLINE]
 
+    def _append_trajectory(results):
+        # graftwatch bench trajectory: best-effort conversion — only
+        # throughput entries carry the eps band the gate's noise model
+        # needs; a conversion failure must not fail the measurement
+        if not args.trajectory:
+            return
+        try:
+            from tools import graftwatch
+            fp, device = graftwatch.device_fingerprint()
+            n = 0
+            for r in results:
+                rec = graftwatch.record_from_bench(r, fingerprint=fp,
+                                                   device=device)
+                if rec is not None:
+                    graftwatch.append_record(args.trajectory, rec)
+                    n += 1
+            print(json.dumps({"trajectory": args.trajectory,
+                              "records_appended": n}), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"trajectory_error":
+                              f"{type(e).__name__}: {e}"}), flush=True)
+
     results = []
     for name in names:
         try:
@@ -1613,6 +1642,7 @@ def main(argv=None):
             print(json.dumps(r), flush=True)
     if not args.configs:
         print(json.dumps(results[0]))
+    _append_trajectory(results)
     _export_trace()
     # a failed config must fail the invocation — a driver/CI gating on the
     # exit status should not see a silent benchmark regression

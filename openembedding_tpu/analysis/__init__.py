@@ -22,6 +22,11 @@ Three enforcement layers, all mechanical (ISSUE 3):
   registry behind the ``/metrics`` ``_bucket``/``_sum``/``_count``
   series, and the expected-vs-measured collective-byte ledger (CLI
   ``python -m tools.graftscope``).
+* :mod:`.memwatch` — graftwatch (ISSUE 7): the per-plane compiled-
+  program MEMORY ledger (``memory_analysis`` argument/output/temp/alias
+  bytes via the jaxcompat shim) with the peak-temp-bytes contract, and
+  the substrate under the ``tools/graftwatch.py`` bench-trajectory
+  regression gate.
 
 Import discipline: ``contracts``, ``lint``, ``concurrency``, and
 ``scope`` are stdlib-only at import time and imported eagerly, so every
@@ -46,6 +51,7 @@ from .scope import (HISTOGRAMS, HistogramRegistry, Span,
 
 _LAZY = {
     "retrace": ".retrace", "programs": ".programs",
+    "memwatch": ".memwatch",
     "RetraceBudgetExceeded": ".retrace", "RetraceGuard": ".retrace",
 }
 
@@ -54,7 +60,7 @@ def __getattr__(name):  # PEP 562: defer the jax-importing submodules
     if name in _LAZY:
         import importlib
         mod = importlib.import_module(_LAZY[name], __name__)
-        if name in ("retrace", "programs"):
+        if name in ("retrace", "programs", "memwatch"):
             return mod
         return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
@@ -62,6 +68,7 @@ def __getattr__(name):  # PEP 562: defer the jax-importing submodules
 
 __all__ = [
     "concurrency", "contracts", "lint", "retrace", "programs", "scope",
+    "memwatch",
     "HISTOGRAMS", "HistogramRegistry", "Span", "export_chrome_trace",
     "span", "step_span",
     "ContractViolation", "ProgramContract", "OpBudget", "REGISTRY",

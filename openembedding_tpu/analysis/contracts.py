@@ -223,6 +223,66 @@ def check_no_host_transfers(hlo_text: str) -> None:
             "pipelining")
 
 
+# --- peak-temp-bytes audit (the memory-level copy check) ---------------------
+
+# calibrated against the shipped planes on the cpu8 mesh (graftwatch
+# memory ledger, vocab sized so a table shard dwarfs batch scratch):
+# batch scratch covers index widening / sort perms / routed buckets
+# (scales with the stream AND the shard count on the owner-dispatch
+# paths), the state term covers the one legitimate state materialization
+# a DECLINED donation forces (CPU never aliases; on TPU alias_bytes
+# covers the state and the term collapses)
+TEMP_FLOOR_BYTES = 1 << 18
+TEMP_BATCH_FACTOR = 2
+TEMP_STATE_SLACK = 1.1
+
+
+def peak_temp_bound(params: Mapping[str, int], program: str,
+                    alias_bytes: int = 0) -> int:
+    """Allowed compiled temp bytes for one plane program.
+
+    Pull programs are read-only: temp must stay batch-scale scratch. A
+    push/step program whose donation the backend declined legitimately
+    materializes the updated state once in temp — that is the
+    ``state_shard_bytes - alias_bytes`` term. Anything beyond is an
+    accidental extra materialization (a table-shard-sized gather or a
+    second state copy) — the memory-level twin of :func:`max_copy_bytes`.
+    Like that audit, detection power depends on the harness sizing the
+    table so one shard dwarfs batch scratch (``memwatch.AUDIT_VOCAB``).
+    """
+    bound = TEMP_FLOOR_BYTES + TEMP_BATCH_FACTOR \
+        * int(params["global_batch"]) * (int(params["dim"]) + 2) \
+        * int(params.get("itemsize", 4)) \
+        * int(params.get("num_shards", 1))
+    if program != "pull":
+        unaliased = max(0, int(params.get("state_shard_bytes", 0))
+                        - int(alias_bytes))
+        bound += int(TEMP_STATE_SLACK * unaliased)
+    return bound
+
+
+def check_peak_temp_bytes(mem: Mapping[str, int], params: Mapping[str, int],
+                          *, program: str, label: str = "") -> int:
+    """Audit one compiled program's ``memory_analysis`` temp bytes
+    against :func:`peak_temp_bound`; returns the bound. ``mem`` is the
+    normalized dict from ``utils.jaxcompat.compiled_memory_stats``.
+    Complements :func:`max_copy_bytes`: a materialization XLA performs
+    without an explicit ``copy`` op (fusion output buffers, gather
+    results) never shows in the HLO-text audit but always lands in
+    temp."""
+    temp = int(mem.get("temp_bytes", 0))
+    bound = peak_temp_bound(params, program,
+                            int(mem.get("alias_bytes", 0)))
+    if temp > bound:
+        raise ContractViolation(
+            f"{label or program}: compiled temp allocation of {temp} "
+            f"bytes > peak-temp bound {bound} (params {dict(params)}, "
+            f"alias_bytes={mem.get('alias_bytes', 0)}) — an accidental "
+            "table-shard-sized materialization (or a second state copy) "
+            "is live inside the program")
+    return bound
+
+
 # --- the per-plane registry --------------------------------------------------
 
 # A bound is a function of the program's static parameters. Every bound

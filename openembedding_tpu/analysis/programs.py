@@ -33,19 +33,37 @@ def _collection(mesh, plane: str, *, vocab: int, dim: int,
     return EmbeddingCollection((spec,), mesh)
 
 
-def contract_params(mesh, *, batch: int, dim: int,
-                    itemsize: int = 4) -> Dict[str, int]:
+def contract_params(mesh, *, batch: int, dim: int, itemsize: int = 4,
+                    vocab: Optional[int] = None,
+                    state_nbytes: Optional[int] = None) -> Dict[str, int]:
     from ..parallel.mesh import DATA_AXIS
     data = mesh.shape[DATA_AXIS]
-    return {"batch_slice": batch // data, "global_batch": batch,
-            "dim": dim, "itemsize": itemsize, "cache_k": CACHE_K,
-            "num_shards": mesh.size}
+    params = {"batch_slice": batch // data, "global_batch": batch,
+              "dim": dim, "itemsize": itemsize, "cache_k": CACHE_K,
+              "num_shards": mesh.size}
+    if vocab is not None:
+        # one table shard's WEIGHT bytes — the unit the memory-ledger
+        # peak-temp audit detects accidental materializations in
+        params["table_shard_bytes"] = vocab * dim * itemsize // mesh.size
+    if state_nbytes is not None:
+        # the whole state pytree's per-device share (weights + optimizer
+        # slots + hash keys); replicated leaves (cache replicas) make
+        # this a slight underestimate, absorbed by the audit's slack
+        params["state_shard_bytes"] = int(state_nbytes) // mesh.size
+    return params
 
 
-def lower_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
-               batch: int = 1024, use_hash: bool = False,
-               out_replicated: bool = False) -> Tuple[str, Dict[str, int]]:
-    """Compiled HLO of one plane's pull program on ``mesh``.
+def _state_nbytes(states) -> int:
+    import jax
+    return int(sum(x.nbytes for x in jax.tree.leaves(states)))
+
+
+def compile_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
+                 batch: int = 1024, use_hash: bool = False,
+                 out_replicated: bool = False):
+    """Compiled pull program + contract params — the object form, for
+    callers that also need ``memory_analysis()`` (graftwatch's memory
+    ledger); :func:`lower_pull` is the HLO-text view of the same build.
 
     ``out_replicated=True`` deliberately breaks the output sharding
     annotation (rows replicated instead of batch-sharded): XLA must then
@@ -69,13 +87,24 @@ def lower_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
     compiled = jax.jit(
         pull_fn, out_shardings=NamedSharding(mesh, out_spec)
     ).lower(states, idx).compile()
-    return compiled.as_text(), contract_params(mesh, batch=batch, dim=dim)
+    return compiled, contract_params(mesh, batch=batch, dim=dim,
+                                     vocab=vocab,
+                                     state_nbytes=_state_nbytes(states))
 
 
-def lower_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
-               batch: int = 1024,
-               use_hash: bool = False) -> Tuple[str, Dict[str, int]]:
-    """Compiled HLO of one plane's push (apply_gradients) program."""
+def lower_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
+               batch: int = 1024, use_hash: bool = False,
+               out_replicated: bool = False) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO text of one plane's pull program on ``mesh``."""
+    compiled, params = compile_pull(mesh, plane, vocab=vocab, dim=dim,
+                                    batch=batch, use_hash=use_hash,
+                                    out_replicated=out_replicated)
+    return compiled.as_text(), params
+
+
+def compile_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
+                 batch: int = 1024, use_hash: bool = False):
+    """Compiled push (apply_gradients) program + contract params."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -91,7 +120,18 @@ def lower_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
     idx = jax.device_put(jnp.zeros((batch,), jnp.int32), sh)
     grads = jax.device_put(jnp.zeros((batch, dim), jnp.float32), sh)
     compiled = jax.jit(push_fn).lower(states, idx, grads).compile()
-    return compiled.as_text(), contract_params(mesh, batch=batch, dim=dim)
+    return compiled, contract_params(mesh, batch=batch, dim=dim,
+                                     vocab=vocab,
+                                     state_nbytes=_state_nbytes(states))
+
+
+def lower_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
+               batch: int = 1024,
+               use_hash: bool = False) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO text of one plane's push program."""
+    compiled, params = compile_push(mesh, plane, vocab=vocab, dim=dim,
+                                    batch=batch, use_hash=use_hash)
+    return compiled.as_text(), params
 
 
 def _grouped_collection(mesh, *, tables: int, vocab: int, dim: int,
@@ -127,15 +167,35 @@ def count_exchange_a2a(mesh, program: str, *, vocab: int = 1 << 16,
 
 def grouped_params(mesh, coll, names, *, batch: int, dim: int,
                    program: str, a2a_ops: Optional[int] = None,
-                   itemsize: int = 4) -> Dict[str, int]:
+                   itemsize: int = 4,
+                   state_nbytes: Optional[int] = None,
+                   vocab: Optional[int] = None) -> Dict[str, int]:
     """Contract params for a grouped-plane program: the base params plus
     num_tables / num_groups (from the planner itself) / the padded bucket
-    dim / the per-exchange all-to-all count."""
+    dim / the per-exchange all-to-all count.
+
+    The per-exchange unit is counted from a SINGLE-TABLE a2a program at
+    the LARGEST group's concatenated stream size (``max group members *
+    batch`` — XLA's all-to-all decomposition depends on the exchanged
+    buffer size, so a unit counted at the per-table batch undercounts
+    once the concat stream crosses a split threshold: at batch 256 the
+    grouped pull compiles 8 all-to-alls where the 256-entry
+    single-table unit is 4). The widest group, not ``num_tables``: on a
+    multi-group plan the whole-collection stream size would inflate the
+    unit past what any one group exchanges, slackening the
+    ``num_groups * unit`` cap. Counting at the widest group's stream
+    calibrates the cap for ANY audited batch; a per-table-loop
+    regression still fails it (num_tables x per-table units always
+    exceeds one stream-sized unit set per group).
+    """
     from ..parallel import grouped
     plans = grouped.plan_groups(coll, tuple(names), read_only=True)
     if a2a_ops is None:
-        a2a_ops = count_exchange_a2a(mesh, program, batch=batch, dim=dim)
-    params = contract_params(mesh, batch=batch, dim=dim, itemsize=itemsize)
+        widest = max(len(p.members) for p in plans)
+        a2a_ops = count_exchange_a2a(mesh, program,
+                                     batch=batch * widest, dim=dim)
+    params = contract_params(mesh, batch=batch, dim=dim, itemsize=itemsize,
+                             vocab=vocab, state_nbytes=state_nbytes)
     params.update({
         "num_tables": len(names), "num_groups": len(plans),
         "dim_bucket": max(p.bucket_dim for p in plans),
@@ -143,15 +203,14 @@ def grouped_params(mesh, coll, names, *, batch: int, dim: int,
     return params
 
 
-def lower_grouped_pull(mesh, *, tables: int = 3, vocab: int = 1 << 14,
-                       dim: int = 16, batch: int = 1024,
-                       use_hash: bool = False,
-                       a2a_ops: Optional[int] = None,
-                       out_replicated: bool = False
-                       ) -> Tuple[str, Dict[str, int]]:
-    """Compiled HLO of the COLLECTION-level grouped pull over ``tables``
-    same-dim tables (one exchange group). ``out_replicated=True`` breaks
-    the output annotation like :func:`lower_pull` — the negative test."""
+def compile_grouped_pull(mesh, *, tables: int = 3, vocab: int = 1 << 14,
+                         dim: int = 16, batch: int = 1024,
+                         use_hash: bool = False,
+                         a2a_ops: Optional[int] = None,
+                         out_replicated: bool = False):
+    """Compiled COLLECTION-level grouped pull over ``tables`` same-dim
+    tables (one exchange group) + params. ``out_replicated=True`` breaks
+    the output annotation like :func:`compile_pull` — the negative test."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -171,17 +230,30 @@ def lower_grouped_pull(mesh, *, tables: int = 3, vocab: int = 1 << 14,
     compiled = jax.jit(
         pull_fn, out_shardings=NamedSharding(mesh, out_spec)
     ).lower(states, idxs).compile()
-    return compiled.as_text(), grouped_params(
+    return compiled, grouped_params(
         mesh, coll, names, batch=batch, dim=dim, program="pull",
-        a2a_ops=a2a_ops)
+        a2a_ops=a2a_ops, vocab=vocab,
+        state_nbytes=_state_nbytes(states))
 
 
-def lower_grouped_push(mesh, *, tables: int = 3, vocab: int = 1 << 14,
+def lower_grouped_pull(mesh, *, tables: int = 3, vocab: int = 1 << 14,
                        dim: int = 16, batch: int = 1024,
                        use_hash: bool = False,
-                       a2a_ops: Optional[int] = None
+                       a2a_ops: Optional[int] = None,
+                       out_replicated: bool = False
                        ) -> Tuple[str, Dict[str, int]]:
-    """Compiled HLO of the collection-level grouped push."""
+    """Compiled HLO text of the collection-level grouped pull."""
+    compiled, params = compile_grouped_pull(
+        mesh, tables=tables, vocab=vocab, dim=dim, batch=batch,
+        use_hash=use_hash, a2a_ops=a2a_ops, out_replicated=out_replicated)
+    return compiled.as_text(), params
+
+
+def compile_grouped_push(mesh, *, tables: int = 3, vocab: int = 1 << 14,
+                         dim: int = 16, batch: int = 1024,
+                         use_hash: bool = False,
+                         a2a_ops: Optional[int] = None):
+    """Compiled collection-level grouped push + params."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -200,16 +272,28 @@ def lower_grouped_push(mesh, *, tables: int = 3, vocab: int = 1 << 14,
     grads = {n: jax.device_put(jnp.zeros((batch, dim), jnp.float32), sh)
              for n in names}
     compiled = jax.jit(push_fn).lower(states, idxs, grads).compile()
-    return compiled.as_text(), grouped_params(
+    return compiled, grouped_params(
         mesh, coll, names, batch=batch, dim=dim, program="push",
-        a2a_ops=a2a_ops)
+        a2a_ops=a2a_ops, vocab=vocab,
+        state_nbytes=_state_nbytes(states))
 
 
-def lower_train_step(mesh, plane: str = "a2a", *, vocab: int = 4096,
-                     dim: int = 8, batch: int = 256,
-                     model: str = "deepfm"
-                     ) -> Tuple[str, Dict[str, int]]:
-    """Compiled HLO of the Trainer's whole jitted train step.
+def lower_grouped_push(mesh, *, tables: int = 3, vocab: int = 1 << 14,
+                       dim: int = 16, batch: int = 1024,
+                       use_hash: bool = False,
+                       a2a_ops: Optional[int] = None
+                       ) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO text of the collection-level grouped push."""
+    compiled, params = compile_grouped_push(
+        mesh, tables=tables, vocab=vocab, dim=dim, batch=batch,
+        use_hash=use_hash, a2a_ops=a2a_ops)
+    return compiled.as_text(), params
+
+
+def compile_train_step(mesh, plane: str = "a2a", *, vocab: int = 4096,
+                       dim: int = 8, batch: int = 256,
+                       model: str = "deepfm"):
+    """Compiled Trainer train-step program + contract params.
 
     The step contract audits cross-cutting properties: donation of the
     state pytree honored (tables updated in place), no f64, no host
@@ -243,4 +327,17 @@ def lower_train_step(mesh, plane: str = "a2a", *, vocab: int = 4096,
     step = trainer._build_train_step()
     compiled = step.lower(state,
                           trainer.shard_batch(batch_data)).compile()
-    return compiled.as_text(), contract_params(mesh, batch=batch, dim=dim)
+    return compiled, contract_params(mesh, batch=batch, dim=dim,
+                                     vocab=vocab,
+                                     state_nbytes=_state_nbytes(state))
+
+
+def lower_train_step(mesh, plane: str = "a2a", *, vocab: int = 4096,
+                     dim: int = 8, batch: int = 256,
+                     model: str = "deepfm"
+                     ) -> Tuple[str, Dict[str, int]]:
+    """Compiled HLO text of the Trainer's whole jitted train step."""
+    compiled, params = compile_train_step(mesh, plane, vocab=vocab,
+                                          dim=dim, batch=batch,
+                                          model=model)
+    return compiled.as_text(), params

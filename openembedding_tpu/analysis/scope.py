@@ -199,6 +199,25 @@ def reset() -> None:
         _retired_total = 0
 
 
+# nominal bytes per buffered span event (7-tuple + small label dict):
+# an estimate for the memory gauges, not an exact accounting — the
+# rings are bounded (RING_CAPACITY) so the estimate's error is too
+EVENT_NOMINAL_BYTES = 160
+
+
+def ring_stats() -> Dict[str, int]:
+    """Live span-ring memory gauges for ``observability.memory_stats``:
+    buffered event count (live rings + the retired deque), events
+    dropped by ring wrap/retirement eviction, and the approximate bytes
+    those buffers hold."""
+    with _REG_LOCK:
+        events = sum(len(r.buf) for r in _RINGS) + len(_RETIRED)
+        dropped = sum(r.dropped for r in _RINGS) \
+            + (_retired_total - len(_RETIRED))
+    return {"events": events, "dropped": max(0, dropped),
+            "approx_bytes": events * EVENT_NOMINAL_BYTES}
+
+
 # ---------------------------------------------------------------------------
 # histogram registry
 # ---------------------------------------------------------------------------
@@ -530,6 +549,9 @@ class ExpectedBytes:
     total: int                         # sum of per-op largest buffers
     per_op: Mapping[str, Tuple[int, int]]   # op -> (count, bytes)
     params: Mapping[str, int]
+    # compiled memory ledger (graftwatch: jaxcompat.compiled_memory_stats
+    # of the SAME program) — None when the backend exposes no analysis
+    memory: Optional[Mapping[str, int]] = None
 
 
 def expected_collective_bytes(hlo_text: str
@@ -555,27 +577,32 @@ def plane_expected_bytes(mesh, plane: str, program: str, *,
     ledger's expected bytes provably sit inside the bounds
     ``contracts.py`` enforces."""
     from . import contracts, programs
+    from ..utils import jaxcompat
     if plane == "a2a+grouped":
-        lower = (programs.lower_grouped_pull if program == "pull"
-                 else programs.lower_grouped_push)
-        txt, params = lower(mesh, tables=tables, batch=batch, dim=dim,
-                            use_hash=use_hash)
+        build = (programs.compile_grouped_pull if program == "pull"
+                 else programs.compile_grouped_push)
+        compiled, params = build(mesh, tables=tables, batch=batch,
+                                 dim=dim, use_hash=use_hash)
     else:
-        lower = (programs.lower_pull if program == "pull"
-                 else programs.lower_push)
-        txt, params = lower(mesh, plane, batch=batch, dim=dim,
-                            use_hash=use_hash)
+        build = (programs.compile_pull if program == "pull"
+                 else programs.compile_push)
+        compiled, params = build(mesh, plane, batch=batch, dim=dim,
+                                 use_hash=use_hash)
+    txt = compiled.as_text()
     if check:
         contracts.check_program(txt, plane, program, **params)
     total, per_op = expected_collective_bytes(txt)
     return ExpectedBytes(plane=plane, program=program, total=total,
-                         per_op=per_op, params=params)
+                         per_op=per_op, params=params,
+                         memory=jaxcompat.compiled_memory_stats(compiled))
 
 
 def ledger_rows(expected: List[ExpectedBytes]) -> List[Dict[str, Any]]:
     """Join expected bytes with the measured pull/push span histograms
     (``span_pull_seconds{plane=...}`` etc.): per row calls, p50/p95
-    latency, expected bytes, and achieved GB/s at the p50."""
+    latency, expected collective bytes, achieved GB/s at the p50, and
+    the program's expected per-device HBM peak (graftwatch memory
+    ledger; None when the backend exposes no memory analysis)."""
     rows = []
     for e in expected:
         name = _hist_name(e.program)
@@ -587,18 +614,24 @@ def ledger_rows(expected: List[ExpectedBytes]) -> List[Dict[str, Any]]:
         rows.append({"plane": e.plane, "stage": e.program,
                      "calls": calls, "p50_ms": p50 * 1e3,
                      "p95_ms": p95 * 1e3, "expected_bytes": e.total,
-                     "per_op": dict(e.per_op), "gbps_p50": gbps})
+                     "per_op": dict(e.per_op), "gbps_p50": gbps,
+                     "hbm_peak_bytes": (e.memory or {}).get("peak_bytes"),
+                     "temp_bytes": (e.memory or {}).get("temp_bytes")})
     return rows
 
 
 def format_ledger(rows: List[Dict[str, Any]]) -> str:
     """Fixed-width per-plane/per-stage table for terminals and logs."""
     head = (f"{'plane':<14}{'stage':<7}{'calls':>6}{'p50_ms':>10}"
-            f"{'p95_ms':>10}{'expected_B':>12}{'GB/s@p50':>10}")
+            f"{'p95_ms':>10}{'expected_B':>12}{'GB/s@p50':>10}"
+            f"{'HBM_MiB':>9}")
     out = [head, "-" * len(head)]
     for r in rows:
+        peak = r.get("hbm_peak_bytes")
+        hbm = f"{peak / (1 << 20):.2f}" if peak is not None else "n/a"
         out.append(
             f"{r['plane']:<14}{r['stage']:<7}{r['calls']:>6}"
             f"{r['p50_ms']:>10.3f}{r['p95_ms']:>10.3f}"
-            f"{r['expected_bytes']:>12}{r['gbps_p50']:>10.4f}")
+            f"{r['expected_bytes']:>12}{r['gbps_p50']:>10.4f}"
+            f"{hbm:>9}")
     return "\n".join(out)

@@ -192,7 +192,7 @@ class EmbeddingCollection:
         return hot_cache.HotCacheManager(
             mesh=self.mesh, spec=sspec, k=sspec.cache_k,
             refresh_every=spec.cache_refresh_every,
-            decay=spec.cache_decay)
+            decay=spec.cache_decay, name=name)
 
     def model_meta(self, model_sign: str = "", model_uri: str = "") -> ModelMeta:
         variables = [

@@ -517,6 +517,33 @@ class ShardedOffloadedTable:
         # points (every device read is a synchronous round trip — tens
         # to ~105 ms over a tunneled link, see check_overflow)
         self._overflow_latest = None
+        from .utils import observability
+        observability.register_memory_source("offload", name, self)
+
+    def memory_stats(self) -> Dict[str, float]:
+        """Host-memory ledger gauges (``observability.memory_stats``):
+        store bytes (weights + slots + work ids; a disk-backed memmap
+        store is flagged, its pages are OS-evictable rather than
+        resident), residency-book bytes, and the live row counters. Row
+        counters read under ``_book``; the vocab-sized dirty scan is
+        deliberately NOT performed (O(GB) at north-star vocab)."""
+        store = self.host_weights.nbytes + self.host_work_id.nbytes \
+            + sum(a.nbytes for a in self.host_slots.values())
+        book = self._resident.nbytes + self._planned.nbytes \
+            + self._dirty.nbytes + self._last_touch.nbytes
+        with self._book:
+            resident = self._resident_count
+            planned = self._planned_count
+            evictions = self.evictions
+        return {
+            "store_bytes": float(store),
+            "store_memmap": float(isinstance(self.host_weights, np.memmap)),
+            "book_bytes": float(book),
+            "resident_rows": float(resident),
+            "planned_rows": float(planned),
+            "cache_capacity_rows": float(self.cache_capacity),
+            "evictions": float(evictions),
+        }
 
     # --- spec / state creation ---------------------------------------------
     def embedding_spec(self, **kw) -> EmbeddingSpec:

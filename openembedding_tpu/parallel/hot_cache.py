@@ -450,6 +450,18 @@ class FreqSketch:
             return int(np.count_nonzero(self._dense))
         return len(self._counts)
 
+    # measured CPython cost of one dict entry (int key + float value +
+    # table slot share) — an estimate for the memory gauges
+    DICT_ENTRY_NOMINAL_BYTES = 100
+
+    def approx_bytes(self) -> int:
+        """Approximate host RAM this sketch holds (graftwatch memory
+        ledger): exact for the dense backing, nominal-per-entry for the
+        dict one."""
+        if self._dense is not None:
+            return int(self._dense.nbytes)
+        return len(self._counts) * self.DICT_ENTRY_NOMINAL_BYTES
+
     # per-batch sample cap: scatter-adding every entry of a 4096x26 batch
     # costs ~7 ms of host time per step (np.add.at), which would out-bill
     # a ~1.5 ms device step; a uniform stride sample preserves frequency
@@ -539,10 +551,12 @@ class HotCacheManager:
     """
 
     def __init__(self, *, mesh: Mesh, spec, k: int = DEFAULT_CACHE_K,
-                 refresh_every: int = 64, decay: float = 0.8):
+                 refresh_every: int = 64, decay: float = 0.8,
+                 name: str = ""):
         from . import sharded_hash as sh  # late: avoids a module cycle
         self.mesh = mesh
         self.spec = spec
+        self.name = name
         self.k = int(k)
         self.refresh_every = max(1, int(refresh_every))
         self._is_hash = isinstance(spec, sh.HashShardingSpec)
@@ -555,6 +569,25 @@ class HotCacheManager:
         self._owns_sketch = True
         self._since = 0
         self.refreshes = 0
+        # per-device bytes of the replica this manager last BUILT (the
+        # CachedState itself lives in the training state; the manager
+        # accounts what it created) — graftwatch memory ledger
+        self.last_replica_bytes = 0
+        from ..utils import observability
+        observability.register_memory_source("hot_cache", name or "cache",
+                                             self)
+
+    def memory_stats(self) -> Dict[str, float]:
+        """Host+replica memory gauges (``observability.memory_stats``):
+        the admission sketch's host RAM and the per-device byte size of
+        the replica built at the last refresh (keys + rows + optimizer
+        slots, replicated on every device)."""
+        return {
+            "replica_bytes": float(self.last_replica_bytes),
+            "sketch_bytes": float(self.sketch.approx_bytes()),
+            "sketch_keys": float(len(self.sketch)),
+            "refreshes": float(self.refreshes),
+        }
 
     def share_sketch(self, other: "HotCacheManager") -> None:
         """Reuse ``other``'s frequency sketch: twin variables fed by the
@@ -613,4 +646,7 @@ class HotCacheManager:
                 self.sketch.decay()
             cache = build_cache(state.table, cand, self.k, mesh=self.mesh,
                                 spec=self.spec)
+            self.last_replica_bytes = int(
+                cache.keys.nbytes + cache.rows.nbytes
+                + sum(v.nbytes for v in cache.slots.values()))
             return CachedState(table=state.table, cache=cache)

@@ -226,6 +226,23 @@ class ModelRegistry:
         # by close() so shutdown quiesces instead of relying on daemon
         # teardown killing a loader mid-commit
         self._loaders: Dict[str, threading.Thread] = {}
+        from ..utils import observability
+        observability.register_memory_source("serving", "registry", self)
+
+    def memory_stats(self) -> Dict[str, float]:
+        """Loaded-model memory gauges (``observability.memory_stats``):
+        NORMAL-status model count and the summed byte size of their
+        state leaves (tables + hash keys; read-only serving carries no
+        optimizer slots)."""
+        import jax as _jax
+        with self._lock:
+            models = list(self._models.values())
+        total = 0
+        for m in models:
+            total += sum(int(x.nbytes)
+                         for x in _jax.tree.leaves(m.states))
+        return {"loaded_models": float(len(models)),
+                "model_bytes": float(total)}
 
     # --- lifecycle (ModelController.create/delete/show equivalents) -------
     def create_model(self, model_uri: str, *, model_sign: Optional[str] = None,

@@ -34,6 +34,50 @@ except AttributeError:  # <= 0.4.x: experimental module, same signature
     from jax.experimental import enable_x64
 
 
+def compiled_memory_stats(compiled):
+    """Normalized ``compiled.memory_analysis()`` as a plain dict, or None.
+
+    The underlying object moved between jaxlib releases
+    (``CompiledMemoryStats`` attributes ``*_size_in_bytes`` on 0.4.x,
+    occasionally absent or None per backend), so every caller routes
+    through this shim: the keys below are stable, missing fields read 0,
+    and a backend without the analysis yields None instead of raising.
+
+    Keys: ``argument_bytes``, ``output_bytes``, ``temp_bytes``,
+    ``alias_bytes``, ``generated_code_bytes``, plus the derived
+    ``peak_bytes`` (= argument + output + temp - alias, the standard
+    per-device live-memory estimate for one program invocation).
+    """
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — unimplemented per backend
+        return None
+    if stats is None:
+        return None
+
+    def _pick(*names) -> int:
+        for n in names:
+            v = getattr(stats, n, None)
+            if v is None and isinstance(stats, dict):
+                v = stats.get(n)
+            if v is not None:
+                return int(v)
+        return 0
+
+    out = {
+        "argument_bytes": _pick("argument_size_in_bytes", "argument_size"),
+        "output_bytes": _pick("output_size_in_bytes", "output_size"),
+        "temp_bytes": _pick("temp_size_in_bytes", "temp_size"),
+        "alias_bytes": _pick("alias_size_in_bytes", "alias_size"),
+        "generated_code_bytes": _pick("generated_code_size_in_bytes",
+                                      "generated_code_size"),
+    }
+    out["peak_bytes"] = max(
+        0, out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        - out["alias_bytes"])
+    return out
+
+
 def set_num_cpu_devices(n: int) -> None:
     """Request ``n`` virtual CPU devices BEFORE the backend initializes.
 

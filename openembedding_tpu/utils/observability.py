@@ -20,7 +20,8 @@ import collections
 import contextlib
 import threading
 import time
-from typing import Callable, Dict, Optional
+import weakref
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -228,6 +229,69 @@ def potential_deadlocks() -> list:
     return concurrency.potential_deadlocks()
 
 
+# --- host-memory ledger (graftwatch) -----------------------------------------
+
+# live memory sources, keyed by object id -> (kind, name, weakref):
+# offload tables, hot-cache managers, and the serving registry register
+# themselves at construction; dead objects fall out via the weakref
+# (pruned lazily at each snapshot), so accounting never extends an
+# object's lifetime
+_MEM_LOCK = make_lock("observability.memsources")
+_MEM_SOURCES: Dict[int, Tuple[str, str, Any]] = {}
+
+
+def register_memory_source(kind: str, name: str, obj) -> None:
+    """Track ``obj`` in the host-memory ledger (``memory_stats``).
+
+    ``obj`` must expose ``memory_stats() -> Dict[str, float]`` of byte/
+    count gauges. Registration is weak: the ledger observes, it never
+    keeps anything alive.
+    """
+    ref = weakref.ref(obj)
+    with _MEM_LOCK:
+        _MEM_SOURCES[id(obj)] = (str(kind), str(name), ref)
+
+
+def memory_stats() -> Dict[str, Dict[str, float]]:
+    """Live host-memory ledger: ``{source: {gauge: value}}``.
+
+    Covers the host RAM the framework holds outside device buffers —
+    offload stores + residency books, hot-cache replicas and admission
+    sketches, registry-loaded serving models — plus the graftscope span
+    rings. Sources are ``"<kind>/<name>"`` keys (duplicate names get a
+    ``#n`` suffix); every value is a float gauge, exported as
+    ``oe_mem_*`` on the serving ``/metrics`` page.
+    """
+    out: Dict[str, Dict[str, float]] = {
+        "scope/rings": {k: float(v) for k, v in scope.ring_stats().items()}
+    }
+    with _MEM_LOCK:
+        items = list(_MEM_SOURCES.items())
+    dead = []
+    for key, (kind, name, ref) in items:
+        obj = ref()
+        if obj is None:
+            dead.append(key)
+            continue
+        try:
+            st = obj.memory_stats()
+        except Exception:  # noqa: BLE001 — the ledger observes a LIVE
+            # system; a source racing its own teardown must read as
+            # absent, never crash a /metrics scrape
+            continue
+        label = f"{kind}/{name}"
+        n = 2
+        while label in out:
+            label = f"{kind}/{name}#{n}"
+            n += 1
+        out[label] = {str(k): float(v) for k, v in st.items()}
+    if dead:
+        with _MEM_LOCK:
+            for key in dead:
+                _MEM_SOURCES.pop(key, None)
+    return out
+
+
 def _prom_name(name: str) -> str:
     out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
     return out.lstrip("0123456789_") or "metric"
@@ -235,7 +299,8 @@ def _prom_name(name: str) -> str:
 
 def prometheus_text(accumulator: Optional[Accumulator] = None,
                     prefix: str = "oe",
-                    include_scope: bool = True) -> str:
+                    include_scope: bool = True,
+                    include_mem: bool = True) -> str:
     """Render the accumulator in Prometheus text exposition format.
 
     The serving controller exposes this at GET /metrics — parity with the
@@ -246,7 +311,11 @@ def prometheus_text(accumulator: Optional[Accumulator] = None,
     escaped, so a real Prometheus scraper parses the page (golden-tested
     in ``tests/test_observability.py``). ``include_scope`` appends the
     graftscope histogram registry as proper ``_bucket``/``_sum``/
-    ``_count`` series (span latencies, per-table pull distributions).
+    ``_count`` series (span latencies, per-table pull distributions);
+    ``include_mem`` appends the graftwatch host-memory ledger
+    (:func:`memory_stats`) as ``<prefix>_mem_<gauge>{source="..."}``
+    gauges — offload stores/books, hot-cache replicas + sketches,
+    loaded serving models, span rings.
     """
     acc = accumulator or GLOBAL
     lines = []
@@ -284,6 +353,23 @@ def prometheus_text(accumulator: Optional[Accumulator] = None,
             lines.append(f"{base}_{suffix} {st[key]:.10g}")
     if include_scope:
         lines.extend(scope.HISTOGRAMS.prometheus_lines(prefix))
+    if include_mem:
+        # graftwatch host-memory ledger: one gauge per (source, field);
+        # HELP/TYPE emitted once per gauge name like the series above
+        mem = memory_stats()
+        by_field: Dict[str, list] = {}
+        for source in sorted(mem):
+            for field in sorted(mem[source]):
+                by_field.setdefault(field, []).append(
+                    (source, mem[source][field]))
+        for field in sorted(by_field):
+            base = f"{prefix}_mem_{_prom_name(field)}"
+            lines.append(f"# HELP {base} graftwatch host-memory ledger "
+                         f"gauge `{field}` (labeled by source)")
+            lines.append(f"# TYPE {base} gauge")
+            for source, value in by_field[field]:
+                esc = source.replace("\\", "\\\\").replace('"', '\\"')
+                lines.append(f'{base}{{source="{esc}"}} {value:.10g}')
     return "\n".join(lines) + ("\n" if lines else "")
 
 

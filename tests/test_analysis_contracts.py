@@ -71,6 +71,52 @@ def test_grouped_push_contract(devices8):
     assert summary["all-to-all"][0] == push_ops
 
 
+def test_grouped_exchange_unit_counted_at_stream_size(devices8,
+                                                      monkeypatch):
+    """Calibration fix (ISSUE 7 satellite): the launch-count cap's
+    per-exchange unit must be counted at the group's CONCATENATED
+    stream size (num_tables * batch), not the per-table batch — XLA's
+    all-to-all split count depends on the exchanged buffer size, so the
+    per-table unit undercounts below the split threshold (batch 256
+    compiled 8 grouped ops against a cap of 4, forcing CI to pin batch
+    512). graftcheck/graftscope at batch 256 cover the compiled end;
+    this pins the counting rule itself."""
+    mesh = create_mesh(2, 4, devices8)
+    asked = []
+
+    def fake_count(mesh, program, **kw):
+        asked.append(kw["batch"])
+        return 8
+
+    monkeypatch.setattr(programs, "count_exchange_a2a", fake_count)
+    coll = programs._grouped_collection(mesh, tables=3, vocab=1 << 14,
+                                        dim=16, use_hash=False)
+    params = programs.grouped_params(mesh, coll, tuple(coll.specs),
+                                     batch=256, dim=16, program="pull")
+    assert asked == [3 * 256]
+    assert params["a2a_ops_per_exchange"] == 8
+    # an explicit a2a_ops skips the count entirely (test/CLI callers)
+    asked.clear()
+    programs.grouped_params(mesh, coll, tuple(coll.specs), batch=256,
+                            dim=16, program="pull", a2a_ops=4)
+    assert asked == []
+    # MULTI-group plan: the unit counts at the WIDEST group's stream,
+    # not the whole collection's — num_tables * batch would inflate the
+    # unit past what any one group exchanges and slacken the cap
+    from openembedding_tpu.embedding import (EmbeddingCollection,
+                                             EmbeddingSpec)
+    specs = tuple(
+        EmbeddingSpec(name=f"m{i}", input_dim=(1 << 14) + 64 * i,
+                      output_dim=dim, plane="a2a+grouped")
+        for i, dim in enumerate((16, 16, 16, 64)))
+    multi = EmbeddingCollection(specs, mesh)
+    asked.clear()
+    params = programs.grouped_params(mesh, multi, tuple(multi.specs),
+                                     batch=256, dim=16, program="pull")
+    assert params["num_groups"] == 2 and params["num_tables"] == 4
+    assert asked == [3 * 256]           # widest group has 3 members
+
+
 def test_grouped_broken_annotation_caught(devices8):
     """Replicating the grouped pull output re-gathers each table's rows
     in a separate buffer — each below the single-buffer bound, so the

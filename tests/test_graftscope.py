@@ -199,11 +199,19 @@ def test_expected_bytes_matches_contracts(devices8):
     assert "all-to-all" in e.per_op          # the owner exchange
     count, nbytes = e.per_op["all-to-all"]
     assert count >= 1 and nbytes > 0
+    # the memory ledger rides along: same compiled program, per-device
+    # argument/temp/peak bytes (ISSUE 7 satellite — the graftscope table
+    # shows latency, bytes, and memory in one place)
+    assert e.memory is not None
+    assert e.memory["argument_bytes"] > 0
+    assert e.memory["peak_bytes"] >= e.memory["argument_bytes"]
     rows = scope.ledger_rows([e])
     assert rows[0]["expected_bytes"] == e.total
     assert rows[0]["calls"] == 0             # nothing measured yet
+    assert rows[0]["hbm_peak_bytes"] == e.memory["peak_bytes"]
     table = scope.format_ledger(rows)
     assert "a2a" in table and "pull" in table
+    assert "HBM_MiB" in table and "n/a" not in table
 
 
 @pytest.mark.slow
@@ -212,9 +220,10 @@ def test_graftscope_cli_smoke(tmp_path):
     registered plane, traced train run, valid trace JSON, exit 0."""
     from tools import graftscope
     out = tmp_path / "trace.json"
-    # batch 512, not smaller: the grouped plane's empirical
-    # per-exchange op count is calibrated at graftcheck's batch size
-    rc = graftscope.main(["--steps", "2", "--batch", "512", "--dim", "8",
+    # batch 256 — BELOW the old 512 pin: the grouped launch-count unit
+    # is now counted at the audited stream size, so any batch audits
+    # clean (ISSUE 7 satellite dropped the CI pin)
+    rc = graftscope.main(["--steps", "2", "--batch", "256", "--dim", "8",
                           "--mesh", "2x4", "--plane", "a2a+grouped",
                           "--out", str(out)])
     assert rc == 0

@@ -1,0 +1,212 @@
+"""graftwatch trajectory schema + regression gate (tools/graftwatch.py).
+
+Pure-host lanes (no lowering): the run-record schema validator, the
+bench-history backfill audit (every entry of ``bench_suite.json`` and
+the ``BENCH_r0*.json`` attempt logs is schema-valid or explicitly
+grandfathered with its missing fields listed — ISSUE 7 satellite), and
+the rolling-baseline gate — including the acceptance-criterion negative
+test: an injected synthetic 2x-slower record exits nonzero.
+"""
+
+import json
+
+import pytest
+
+from tools import graftwatch as gw
+
+_FP = "cpu8-test-c2"
+_DEV = {"platform": "cpu", "n_devices": 8, "device_kind": "cpu"}
+
+
+def _record(ts: str, eps: float, p50_ms: float = 1.0):
+    return gw.make_record(
+        plane="a2a",
+        config={"mesh": "2x4", "batch": 256, "dim": 8, "steps": 4,
+                "blocks": 3, "source": "graftwatch-quick"},
+        eps=eps, eps_min=eps * 0.95, eps_max=eps * 1.05,
+        scope={stage: {"calls": 12, "p50_ms": p50_ms,
+                       "p95_ms": p50_ms * 1.3, "expected_bytes": 4096,
+                       "gbps_p50": 0.1} for stage in ("pull", "push")},
+        memory={"pull": {"argument_bytes": 1 << 20, "output_bytes": 1024,
+                         "temp_bytes": 2048, "alias_bytes": 0,
+                         "generated_code_bytes": 0,
+                         "peak_bytes": (1 << 20) + 3072},
+                "push": None},
+        fingerprint=_FP, device=_DEV, ts=ts)
+
+
+# --- schema ------------------------------------------------------------------
+
+def test_record_schema_roundtrip():
+    rec = _record("2026-08-01T00:00:00+00:00", 1000.0)
+    assert gw.validate_record(rec) == []
+    # provenance fields are live (sha + versions resolved at build time)
+    assert rec["schema_version"] == gw.SCHEMA_VERSION
+    assert rec["git_sha"] and rec["jax"] and rec["jaxlib"]
+    # survives a JSON roundtrip (the JSONL on-disk form)
+    assert gw.validate_record(json.loads(json.dumps(rec))) == []
+
+
+@pytest.mark.parametrize("mutate,fragment", [
+    (lambda r: r.pop("git_sha"), "git_sha"),
+    (lambda r: r.pop("fingerprint"), "fingerprint"),
+    (lambda r: r.update(schema_version=99), "schema_version"),
+    (lambda r: r.update(eps=-1.0), "eps"),
+    (lambda r: r.update(eps=True), "eps"),
+    (lambda r: r.update(eps_min=r["eps_max"] * 2), "band"),
+    (lambda r: r.update(device={"platform": "cpu"}), "n_devices"),
+    (lambda r: r["scope"]["pull"].pop("p50_ms"), "p50_ms"),
+])
+def test_record_schema_lists_each_problem(mutate, fragment):
+    rec = _record("2026-08-01T00:00:00+00:00", 1000.0)
+    mutate(rec)
+    problems = gw.validate_record(rec)
+    assert problems and any(fragment in p for p in problems), problems
+
+
+def test_append_refuses_invalid_record(tmp_path):
+    rec = _record("2026-08-01T00:00:00+00:00", 1000.0)
+    del rec["ts"]
+    with pytest.raises(ValueError, match="schema-invalid"):
+        gw.append_record(str(tmp_path / "t.jsonl"), rec)
+
+
+def test_load_trajectory_rejects_corrupt_lines(tmp_path):
+    path = tmp_path / "t.jsonl"
+    good = _record("2026-08-01T00:00:00+00:00", 1000.0)
+    path.write_text(json.dumps(good) + "\nnot json\n")
+    with pytest.raises(ValueError, match="invalid record"):
+        gw.load_trajectory(str(path))
+    assert gw.load_trajectory(str(tmp_path / "missing.jsonl")) == []
+
+
+# --- bench-history backfill (satellite) --------------------------------------
+
+def test_bench_history_all_readable():
+    """Every committed bench entry passes the schema or is explicitly
+    grandfathered with its missing fields listed — no silently
+    unreadable history."""
+    invalid, lines = gw.validate_bench_files()
+    assert invalid == 0, [ln for ln in lines if ln.startswith("INVALID")]
+    assert any(ln.startswith("ok") for ln in lines)
+    for ln in lines:
+        if ln.startswith("grandfathered"):
+            assert "missing [" in ln and "missing []" not in ln, ln
+
+
+def test_classify_bench_entry_shapes():
+    ok, missing = gw.classify_bench_entry(
+        {"metric": "m", "value": 1.0, "unit": "examples/s",
+         "vs_baseline": 1.0, "config": {}, "ts": "2026-01-01T00:00:00"})
+    assert ok == "ok" and missing == []
+    # honest error records are first-class bench history
+    assert gw.classify_bench_entry(
+        {"metric": "m", "error": "device wedged"}) == ("ok", [])
+    status, missing = gw.classify_bench_entry({"metric": "m", "value": 1.0})
+    assert status == "grandfathered" and "ts" in missing
+    # the legacy driver attempt logs grandfather whole, with a reason
+    status, missing = gw.classify_bench_entry(
+        {"n": 1, "cmd": "python bench.py", "rc": 0, "tail": "..."})
+    assert status == "grandfathered" and missing
+    assert gw.classify_bench_entry([1, 2])[0] == "invalid"
+    assert gw.classify_bench_entry({"value": 1.0})[0] == "invalid"
+
+
+def test_record_from_bench_conversion():
+    entry = {"metric": "deepfm_dim9_examples_per_sec_cpu8",
+             "value": 1000.0, "unit": "examples/s", "vs_baseline": 0.01,
+             "eps_min": 900.0, "eps_max": 1100.0,
+             "config": {"plane": "a2a+grouped", "batch": 4096, "dim": 9},
+             "ts": "2026-08-01T00:00:00+00:00"}
+    rec = gw.record_from_bench(entry, fingerprint=_FP, device=_DEV)
+    assert rec is not None and gw.validate_record(rec) == []
+    assert rec["plane"] == "a2a+grouped" and rec["eps"] == 1000.0
+    assert rec["config"]["source"] == "bench"
+    assert rec["scope"] is None          # bench entries carry no spans
+    # inconvertible shapes: errors, non-throughput units, missing band
+    assert gw.record_from_bench({"metric": "m", "error": "x"}) is None
+    assert gw.record_from_bench(
+        {"metric": "m", "value": 1.0, "unit": "GB/s"}) is None
+    assert gw.record_from_bench(
+        {"metric": "m", "value": 1.0, "unit": "examples/s"}) is None
+
+
+# --- the regression gate -----------------------------------------------------
+
+def _trajectory():
+    return [_record("2026-08-01T00:00:00+00:00", 1000.0),
+            _record("2026-08-02T00:00:00+00:00", 1050.0),
+            _record("2026-08-03T00:00:00+00:00", 980.0)]
+
+
+def test_gate_healthy_and_soft_pass():
+    failures, lines = gw.gate(_trajectory())
+    assert failures == 0
+    assert any("ok" in ln and "a2a/eps" in ln for ln in lines)
+    # a single record (first run on new hardware) soft-passes with a warn
+    failures, lines = gw.gate(_trajectory()[:1])
+    assert failures == 0 and "no baseline" in lines[0]
+    # an empty trajectory warns instead of passing silently
+    failures, lines = gw.gate([])
+    assert failures == 0 and "empty" in lines[0]
+
+
+def test_gate_catches_injected_2x_regression(tmp_path):
+    """THE acceptance-criterion negative test: a synthetic 2x-slower
+    record (eps halved, p50 doubled) against a healthy baseline exits
+    nonzero through the CLI."""
+    records = _trajectory()
+    records.append(_record("2026-08-04T00:00:00+00:00", 500.0,
+                           p50_ms=2.0))
+    failures, lines = gw.gate(records)
+    assert failures >= 1
+    assert any("REGRESSION" in ln and "eps" in ln for ln in lines)
+    assert any("REGRESSION" in ln and "p50_ms" in ln for ln in lines)
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    assert gw.main(["--gate", "--trajectory", str(path)]) == 1
+    # drop the injected record -> the same CLI invocation is clean
+    with open(path, "w") as f:
+        for r in records[:-1]:
+            f.write(json.dumps(r) + "\n")
+    assert gw.main(["--gate", "--trajectory", str(path)]) == 0
+
+
+def test_gate_noise_band_derived_from_eps_spread():
+    """A wide measured band (noisy box) must widen the gate: the same
+    -40% delta that fails a tight-band group passes a wide-band one."""
+    tight = _trajectory()
+    tight.append(_record("2026-08-04T00:00:00+00:00", 600.0))
+    failures, _ = gw.gate(tight)
+    assert failures >= 1                      # 40% drop vs ~35% band
+    noisy = []
+    for i, eps in enumerate((1000.0, 1050.0, 980.0, 600.0)):
+        r = _record(f"2026-08-0{i + 1}T00:00:00+00:00", eps)
+        r["eps_min"], r["eps_max"] = eps * 0.6, eps * 1.4   # 80% spread
+        noisy.append(r)
+    failures, lines = gw.gate(noisy)
+    assert failures == 0, lines
+
+
+def test_gate_groups_by_fingerprint():
+    """Records from different hardware never gate each other."""
+    records = _trajectory()
+    slow = _record("2026-08-04T00:00:00+00:00", 100.0)
+    slow["fingerprint"] = "tpu8-real-device"
+    records.append(slow)
+    failures, lines = gw.gate(records)
+    assert failures == 0
+    assert any("no baseline" in ln and "tpu8-real-device" in ln
+               for ln in lines)
+
+
+def test_committed_trajectory_gates_clean():
+    """The repo's own BENCH_trajectory.jsonl must load schema-valid and
+    gate clean — a PR that lands a regressing record (or corrupts the
+    file) fails here before CI's gate even runs."""
+    records = gw.load_trajectory(gw.TRAJECTORY_FILE)
+    assert records, "committed trajectory is missing or empty"
+    failures, lines = gw.gate(records)
+    assert failures == 0, lines

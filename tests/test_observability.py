@@ -167,16 +167,21 @@ def test_streaming_auc_exact_cases():
     assert auc4.result() == 0.5
 
 
-def test_prometheus_text_golden():
+def test_prometheus_text_golden(monkeypatch):
     """Golden exposition output: every series carries # HELP/# TYPE, the
-    graftscope histograms render as _bucket/_sum/_count, and label
-    values are escaped — the page must stay parseable by a real
-    Prometheus scraper (satellite: metric hygiene)."""
+    graftscope histograms render as _bucket/_sum/_count, the graftwatch
+    host-memory ledger renders as oe_mem_* gauges, and label values are
+    escaped — the page must stay parseable by a real Prometheus scraper
+    (satellite: metric hygiene)."""
     acc = obs.Accumulator()
     acc.add("pull_indices", 512)
     acc.add_time("train_step", 0.5)
     scope.HISTOGRAMS.reset()
     scope.HISTOGRAMS.observe("span_pull_seconds", 0.25, plane="a2a")
+    # deterministic memory section: only the span-ring source (emptied),
+    # no leftover registered tables from earlier tests in the session
+    scope.reset()
+    monkeypatch.setattr(obs, "_MEM_SOURCES", {})
     got = obs.prometheus_text(acc)
     want = """\
 # HELP oe_pull_indices_total accumulated count of `pull_indices`
@@ -194,6 +199,15 @@ oe_span_pull_seconds_bucket{plane="a2a",le="0.3162"} 1
 oe_span_pull_seconds_bucket{plane="a2a",le="+Inf"} 1
 oe_span_pull_seconds_sum{plane="a2a"} 0.25
 oe_span_pull_seconds_count{plane="a2a"} 1
+# HELP oe_mem_approx_bytes graftwatch host-memory ledger gauge `approx_bytes` (labeled by source)
+# TYPE oe_mem_approx_bytes gauge
+oe_mem_approx_bytes{source="scope/rings"} 0
+# HELP oe_mem_dropped graftwatch host-memory ledger gauge `dropped` (labeled by source)
+# TYPE oe_mem_dropped gauge
+oe_mem_dropped{source="scope/rings"} 0
+# HELP oe_mem_events graftwatch host-memory ledger gauge `events` (labeled by source)
+# TYPE oe_mem_events gauge
+oe_mem_events{source="scope/rings"} 0
 """
     assert got == want
     # minimal scraper-side parse: every non-comment line is
@@ -205,6 +219,134 @@ oe_span_pull_seconds_count{plane="a2a"} 1
         float(value)
         assert name_part.startswith("oe_")
     scope.HISTOGRAMS.reset()
+
+
+def test_memory_stats_registry_and_weakrefs():
+    """Sources register weakly: a live object's gauges appear under
+    kind/name, duplicate names disambiguate, and a collected object
+    falls out of the snapshot instead of being kept alive."""
+    import gc
+
+    class Src:
+        def __init__(self, b):
+            self.b = b
+
+        def memory_stats(self):
+            return {"bytes": self.b}
+
+    a, b = Src(10.0), Src(20.0)
+    obs.register_memory_source("test", "dup", a)
+    obs.register_memory_source("test", "dup", b)
+    try:
+        ms = obs.memory_stats()
+        assert "scope/rings" in ms
+        vals = sorted(v["bytes"] for k, v in ms.items()
+                      if k.startswith("test/dup"))
+        assert vals == [10.0, 20.0]
+        del a
+        gc.collect()
+        ms = obs.memory_stats()
+        vals = [v["bytes"] for k, v in ms.items()
+                if k.startswith("test/dup")]
+        assert vals == [20.0]
+
+        class Broken:
+            def memory_stats(self):
+                raise RuntimeError("mid-teardown")
+
+        c = Broken()
+        obs.register_memory_source("test", "broken", c)
+        ms = obs.memory_stats()             # never raises out of a scrape
+        assert not any(k.startswith("test/broken") for k in ms)
+    finally:
+        del b
+        gc.collect()
+        obs.memory_stats()                  # prune the dead refs
+
+
+def test_memory_stats_offload_monotone(devices8):
+    """Offload-table gauges (ISSUE 7 satellite): store/book bytes exact
+    at construction, resident/planned row counters monotone-sane across
+    the prepare -> apply -> evict cycle."""
+    import numpy as np
+    from openembedding_tpu import EmbeddingVariableMeta
+    from openembedding_tpu.offload import ShardedOffloadedTable
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(2, 4, devices8)
+    vocab, cache = 2048, 256
+    t = ShardedOffloadedTable(
+        "memt", EmbeddingVariableMeta(embedding_dim=4,
+                                      vocabulary_size=vocab),
+        {"category": "sgd", "learning_rate": 1.0},
+        {"category": "constant", "value": 0.25},
+        vocab=vocab, cache_capacity=cache, mesh=mesh)
+    ms = t.memory_stats()
+    # store = weights + optimizer slots + int64 work ids, exactly
+    assert ms["store_bytes"] == t.host_weights.nbytes \
+        + t.host_work_id.nbytes \
+        + sum(a.nbytes for a in t.host_slots.values())
+    assert ms["store_bytes"] >= vocab * 4 * 4 + vocab * 8
+    assert ms["store_memmap"] == 0.0
+    assert ms["book_bytes"] == t._resident.nbytes + t._planned.nbytes \
+        + t._dirty.nbytes + t._last_touch.nbytes
+    assert ms["resident_rows"] == 0.0 and ms["planned_rows"] == 0.0
+    assert ms["cache_capacity_rows"] == float(cache)
+    # prepare marks planned rows; cancel returns them
+    prep = t.host_prepare(np.arange(0, 50, dtype=np.int32))
+    assert t.memory_stats()["planned_rows"] == 50.0
+    t.cancel_prepared(prep)
+    assert t.memory_stats()["planned_rows"] == 0.0
+    # apply moves planned -> resident; an over-budget prepare evicts
+    cachestate = t.create_cache()
+    prep = t.host_prepare(np.arange(0, 50, dtype=np.int32))
+    cachestate = t.apply_prepared(cachestate, prep)
+    ms = t.memory_stats()
+    assert ms["resident_rows"] == 50.0 and ms["planned_rows"] == 0.0
+    prep = t.host_prepare(np.arange(100, 100 + 260, dtype=np.int32))
+    assert prep.needs_evict
+    cachestate = t.apply_prepared(cachestate, prep)
+    ms = t.memory_stats()
+    assert ms["evictions"] >= 1.0
+    # eviction kept the cache bounded: the pre-evict books would hold
+    # 50 + 260 rows; post-evict residency stays within one batch of the
+    # nominal capacity (hash occupancy is threshold-managed, not exact)
+    assert 0.0 < ms["resident_rows"] < 310.0
+    # the ledger sees this table under offload/<name>
+    snap = obs.memory_stats()
+    key = next(k for k in snap if k.startswith("offload/memt"))
+    assert snap[key]["resident_rows"] == ms["resident_rows"]
+
+
+def test_memory_stats_hot_cache_refresh(devices8):
+    """Hot-cache gauges: the admission sketch accounts its host RAM and
+    a refresh records the replica bytes it just built."""
+    import numpy as np
+    import jax
+    from openembedding_tpu.embedding import (EmbeddingCollection,
+                                             EmbeddingSpec)
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh(2, 4, devices8)
+    coll = EmbeddingCollection(
+        (EmbeddingSpec(name="hc", input_dim=512, output_dim=4,
+                       plane="a2a+cache", cache_k=16),), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    mgr = coll.make_hot_cache_manager("hc")
+    ms = mgr.memory_stats()
+    assert ms["replica_bytes"] == 0.0 and ms["refreshes"] == 0.0
+    assert ms["sketch_bytes"] > 0.0          # dense backing preallocates
+    mgr.observe(np.arange(64, dtype=np.int32))
+    assert mgr.memory_stats()["sketch_keys"] == 64.0
+    new_state = mgr.refresh(states["hc"])
+    ms = mgr.memory_stats()
+    assert ms["refreshes"] == 1.0
+    expect = new_state.cache.keys.nbytes + new_state.cache.rows.nbytes \
+        + sum(v.nbytes for v in new_state.cache.slots.values())
+    assert ms["replica_bytes"] == float(expect) > 0.0
+    snap = obs.memory_stats()
+    key = next(k for k in snap if k.startswith("hot_cache/hc"))
+    assert snap[key]["replica_bytes"] == ms["replica_bytes"]
 
 
 def test_prometheus_text_and_endpoint(devices8):
@@ -245,6 +387,16 @@ def test_prometheus_text_and_endpoint(devices8):
                'route="/metrics",le="+Inf"}' in body2
         assert 'oe_span_http_seconds_count{method="GET",' \
                'route="/metrics"}' in body2
+        # graftwatch host-memory gauges are on the page and parse
+        # scraper-side: the registry this server fronts accounts its
+        # loaded models (zero here), span rings always report
+        assert "# TYPE oe_mem_events gauge" in body2
+        assert 'oe_mem_events{source="scope/rings"}' in body2
+        assert 'oe_mem_loaded_models{source="serving/registry"} 0' \
+            in body2
+        for ln in body2.strip().splitlines():
+            if ln.startswith("oe_mem_"):
+                float(ln.rsplit(" ", 1)[1])
     finally:
         srv.stop()
         obs.GLOBAL.reset()

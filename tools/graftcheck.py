@@ -6,8 +6,11 @@ Builds a virtual CPU mesh, lowers every registered plane's pull/push
 program (array AND hash tables) plus the whole jitted train step, and
 audits them against ``openembedding_tpu/analysis/contracts.py``:
 collective inventory + byte bounds, no f64, no host transfers, step
-donation honored. Exit 0 when every contract holds, 1 with the first
-violation per program otherwise.
+donation honored — plus the graftwatch MEMORY ledger
+(``analysis/memwatch.py``): every plane's compiled temp allocation
+audited against the peak-temp-bytes contract at sizes where one table
+shard dwarfs batch scratch. Exit 0 when every contract holds, 1 with
+the first violation per program otherwise.
 
 This is the compile-audit-time version of the scaling guarantee: a
 sharding/plane regression fails HERE, on a laptop, instead of as a
@@ -33,6 +36,8 @@ def main(argv=None) -> int:
     ap.add_argument("--dim", type=int, default=16)
     ap.add_argument("--skip-step", action="store_true",
                     help="skip the (slower) whole-train-step audit")
+    ap.add_argument("--skip-mem", action="store_true",
+                    help="skip the graftwatch memory-ledger audit")
     args = ap.parse_args(argv)
     data, model = (int(x) for x in args.mesh.split("x"))
 
@@ -90,6 +95,26 @@ def main(argv=None) -> int:
                 return contracts.check_program(txt, "a2a+grouped", prog,
                                                **params)
             audit(f"a2a+grouped/{prog} ({kind}, 3 tables)", run)
+
+    # graftwatch memory ledger: peak-temp contract per plane at the
+    # calibrated audit sizes (memwatch.AUDIT_*, deliberately independent
+    # of --batch: detection power needs the table shard to dwarf batch
+    # scratch, exactly like the step audit's copy bound below)
+    if not args.skip_mem:
+        from openembedding_tpu.analysis import memwatch
+
+        def run_mem():
+            rows = memwatch.memory_ledger(mesh)
+            print(memwatch.format_memory_table(rows))
+            missing = [f"{r.plane}/{r.program}" for r in rows
+                       if r.mem is None]
+            if missing:
+                raise RuntimeError(
+                    f"no compiled memory analysis for {missing} — the "
+                    "backend stopped exposing memory_analysis(); the "
+                    "ledger (and every HBM claim downstream) is blind")
+            return f"{len(rows)} programs, peak-temp bounds hold"
+        audit("memory ledger (all planes, peak-temp contract)", run_mem)
 
     if not args.skip_step:
         def run_step():

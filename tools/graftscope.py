@@ -16,7 +16,9 @@ three moves (``openembedding_tpu/analysis/scope.py``):
    plane (compile warmed up outside the measured window) so every
    exchange lands in the graftscope latency histograms, then print the
    per-plane/per-stage table: calls, p50/p95 latency, expected
-   collective bytes, achieved GB/s at the p50.
+   collective bytes, achieved GB/s at the p50, and the program's
+   expected per-device HBM peak (graftwatch memory ledger) — latency,
+   bytes, and memory in one artifact.
 3. **Traced train run** — ``--steps`` real ``Trainer.train_step`` calls
    on ``--plane`` (step spans, lookahead spans) captured into the span
    rings and written as Chrome-trace/Perfetto JSON (``--out``; open at

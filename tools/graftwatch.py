@@ -1,0 +1,642 @@
+"""graftwatch CLI: versioned bench trajectory + perf-regression gate.
+
+    python -m tools.graftwatch --record --quick     # cpu8 micro-bench
+    python -m tools.graftwatch --gate               # regression gate
+    python -m tools.graftwatch --validate-bench     # bench-file audit
+
+Bench entries used to be schemaless one-off JSON blobs: no git sha, no
+hardware fingerprint, nothing consuming them — a perf regression
+between PRs was undetectable until someone eyeballed numbers. This
+tool closes the loop (the reference's own benchmark discipline is
+reproducible per-config records, ``documents/en/benchmark.md``):
+
+* ``--record`` runs a small per-plane pull/push micro-bench on a
+  virtual cpu mesh (``--quick`` for the CI-sized variant) and appends
+  ONE schema-versioned record per registered plane to
+  ``BENCH_trajectory.jsonl``: git sha, jax/jaxlib versions, hardware
+  fingerprint, eps with min/max band, graftscope span percentiles,
+  HLO-derived expected collective bytes, and the graftwatch memory
+  ledger (``analysis/memwatch.py``) for the same programs.
+* ``--gate`` compares the NEWEST record of each (plane, fingerprint,
+  config) group against the trailing baseline (median of the previous
+  ``--window`` records) with a noise band derived from each record's
+  own eps_min/eps_max spread. No baseline -> soft pass with a warning
+  (the first record on new hardware cannot regress against anything);
+  baseline present + any metric worse than the band -> exit 1.
+* ``--validate-bench`` audits every entry of ``bench_suite.json`` and
+  the ``BENCH_r0*.json`` attempt logs against the bench-entry schema:
+  entries either pass or are explicitly grandfathered with their
+  missing fields listed — no silently unreadable history.
+
+``bench.py --trajectory <path>`` appends its own throughput entries
+through :func:`record_from_bench`, so real device rounds land in the
+same trajectory as the CI micro-bench.
+
+Gate/validate modes import no jax — they run anywhere, instantly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+SCHEMA_VERSION = 1
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAJECTORY_FILE = os.path.join(REPO_ROOT, "BENCH_trajectory.jsonl")
+
+# gate tuning: the band is derived from measured eps spread, floored at
+# MIN_BAND (2-core CI boxes jitter ~20% between blocks) and widened by
+# SAFETY; a genuine 2x regression (50% drop) always clears the band,
+# block-to-block noise never should
+MIN_BAND = 0.25
+BAND_SAFETY = 1.4
+BASELINE_WINDOW = 5
+
+
+# --- provenance --------------------------------------------------------------
+
+def git_info() -> Tuple[str, bool]:
+    """(sha, dirty) of the repo, or ("unknown", False) outside git."""
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        dirty = bool(subprocess.run(
+            ["git", "status", "--porcelain"], cwd=REPO_ROOT,
+            capture_output=True, text=True, timeout=10).stdout.strip())
+        return (sha or "unknown"), dirty
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        return "unknown", False
+
+
+def _cpu_model_slug() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    return re.sub(r"[^a-z0-9]+", "_",
+                                  model.lower()).strip("_")[:40]
+    except OSError:
+        pass
+    import platform as _platform
+    return re.sub(r"[^a-z0-9]+", "_",
+                  (_platform.processor() or _platform.machine() or
+                   "unknown").lower())[:40]
+
+
+def device_fingerprint() -> Tuple[str, Dict[str, Any]]:
+    """(fingerprint string, device dict) of the LIVE jax backend.
+
+    The fingerprint keys baseline grouping: records from different
+    hardware must never gate each other (a GH runner regressing against
+    a workstation record is noise, not signal), so it folds in platform,
+    device count, device kind, and the host CPU model + core count.
+    """
+    import jax
+    devs = jax.devices()
+    platform = devs[0].platform
+    kind = getattr(devs[0], "device_kind", "") or platform
+    device = {"platform": platform, "n_devices": len(devs),
+              "device_kind": kind}
+    fp = (f"{platform}{len(devs)}-{_cpu_model_slug()}"
+          f"-c{os.cpu_count() or 0}")
+    return fp, device
+
+
+def make_record(*, plane: str, config: Mapping[str, Any], eps: float,
+                eps_min: float, eps_max: float,
+                scope: Optional[Mapping[str, Any]] = None,
+                memory: Optional[Mapping[str, Any]] = None,
+                host_memory: Optional[Mapping[str, Any]] = None,
+                fingerprint: Optional[str] = None,
+                device: Optional[Mapping[str, Any]] = None,
+                ts: Optional[str] = None) -> Dict[str, Any]:
+    """Assemble one schema-valid trajectory record (provenance fields
+    computed live when not supplied)."""
+    import datetime
+    if fingerprint is None or device is None:
+        fingerprint, device = device_fingerprint()
+    sha, dirty = git_info()
+    try:
+        import jax
+        jax_v = jax.__version__
+    except Exception:  # noqa: BLE001
+        jax_v = "unknown"
+    try:
+        import jaxlib
+        jaxlib_v = jaxlib.__version__
+    except Exception:  # noqa: BLE001
+        jaxlib_v = "unknown"
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "ts": ts or datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "git_sha": sha, "git_dirty": dirty,
+        "jax": jax_v, "jaxlib": jaxlib_v,
+        "fingerprint": fingerprint, "device": dict(device),
+        "plane": plane, "config": dict(config),
+        "eps": float(eps), "eps_min": float(eps_min),
+        "eps_max": float(eps_max),
+        "scope": dict(scope) if scope else None,
+        "memory": dict(memory) if memory else None,
+        "host_memory": dict(host_memory) if host_memory else None,
+    }
+
+
+# --- schema validation -------------------------------------------------------
+
+_NUM = (int, float)
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Problems with one trajectory record ([] == schema-valid)."""
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    p: List[str] = []
+
+    def need(key, types):
+        v = rec.get(key)
+        tt = types if isinstance(types, tuple) else (types,)
+        # bool is an int subclass — only accept it where bool is asked
+        if not isinstance(v, tt) or (isinstance(v, bool)
+                                     and bool not in tt):
+            p.append(f"{key}: expected "
+                     f"{'/'.join(t.__name__ for t in tt)}, "
+                     f"got {type(v).__name__}")
+            return None
+        return v
+
+    if rec.get("schema_version") != SCHEMA_VERSION:
+        p.append(f"schema_version: expected {SCHEMA_VERSION}, "
+                 f"got {rec.get('schema_version')!r}")
+    for key in ("ts", "git_sha", "jax", "jaxlib", "fingerprint", "plane"):
+        need(key, str)
+    need("git_dirty", bool)
+    need("config", dict)
+    dev = need("device", dict)
+    if dev is not None:
+        if not isinstance(dev.get("platform"), str):
+            p.append("device.platform: expected str")
+        if not isinstance(dev.get("n_devices"), int):
+            p.append("device.n_devices: expected int")
+    for key in ("eps", "eps_min", "eps_max"):
+        v = need(key, _NUM)
+        if v is not None and (isinstance(v, bool) or v <= 0):
+            p.append(f"{key}: must be a positive number, got {v!r}")
+    if not p and not (rec["eps_min"] <= rec["eps"] <= rec["eps_max"]):
+        p.append("eps band violated: need eps_min <= eps <= eps_max")
+    scope = rec.get("scope")
+    if scope is not None:
+        if not isinstance(scope, dict):
+            p.append("scope: expected object or null")
+        else:
+            for stage, entry in scope.items():
+                if not isinstance(entry, dict):
+                    p.append(f"scope.{stage}: expected object")
+                    continue
+                for k in ("p50_ms", "p95_ms"):
+                    if not isinstance(entry.get(k), _NUM):
+                        p.append(f"scope.{stage}.{k}: expected number")
+                if not isinstance(entry.get("calls"), int):
+                    p.append(f"scope.{stage}.calls: expected int")
+                if not isinstance(entry.get("expected_bytes"), int):
+                    p.append(f"scope.{stage}.expected_bytes: expected int")
+    mem = rec.get("memory")
+    if mem is not None and not isinstance(mem, dict):
+        p.append("memory: expected object or null")
+    return p
+
+
+# bench_suite.json entry schema (the pre-trajectory record shape every
+# runner in bench.py emits); honest error records are first-class
+_BENCH_REQUIRED: Tuple[Tuple[str, Any], ...] = (
+    ("value", _NUM), ("unit", str), ("vs_baseline", _NUM),
+    ("config", dict), ("ts", str))
+
+
+def classify_bench_entry(entry: Any) -> Tuple[str, List[str]]:
+    """("ok" | "grandfathered" | "invalid", missing-field list).
+
+    ``ok``: a well-formed bench record or an honest error record.
+    ``grandfathered``: readable history predating a field (listed) —
+    the legacy ``BENCH_r0*.json`` driver attempt logs land here whole.
+    ``invalid``: unreadable as bench history at all.
+    """
+    if not isinstance(entry, dict):
+        return "invalid", ["entry is not a JSON object"]
+    if {"n", "cmd", "rc"} <= set(entry):
+        return "grandfathered", [
+            "legacy driver attempt log (n/cmd/rc/tail) — predates the "
+            "bench-entry schema; kept as wedge-history provenance"]
+    if not isinstance(entry.get("metric"), str):
+        return "invalid", ["metric: required str"]
+    if isinstance(entry.get("error"), str):
+        return "ok", []
+    missing = [key for key, types in _BENCH_REQUIRED
+               if not isinstance(entry.get(key), types)]
+    return ("ok" if not missing else "grandfathered"), missing
+
+
+def validate_bench_files(root: str = REPO_ROOT) -> Tuple[int, List[str]]:
+    """Audit bench_suite.json + BENCH_r0*.json; returns (invalid count,
+    report lines)."""
+    import glob
+    lines: List[str] = []
+    invalid = 0
+    paths = [os.path.join(root, "bench_suite.json")]
+    paths += sorted(glob.glob(os.path.join(root, "BENCH_r0*.json")))
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            continue
+        except (OSError, json.JSONDecodeError) as e:
+            invalid += 1
+            lines.append(f"INVALID {name}: unreadable JSON ({e})")
+            continue
+        entries = data if isinstance(data, list) else [data]
+        for i, entry in enumerate(entries):
+            status, missing = classify_bench_entry(entry)
+            label = entry.get("metric", f"entry[{i}]") \
+                if isinstance(entry, dict) else f"entry[{i}]"
+            if status == "invalid":
+                invalid += 1
+                lines.append(f"INVALID {name}:{label}: {missing}")
+            elif status == "grandfathered":
+                lines.append(f"grandfathered {name}:{label}: "
+                             f"missing {missing}")
+            else:
+                lines.append(f"ok   {name}:{label}")
+    return invalid, lines
+
+
+# --- trajectory IO -----------------------------------------------------------
+
+def load_trajectory(path: str) -> List[Dict[str, Any]]:
+    """Schema-valid records from a JSONL trajectory (raises ValueError
+    listing every invalid line — a half-corrupt trajectory must not
+    silently gate on the readable half)."""
+    records: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    try:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    problems.append(f"line {lineno}: bad JSON ({e})")
+                    continue
+                bad = validate_record(rec)
+                if bad:
+                    problems.append(f"line {lineno}: {'; '.join(bad)}")
+                else:
+                    records.append(rec)
+    except FileNotFoundError:
+        return []
+    if problems:
+        raise ValueError(
+            f"{path}: {len(problems)} invalid record(s): "
+            + " | ".join(problems[:5]))
+    return records
+
+
+def append_record(path: str, rec: Dict[str, Any]) -> None:
+    bad = validate_record(rec)
+    if bad:
+        raise ValueError(f"refusing to append a schema-invalid record: "
+                         f"{bad}")
+    with open(path, "a") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def record_from_bench(result: Mapping[str, Any], *,
+                      fingerprint: Optional[str] = None,
+                      device: Optional[Mapping[str, Any]] = None
+                      ) -> Optional[Dict[str, Any]]:
+    """Convert one bench.py result dict into a trajectory record
+    (throughput entries only — they carry the eps_min/eps_max band the
+    gate's noise model needs); None for inconvertible entries."""
+    if not isinstance(result, dict) or "error" in result:
+        return None
+    if result.get("unit") != "examples/s":
+        return None
+    if not all(isinstance(result.get(k), _NUM)
+               for k in ("value", "eps_min", "eps_max")):
+        return None
+    cfg = dict(result.get("config") or {})
+    cfg["source"] = "bench"
+    cfg["metric"] = result.get("metric", "")
+    return make_record(
+        plane=str(cfg.get("plane", "a2a")), config=cfg,
+        eps=result["value"], eps_min=result["eps_min"],
+        eps_max=result["eps_max"], fingerprint=fingerprint,
+        device=device, ts=result.get("ts"))
+
+
+# --- the regression gate -----------------------------------------------------
+
+def _rel_spread(rec: Mapping[str, Any]) -> float:
+    eps = float(rec["eps"]) or 1e-9
+    return max(0.0, (float(rec["eps_max"]) - float(rec["eps_min"])) / eps)
+
+
+def _gate_metrics(rec: Mapping[str, Any]) -> Dict[str, Tuple[float, bool]]:
+    """metric -> (value, higher_is_better) for one record."""
+    out: Dict[str, Tuple[float, bool]] = {
+        "eps": (float(rec["eps"]), True)}
+    for stage, entry in (rec.get("scope") or {}).items():
+        p50 = entry.get("p50_ms")
+        if isinstance(p50, _NUM) and p50 > 0:
+            out[f"{stage}_p50_ms"] = (float(p50), False)
+    return out
+
+
+def _group_key(rec: Mapping[str, Any]) -> Tuple[str, str, str]:
+    return (str(rec["plane"]), str(rec["fingerprint"]),
+            json.dumps(rec.get("config") or {}, sort_keys=True))
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def gate(records: List[Dict[str, Any]], *, window: int = BASELINE_WINDOW,
+         min_band: float = MIN_BAND, safety: float = BAND_SAFETY
+         ) -> Tuple[int, List[str]]:
+    """(regressions, report lines): for each (plane, fingerprint,
+    config) group, the newest record vs the trailing-median baseline
+    with a spread-derived noise band. Groups without a baseline warn
+    and pass (first run on new hardware — "soft-fail" mode)."""
+    groups: Dict[Tuple[str, str, str], List[Dict[str, Any]]] = {}
+    for rec in records:
+        groups.setdefault(_group_key(rec), []).append(rec)
+    failures = 0
+    lines: List[str] = []
+    for key in sorted(groups):
+        plane, fp, _cfg = key
+        seq = sorted(groups[key], key=lambda r: r["ts"])
+        newest, base = seq[-1], seq[:-1][-window:]
+        if not base:
+            lines.append(f"warn {plane} [{fp}]: no baseline record yet — "
+                         "soft pass (gate arms once this record lands "
+                         "in the trajectory)")
+            continue
+        band = safety * max([min_band, _rel_spread(newest)]
+                            + [_rel_spread(r) for r in base])
+        new_metrics = _gate_metrics(newest)
+        for metric, (value, higher) in sorted(new_metrics.items()):
+            base_vals = []
+            for r in base:
+                bm = _gate_metrics(r).get(metric)
+                if bm is not None:
+                    base_vals.append(bm[0])
+            if not base_vals:
+                continue
+            baseline = _median(base_vals)
+            if baseline <= 0:
+                continue
+            delta = (value - baseline) / baseline
+            worse = -delta if higher else delta
+            verdict = "REGRESSION" if worse > band else "ok"
+            if verdict == "REGRESSION":
+                failures += 1
+            lines.append(
+                f"{verdict:<10} {plane}/{metric} [{fp}]: new={value:.4g} "
+                f"baseline={baseline:.4g} ({len(base_vals)} rec) "
+                f"delta={delta * 100:+.1f}% band=±{band * 100:.1f}%")
+    if not groups:
+        lines.append("warn: trajectory is empty — nothing to gate")
+    return failures, lines
+
+
+# --- the cpu micro-bench (--record) ------------------------------------------
+
+def run_record(args) -> List[Dict[str, Any]]:
+    """Per-plane pull/push micro-bench on a virtual CPU mesh: measured
+    span percentiles + contract-audited expected bytes + the memory
+    ledger, one trajectory record per registered plane."""
+    data, model = (int(x) for x in args.mesh.split("x"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from openembedding_tpu.utils.jaxcompat import set_num_cpu_devices
+    set_num_cpu_devices(data * model)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from openembedding_tpu.analysis import memwatch, programs, scope
+    from openembedding_tpu.parallel.mesh import create_mesh, DATA_AXIS
+    from openembedding_tpu.utils import observability
+
+    mesh = create_mesh(data, model)
+    planes = memwatch.registered_planes()
+    fingerprint, device = device_fingerprint()
+    rng = np.random.RandomState(0)
+    sh = NamedSharding(mesh, P(DATA_AXIS))
+
+    def _vocab(plane: str) -> int:
+        return (1 << 14) if plane == "a2a+grouped" else (1 << 16)
+
+    def _batches(coll, vocab):
+        names = tuple(coll.specs)
+        idxs = {n: jax.device_put(
+            jnp.asarray(rng.randint(0, vocab, size=args.batch)
+                        .astype(np.int32)), sh) for n in names}
+        grads = {n: jax.device_put(
+            jnp.zeros((args.batch, args.dim), jnp.float32), sh)
+            for n in names}
+        return idxs, grads
+
+    # expected bytes + memory ledger per plane/program (contract-audited
+    # lowering — a plane whose ledger cannot be produced fails --record)
+    expected: Dict[str, Dict[str, Any]] = {}
+    for plane in planes:
+        expected[plane] = {}
+        for program in ("pull", "push"):
+            expected[plane][program] = scope.plane_expected_bytes(
+                mesh, plane, program, batch=args.batch, dim=args.dim)
+
+    # warm every plane's eager dispatch programs with the SAME
+    # evaluate_performance flag as measurement (it keys the jit cache)
+    observability.set_evaluate_performance(True)
+    try:
+        worlds = {}
+        for plane in planes:
+            vocab = _vocab(plane)
+            if plane == "a2a+grouped":
+                coll = programs._grouped_collection(
+                    mesh, tables=3, vocab=vocab, dim=args.dim,
+                    use_hash=False)
+            else:
+                coll = programs._collection(mesh, plane, vocab=vocab,
+                                            dim=args.dim, use_hash=False)
+            states = coll.init(jax.random.PRNGKey(0))
+            idxs, grads = _batches(coll, vocab)
+            jax.block_until_ready(coll.pull(states, idxs))
+            states = coll.apply_gradients(states, idxs, grads)
+            jax.block_until_ready(jax.tree.leaves(states))
+            worlds[plane] = (coll, states)
+        scope.HISTOGRAMS.reset()      # drop compile-inclusive samples
+        scope.reset()
+
+        records = []
+        for plane in planes:
+            coll, states = worlds[plane]
+            vocab = _vocab(plane)
+            block_eps = []
+            for _ in range(args.blocks):
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    idxs, grads = _batches(coll, vocab)
+                    coll.pull(states, idxs)          # plane_timed blocks
+                    states = coll.apply_gradients(states, idxs, grads)
+                dt = time.perf_counter() - t0
+                block_eps.append(args.steps * args.batch / dt)
+            worlds[plane] = (coll, states)
+            rows = scope.ledger_rows(
+                [expected[plane]["pull"], expected[plane]["push"]])
+            scope_section = {
+                r["stage"]: {"calls": int(r["calls"]),
+                             "p50_ms": round(r["p50_ms"], 4),
+                             "p95_ms": round(r["p95_ms"], 4),
+                             "expected_bytes": int(r["expected_bytes"]),
+                             "gbps_p50": round(r["gbps_p50"], 4)
+                             if r["gbps_p50"] == r["gbps_p50"] else 0.0}
+                for r in rows}
+            for r in rows:
+                if r["calls"] < args.blocks * args.steps:
+                    raise RuntimeError(
+                        f"{plane}/{r['stage']}: {r['calls']} span(s) "
+                        f"recorded < {args.blocks * args.steps} "
+                        "dispatched — the measurement instrumentation "
+                        "is broken")
+            memory_section = {
+                program: dict(expected[plane][program].memory or {})
+                or None for program in ("pull", "push")}
+            host_mem = {
+                src: {k: round(v, 1) for k, v in fields.items()}
+                for src, fields in observability.memory_stats().items()}
+            records.append(make_record(
+                plane=plane,
+                config={"mesh": args.mesh, "batch": args.batch,
+                        "dim": args.dim, "steps": args.steps,
+                        "blocks": args.blocks,
+                        "source": "graftwatch-quick" if args.quick
+                        else "graftwatch"},
+                eps=_median(block_eps), eps_min=min(block_eps),
+                eps_max=max(block_eps), scope=scope_section,
+                memory=memory_section, host_memory=host_mem,
+                fingerprint=fingerprint, device=device))
+    finally:
+        observability.set_evaluate_performance(False)
+    return records
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench trajectory recorder + perf-regression gate")
+    ap.add_argument("--record", action="store_true",
+                    help="run the per-plane micro-bench and append one "
+                         "record per plane to the trajectory")
+    ap.add_argument("--gate", action="store_true",
+                    help="compare newest records against the trailing "
+                         "baseline; exit 1 on regression beyond band")
+    ap.add_argument("--validate-bench", action="store_true",
+                    help="audit bench_suite.json + BENCH_r0*.json "
+                         "against the bench-entry schema")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized micro-bench (fewer/smaller blocks)")
+    ap.add_argument("--trajectory", default=TRAJECTORY_FILE,
+                    help=f"JSONL path (default {TRAJECTORY_FILE})")
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--batch", type=int, default=0, help="0 = auto")
+    ap.add_argument("--dim", type=int, default=0, help="0 = auto")
+    ap.add_argument("--steps", type=int, default=0, help="0 = auto")
+    ap.add_argument("--blocks", type=int, default=0, help="0 = auto")
+    ap.add_argument("--window", type=int, default=BASELINE_WINDOW,
+                    help="trailing records per baseline median")
+    ap.add_argument("--min-band", type=float, default=MIN_BAND)
+    ap.add_argument("--safety", type=float, default=BAND_SAFETY)
+    args = ap.parse_args(argv)
+    args.batch = args.batch or (256 if args.quick else 1024)
+    args.dim = args.dim or (8 if args.quick else 16)
+    args.steps = args.steps or (4 if args.quick else 10)
+    args.blocks = args.blocks or (3 if args.quick else 5)
+
+    if not (args.record or args.gate or args.validate_bench):
+        ap.error("pick at least one of --record / --gate "
+                 "/ --validate-bench")
+    rc = 0
+
+    if args.validate_bench:
+        invalid, lines = validate_bench_files()
+        for ln in lines:
+            print(ln)
+        if invalid:
+            print(f"graftwatch: {invalid} unreadable bench entr(ies)",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            print("graftwatch: bench history readable "
+                  "(schema-valid or explicitly grandfathered)")
+
+    if args.record:
+        try:
+            records = run_record(args)
+        except Exception as e:  # noqa: BLE001 — a plane whose ledger or
+            # spans cannot be produced must fail the recorder loudly
+            print(f"graftwatch: --record failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        for rec in records:
+            append_record(args.trajectory, rec)
+            sc = rec["scope"]
+            print(json.dumps({
+                "plane": rec["plane"], "eps": round(rec["eps"], 1),
+                "eps_band": [round(rec["eps_min"], 1),
+                             round(rec["eps_max"], 1)],
+                "pull_p50_ms": sc["pull"]["p50_ms"],
+                "push_p50_ms": sc["push"]["p50_ms"],
+                "fingerprint": rec["fingerprint"]}), flush=True)
+        print(f"graftwatch: appended {len(records)} record(s) to "
+              f"{args.trajectory}")
+
+    if args.gate:
+        try:
+            records = load_trajectory(args.trajectory)
+        except ValueError as e:
+            print(f"graftwatch: {e}", file=sys.stderr)
+            return 2
+        failures, lines = gate(records, window=args.window,
+                               min_band=args.min_band,
+                               safety=args.safety)
+        for ln in lines:
+            print(ln)
+        if failures:
+            print(f"graftwatch: {failures} perf regression(s) beyond "
+                  "the noise band", file=sys.stderr)
+            rc = 1
+        else:
+            print("graftwatch: gate clean")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
