@@ -788,6 +788,119 @@ def run_cache_ab(name, config, *, steps, warmup):
     }
 
 
+def run_pipelined_ab(name, config, *, steps, warmup):
+    """Pipelined-vs-serial A/B on one config: identical data + seeds,
+    ``plane="a2a"`` vs ``plane="a2a+pipelined"`` (the double-buffered
+    step schedule, ``parallel/pipelined.py``). Reports both planes'
+    examples/s, the speedup, and an instrumented whole-step /
+    stage-isolated split (``plane_timings``: step_ms + overlap_hidden_ms
+    = step minus the serially-dispatched pull+push walls) sampled
+    outside the timed blocks. ``value`` is the PIPELINED plane's
+    examples/s so ``vs_baseline`` stays comparable with the plain
+    ``deepfm_dim9*`` entries.
+    """
+    import jax
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.utils import observability as obs
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    data_ax = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    mesh = create_mesh(data_ax, n_dev // data_ax)
+    batch = config["batch"]
+    planes = {}
+    stage_split = {}
+    for plane in ("a2a", "a2a+pipelined"):
+        cfg = dict(config, plane=plane)
+        features, coll, trainer, mapper = build(cfg, mesh)
+        batches = make_batches(cfg, features, mapper)
+
+        def step(state, i):
+            # the lookahead the fit loop would provide: the pipelined
+            # arm prefetches batch i+1 inside step i's program; the
+            # serial arm ignores it
+            return trainer.train_step(
+                state, batches[i % len(batches)],
+                next_batch=batches[(i + 1) % len(batches)])
+
+        state = trainer.init(jax.random.PRNGKey(0),
+                             trainer.shard_batch(batches[0]))
+        # ONE batch index across warmup, blocks and the instrumented
+        # sample: restarting at 0 per block would make every block
+        # open on a lookahead miss (an eager re-prime the pipelined
+        # arm alone pays, inside the timed window)
+        gi = 0
+        # the pipelined schedule has a 2-step compile warmup (prime
+        # pull + step program, step 2 may legally recompile once)
+        for _ in range(max(warmup, 3)):
+            state, m = step(state, gi)
+            gi += 1
+        jax.block_until_ready(m["loss"])
+        block_eps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, m = step(state, gi)
+                gi += 1
+            jax.block_until_ready(m["loss"])
+            block_eps.append(steps * batch / (time.perf_counter() - t0))
+        planes[plane] = _median(block_eps)
+        if plane == "a2a+pipelined":
+            # instrumented sample OUTSIDE the timed blocks: whole-step
+            # wall (blocking) + one eager stage-isolation round so
+            # plane_timings can report overlap_hidden_ms (the in-step
+            # pull/push are not separable host-side — the satellite fix
+            # for double-counted stage attribution)
+            obs.set_evaluate_performance(True)
+            try:
+                sb = trainer.shard_batch(batches[0])
+                inputs = {k2: v for k2, v in sb["sparse"].items()
+                          if k2 in coll.specs}
+
+                def stage_round():
+                    rows = coll.pull(state.emb, inputs)
+                    jax.block_until_ready(jax.tree.leaves(rows))
+                    emb2 = coll.apply_gradients(state.emb, inputs, rows)
+                    jax.block_until_ready(jax.tree.leaves(emb2))
+
+                # warm the instrumented eager stage programs (the
+                # record gate keys their jit cache: first dispatch
+                # compiles) so the sampled walls are run time, not
+                # compile time; then ONE full stage-isolation round per
+                # recorded step — the normalization plane_timings'
+                # overlap_hidden_ms estimate assumes
+                stage_round()
+                obs.GLOBAL.reset()
+                for _ in range(3):
+                    state, m = step(state, gi)
+                    gi += 1
+                    stage_round()
+                jax.effects_barrier()
+                t = obs.plane_timings().get(trainer.pipeline_plane, {})
+                stage_split = {
+                    k: round(t[k], 3)
+                    for k in ("step_ms", "pull_ms", "push_ms",
+                              "stage_serial_ms", "overlap_hidden_ms")
+                    if k in t}
+            finally:
+                obs.set_evaluate_performance(False)
+        del state
+        gc.collect()
+    eps = planes["a2a+pipelined"]
+    return {
+        "metric": f"{name}_examples_per_sec_{platform}{n_dev}",
+        "value": round(eps, 1),
+        "unit": "examples/s",
+        "vs_baseline": round(eps / n_dev / REF_PER_CHIP, 3),
+        "per_chip": round(eps / n_dev, 1),
+        "serial_eps": round(planes["a2a"], 1),
+        "pipelined_speedup": round(eps / planes["a2a"], 3),
+        "plane_timings": stage_split,
+        **_hbm_stats(),
+        "config": dict(config),
+    }
+
+
 def run_plane_parity(name, config, *, steps, warmup):
     """Cross-plane AUC/loss parity: a2a, psum, hybrid (sparse_as_dense),
     and offload planes trained on IDENTICAL data + seeds must agree — the
@@ -1108,6 +1221,17 @@ CONFIGS = {
                          "cache_k": 4096, "cache_refresh_every": 16},
     "deepfm_dim64": {"model": "deepfm", "dim": 64, "vocab": 1 << 18,
                      "batch": 4096, "zipf": True},
+    # pipelined-vs-serial A/B: the double-buffered step schedule
+    # (parallel/pipelined.py) on the headline shape and on dim64 —
+    # where pull_ms is ~3x the dim9 cost (BENCH_r05) and the overlap
+    # win is largest on hardware whose exchange has real latency
+    "deepfm_dim9_pipelined_ab": {"kind": "pipelined_ab", "model": "deepfm",
+                                 "dim": 9, "vocab": 1 << 20,
+                                 "batch": 4096, "zipf": True},
+    "deepfm_dim64_pipelined_ab": {"kind": "pipelined_ab",
+                                  "model": "deepfm", "dim": 64,
+                                  "vocab": 1 << 18, "batch": 4096,
+                                  "zipf": True},
     # checkpoint timing on a deliberately small table: the bench link
     # (tunneled chip) moves ~10 MB/s device->host, so GB-scale dumps are
     # link-bound; the per-GB rate extrapolates
@@ -1188,7 +1312,7 @@ CONFIGS = {
 }
 HEADLINE = "deepfm_dim9"
 RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
-           "cache_ab": run_cache_ab,
+           "cache_ab": run_cache_ab, "pipelined_ab": run_pipelined_ab,
            "hash_probe": run_hash_probe,
            "auc": run_auc_criteo, "ckpt_local": run_ckpt_local,
            "serving_lookup": run_serving_lookup,

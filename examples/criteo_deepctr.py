@@ -47,7 +47,8 @@ def parse_args(argv=None):
                    "parallel params (the reference's --cache hybrid, "
                    "exb.py:617-632); needs --no-fused")
     p.add_argument("--plane", default="a2a",
-                   choices=["a2a", "psum", "a2a+cache", "a2a+grouped"],
+                   choices=["a2a", "psum", "a2a+cache", "a2a+grouped",
+                            "a2a+pipelined", "a2a+grouped+pipelined"],
                    help="sparse data plane: owner-routed all-to-all "
                    "(default), the psum/all_gather baseline, a2a plus "
                    "the hot-row replica cache (parallel/hot_cache.py), "

@@ -102,6 +102,35 @@ def plane_memory(mesh, plane: str, program: str, *,
                      params=params, temp_bound=bound)
 
 
+def pipelined_step_memory(mesh, *, batch: int = AUDIT_BATCH,
+                          dim: int = AUDIT_DIM,
+                          vocab: Optional[int] = None,
+                          check: bool = True) -> MemoryRow:
+    """Memory-ledger row for the PIPELINED STEP program
+    (``parallel/pipelined.py``): the whole-step peak-temp bound plus
+    exactly one extra pulled-row buffer (``pipeline_rows_bytes``,
+    measured from the primed buffer itself) — never anything
+    table-sized. The vocab defaults low enough that the deepfm harness
+    compiles quickly; pass ``vocab=AUDIT_VOCAB`` for the
+    shard-dominates-scratch sizing when hunting a regression."""
+    from . import contracts, programs
+    from ..utils import jaxcompat
+    compiled, params = programs.compile_pipelined_step(
+        mesh, vocab=vocab or (1 << 17), batch=batch, dim=dim)
+    mem = jaxcompat.compiled_memory_stats(compiled)
+    bound = None
+    if mem is not None:
+        if check:
+            bound = contracts.check_peak_temp_bytes(
+                mem, params, program="step",
+                label="a2a+pipelined/step (deepfm)")
+        else:
+            bound = contracts.peak_temp_bound(
+                params, "step", int(mem.get("alias_bytes", 0)))
+    return MemoryRow(plane="a2a+pipelined", program="step", kind="array",
+                     mem=mem, params=params, temp_bound=bound)
+
+
 def registered_planes() -> List[str]:
     """Planes with a pull/push contract in the registry — the coverage
     set the graftcheck/graftwatch memory audits iterate."""

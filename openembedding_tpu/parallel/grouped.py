@@ -79,6 +79,10 @@ from ..utils.jaxcompat import shard_map
 from . import alltoall as a2a
 
 GROUPED_PLANE = "a2a+grouped"
+# the composed plane: grouped collection-level exchange AND the
+# Trainer's pipelined step schedule (parallel/pipelined.py) — the
+# prefetched exchange stays one collective round per group
+GROUPED_PLANES = ("a2a+grouped", "a2a+grouped+pipelined")
 
 # array offset streams are int32: a group's concatenated padded vocabs
 # must stay addressable (the planner splits groups at this boundary)
@@ -145,14 +149,21 @@ def plan_groups(collection, names, *, read_only: bool = False
     for name in ordered:
         spec = collection.specs[name]
         ss = collection.sharding_spec(name)
-        if ss.plane != GROUPED_PLANE:
-            raise ValueError(f"{name!r} is not on the {GROUPED_PLANE} plane")
+        if ss.plane not in GROUPED_PLANES:
+            raise ValueError(f"{name!r} is not on a grouped plane "
+                             f"({GROUPED_PLANES})")
+        # ss.plane is part of the key: a plain-grouped and a
+        # grouped+pipelined table must never share a plan — the
+        # per-plan timing attribution labels by member plane, and the
+        # Trainer pulls the two sets at different schedule points anyway
         if spec.use_hash:
-            key = ("hash", spec.key_dtype, dim_bucket(spec.output_dim),
+            key = ("hash", ss.plane, spec.key_dtype,
+                   dim_bucket(spec.output_dim),
                    ss.num_shards, ss.data_axis, ss.model_axis,
                    ss.a2a_capacity, ss.a2a_slack, spec.dtype)
         else:
-            key = ("array", dim_bucket(spec.output_dim), ss.num_shards,
+            key = ("array", ss.plane, dim_bucket(spec.output_dim),
+                   ss.num_shards,
                    ss.layout, ss.data_axis, ss.model_axis,
                    ss.a2a_capacity, ss.a2a_slack, spec.dtype)
         buckets.setdefault(key, []).append(name)
@@ -170,20 +181,20 @@ def plan_groups(collection, names, *, read_only: bool = False
                     slot_names=tuple(collection.optimizer(n).slot_shapes(
                         collection.specs[n].output_dim)))
                 for n in group_names)
-            plans.append(GroupPlan(kind="hash", bucket_dim=key[2],
-                                   key_dtype=key[1], members=members))
+            plans.append(GroupPlan(kind="hash", bucket_dim=key[3],
+                                   key_dtype=key[2], members=members))
             continue
         # array: accumulate members until the offset space would overflow
         run, span = [], 0
         for n in group_names:
             ss = collection.sharding_spec(n)
             if run and span + ss.padded_vocab > _MAX_OFFSET_SPAN:
-                plans.append(_array_plan(collection, tuple(run), key[1]))
+                plans.append(_array_plan(collection, tuple(run), key[2]))
                 run, span = [], 0
             run.append(n)
             span += ss.padded_vocab
         if run:
-            plans.append(_array_plan(collection, tuple(run), key[1]))
+            plans.append(_array_plan(collection, tuple(run), key[2]))
     plans.sort(key=lambda p: collection.variable_id(p.members[0].name))
     return tuple(plans)
 
@@ -650,7 +661,7 @@ def pull_grouped(collection, states, idx_map: Dict[str, jnp.ndarray], *,
                     + [states[n].weights for n in names]
                     + [states[n].init_rng for n in names] + idxs)
         res = observability.plane_timed(
-            "pull", GROUPED_PLANE, record, fn, *args)
+            "pull", plan.members[0].spec.plane, record, fn, *args)
         if host_record:
             _record_group(plan, idxs,
                           states[names[0]].weights.dtype.itemsize)
@@ -677,7 +688,7 @@ def apply_gradients_grouped(collection, states,
         if plan.kind == "array":
             fn = _array_push_program(mesh, plan, batch_sharded, record)
             res = observability.plane_timed(
-                "push", GROUPED_PLANE, record, fn,
+                "push", plan.members[0].spec.plane, record, fn,
                 *([states[n].weights for n in names]
                   + [states[n].slots for n in names] + idxs + grads))
             for n, (w, s) in zip(names, res):
@@ -685,7 +696,7 @@ def apply_gradients_grouped(collection, states,
         else:
             fn = _hash_push_program(mesh, plan, batch_sharded, record)
             res = observability.plane_timed(
-                "push", GROUPED_PLANE, record, fn,
+                "push", plan.members[0].spec.plane, record, fn,
                 *([states[n].keys for n in names]
                   + [states[n].weights for n in names]
                   + [states[n].slots for n in names]

@@ -1,0 +1,132 @@
+"""Pipelined exchange plane: overlap the sparse exchange with dense compute
+(``plane="a2a+pipelined"``, ``"a2a+grouped+pipelined"``).
+
+The reference dedicates a whole TF-op layer to hiding embedding-exchange
+latency behind dense compute: ``PrefetchPullWeights`` issues the pull RPCs
+for a FUTURE batch from the input pipeline while the current batch's dense
+fwd/bwd runs, and the server's pending-pull queue holds each prefetched
+pull until the previous batch's push has committed — a per-batch version
+barrier, so prefetching never changes the numbers (SURVEY L5:
+``exb_ops.cpp:109-205``, ``Prefetch.h``, ``EmbeddingPullOperator.cpp:
+125-141``). Every plane here ran pull -> dense -> push strictly
+serialized inside one jitted step, with the whole exchange on the
+critical path.
+
+This module is that prefetch layer, TPU-native: ONE jitted SPMD step
+program per batch whose dataflow is re-cut so the exchange can overlap
+the dense dots —
+
+* **rows are double-buffered**: step N's dense fwd/bwd consumes the rows
+  buffer pulled by step N-1's program (a :class:`PipelineState` input,
+  donated — the in/out row buffers alternate in place), so the dense
+  compute depends on NO collective of its own program;
+* **the prefetch pull for batch N+1 rides step N's program**: its
+  dedup/bucketize/key-leg collectives depend only on the (input) index
+  stream, so XLA's scheduler is free to run them concurrently with the
+  dense dots — the async-start/async-done overlap the contract audits;
+* **the version barrier is an op dependency**: the prefetched pull's
+  row RESOLUTION reads the tables produced by step N's push, exactly
+  like the reference's server holding prefetched pulls until the
+  previous batch commits. This is what keeps the plane bit-identical
+  to ``"a2a"``: the op order on every table is
+  ``..., push(N), pull(N+1), push(N+1), ...`` — the serial plane's
+  order with the step boundaries cut one pull earlier.
+
+Schedule of step N's program (steady state)::
+
+      dense fwd/bwd(N)  ∥  pull(N+1) index+key legs     <- overlapped
+              |                      |
+         push(N) commit ------------>|                  <- version barrier
+                                     v
+                          pull(N+1) row resolution      -> rows buffer N+1
+
+A deliberately *delayed* push (push(N-1) riding step N, the textbook
+software-pipelining cut) would hide the push too — but then pull(N+1)
+could never observe push(N) and every overlapping key trains on
+one-step-stale rows: NOT equivalent to ``"a2a"``. The reference makes
+the same call (the version barrier), so this plane does; the pending
+gradients therefore never outlive their own step program and the only
+pipeline state is the pulled-row double buffer.
+
+Drain semantics: the tables are authoritative after EVERY step (no
+pending pushes), so eval needs no drain at all and "draining" just
+discards the prefetched row buffer (:func:`drain` /
+``Trainer.drain_pipeline``). A warmup prologue (:func:`prime`) fills
+the buffer for the first batch — the same eager pull program the plain
+``"a2a"`` plane would have run, so results are bit-identical at any
+drain point.
+
+Composition matrix: ``"a2a+grouped+pipelined"`` variables batch their
+prefetched exchange into one collective round per GROUP
+(``parallel/grouped.py``); plain ``"a2a"``/``"psum"``/``"a2a+cache"``
+variables in the same model keep their in-step serial pull (the cache
+plane's host-side admission refresh rewrites replica state between
+steps, which a prefetched buffer cannot see — the refresh is
+value-preserving for the TABLE, so the two planes compose side by side
+but do not stack). Offloaded variables must NOT be pipelined: their
+host->HBM cache inserts mutate table state between the prefetch and the
+consuming step.
+
+Per-table entry points (serving probes, checkpoint paths,
+``pull_sharded`` on a pipelined spec) run the plain ``"a2a"`` program —
+like the grouped plane, pipelining exists only at the Trainer level.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from flax import struct
+
+PIPELINED_PLANES = ("a2a+pipelined", "a2a+grouped+pipelined")
+
+
+@struct.dataclass
+class PipelineState:
+    """The pipeline's only cross-step state: the prefetched row buffer.
+
+    ``rows[name]`` holds the (pooled, batch-sharded) rows the NEXT
+    batch's dense compute will consume, pulled AFTER the producing
+    step's push committed (the version barrier). Threaded through
+    ``TrainState.pipe`` and donated with it, so the in/out buffers
+    double-buffer in place. Derived state: never checkpointed — a
+    restore re-primes from the authoritative tables.
+    """
+
+    rows: Dict[str, jnp.ndarray]
+
+
+def split_columns(collection, inputs: Dict[str, Any]):
+    """(pipelined, inline) partition of a batch's sparse columns."""
+    pipelined = frozenset(collection.pipelined_names())
+    pre = {n: v for n, v in inputs.items() if n in pipelined}
+    inline = {n: v for n, v in inputs.items() if n not in pipelined}
+    return pre, inline
+
+
+def prefetch_pull(collection, states, inputs: Dict[str, Any], *,
+                  batch_sharded: bool = True) -> PipelineState:
+    """Pull the pipelined columns of ``inputs`` into a fresh row buffer.
+
+    Called inside the step program (tables post-push: the version
+    barrier) AND eagerly by the warmup prologue / re-prime path — both
+    run the same ``EmbeddingCollection.pull`` the serial plane runs, so
+    grouped members batch into group rounds and pooled members come
+    back combined. Exactness follows: the buffer holds exactly what a
+    serial step's own pull would have produced.
+    """
+    pre, _ = split_columns(collection, inputs)
+    return PipelineState(rows=collection.pull(states, pre,
+                                              batch_sharded=batch_sharded))
+
+
+def drain(state):
+    """Discard the prefetched row buffer (``TrainState.pipe`` -> None).
+
+    The tables are authoritative after every step — draining loses no
+    updates, it only forfeits the prefetch (the next step re-primes).
+    """
+    if getattr(state, "pipe", None) is None:
+        return state
+    return state.replace(pipe=None)

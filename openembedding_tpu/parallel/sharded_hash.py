@@ -33,6 +33,7 @@ from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import hash_table as hash_lib
 from . import alltoall as a2a
 from . import hot_cache
+from . import sharded_table as st
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -45,7 +46,7 @@ class HashShardingSpec:
     max_probes: int = hash_lib.DEFAULT_MAX_PROBES
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
-    plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache" | "a2a+grouped"
+    plane: str = "a2a"   # sharded_table.PLANES member
     a2a_capacity: int = 0
     a2a_slack: float = 2.0
     key_width: int = 32  # 64 = [n, 2] int32 (lo, hi) pairs, x64-off
@@ -58,11 +59,17 @@ class HashShardingSpec:
     @property
     def is_grouped(self) -> bool:
         """Collection-level multi-table exchange (``parallel/grouped.py``)."""
-        return self.plane == "a2a+grouped"
+        return self.plane in ("a2a+grouped", "a2a+grouped+pipelined")
+
+    @property
+    def is_pipelined(self) -> bool:
+        """Trainer-level double-buffered exchange schedule
+        (``parallel/pipelined.py``)."""
+        return self.plane in ("a2a+pipelined", "a2a+grouped+pipelined")
 
     @property
     def shard_axes(self) -> tuple:
-        if self.plane in ("a2a", "a2a+cache", "a2a+grouped"):
+        if self.plane != "psum":
             return (self.data_axis, self.model_axis)
         return (self.model_axis,)
 
@@ -102,7 +109,7 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
     ``plane="a2a+cache"``: a2a layout plus a ``cache_k``-row hot-row replica
     on every device (``parallel/hot_cache.py``); 0 picks the default size.
     """
-    if plane not in ("a2a", "psum", "a2a+cache", "a2a+grouped"):
+    if plane not in st.PLANES:
         raise ValueError(f"unknown plane {plane!r}")
     if key_width not in (32, 64):
         raise ValueError(f"key_width must be 32 or 64, got {key_width}")
@@ -323,7 +330,7 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
 
     # a grouped-plane table addressed PER TABLE takes the plain a2a
     # program — grouping only exists at the collection level
-    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+    if (spec.plane != "psum" and spec.num_shards > 1) \
             or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
@@ -451,7 +458,7 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                    slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+    if (spec.plane != "psum" and spec.num_shards > 1) \
             or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)

@@ -44,6 +44,15 @@ Data planes (``ShardingSpec.plane``):
   (``parallel/grouped.py``): a T-table model pays O(#groups) collective
   rounds instead of O(T). Per-table calls on this plane (serving probes,
   checkpoint paths) behave exactly like ``"a2a"``.
+* ``"a2a+pipelined"`` — the a2a layout, but the TRAINER double-buffers
+  the exchange (``parallel/pipelined.py``): batch N+1's rows are pulled
+  inside step N's jitted program (after step N's push commits, so
+  results stay bit-identical to ``"a2a"``) and the pull's index/key-leg
+  collectives overlap step N's dense compute. Per-table calls behave
+  exactly like ``"a2a"`` — the plane only changes the step schedule.
+* ``"a2a+grouped+pipelined"`` — both: grouped collection-level exchange
+  AND the pipelined step schedule, so the prefetched exchange is one
+  collective round per GROUP.
 """
 
 from __future__ import annotations
@@ -72,6 +81,13 @@ from . import hot_cache
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
+# every plane riding the owner-routed exchange layout (tables sharded
+# over the whole mesh grid); "psum" is the lone broadcast-style ablation
+A2A_PLANES = ("a2a", "a2a+cache", "a2a+grouped", "a2a+pipelined",
+              "a2a+grouped+pipelined")
+PLANES = A2A_PLANES + ("psum",)
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardingSpec:
     """Static description of how one table is laid out on the mesh."""
@@ -82,6 +98,7 @@ class ShardingSpec:
     data_axis: str = DATA_AXIS
     model_axis: str = MODEL_AXIS
     plane: str = "a2a"   # "a2a" | "psum" | "a2a+cache" | "a2a+grouped"
+                         # | "a2a+pipelined" | "a2a+grouped+pipelined"
     a2a_capacity: int = 0    # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0   # auto capacity = slack * mean bucket size
     cache_k: int = 0         # hot-row replica slots ("a2a+cache" plane)
@@ -93,12 +110,18 @@ class ShardingSpec:
     @property
     def is_grouped(self) -> bool:
         """Collection-level multi-table exchange (``parallel/grouped.py``)."""
-        return self.plane == "a2a+grouped"
+        return self.plane in ("a2a+grouped", "a2a+grouped+pipelined")
+
+    @property
+    def is_pipelined(self) -> bool:
+        """Trainer-level double-buffered exchange schedule
+        (``parallel/pipelined.py``)."""
+        return self.plane in ("a2a+pipelined", "a2a+grouped+pipelined")
 
     @property
     def shard_axes(self) -> tuple:
         """Mesh axes the table's row dimension is sharded over."""
-        if self.plane in ("a2a", "a2a+cache", "a2a+grouped"):
+        if self.plane != "psum":
             return (self.data_axis, self.model_axis)
         return (self.model_axis,)
 
@@ -139,7 +162,7 @@ def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
     """
     if layout not in ("mod", "div"):
         raise ValueError(f"unknown layout {layout!r}")
-    if plane not in ("a2a", "psum", "a2a+cache", "a2a+grouped"):
+    if plane not in PLANES:
         raise ValueError(f"unknown plane {plane!r}")
     want = mesh.shape[MODEL_AXIS] if plane == "psum" else mesh.size
     if num_shards == -1:
@@ -300,7 +323,7 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
     # grouped-plane table addressed PER TABLE (serving probes, checkpoint
     # paths) takes the plain a2a program — grouping only exists at the
     # collection level.
-    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+    if (spec.plane != "psum" and spec.num_shards > 1) \
             or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
@@ -414,7 +437,7 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                    slot_names: tuple, record_stats: bool = False):
     batch_spec = P(spec.data_axis) if batch_sharded else P()
 
-    if (spec.plane in ("a2a", "a2a+grouped") and spec.num_shards > 1) \
+    if (spec.plane != "psum" and spec.num_shards > 1) \
             or spec.is_cached:
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)

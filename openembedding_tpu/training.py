@@ -35,6 +35,7 @@ from .analysis.concurrency import sync_point
 from .analysis.retrace import RetraceGuard
 from .utils import observability
 from .embedding import EmbeddingCollection
+from .parallel import pipelined as pipeline_lib
 from .parallel.mesh import DATA_AXIS
 
 
@@ -46,6 +47,10 @@ class TrainState:
     params: Any                  # flax dense params, replicated
     opt_state: Any               # optax state for the dense params
     emb: Dict[str, Any]          # embedding states (sharded over model axis)
+    # pipelined-plane prefetched row buffer (parallel/pipelined.py);
+    # None outside the pipelined schedule. Derived state: checkpoints
+    # never carry it, a restore re-primes from the tables
+    pipe: Any = None
 
 
 def binary_logloss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -136,6 +141,26 @@ class Trainer:
                 if base is not None:
                     mgr.share_sketch(base)
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # pipelined-exchange plane (parallel/pipelined.py): variables
+        # whose pull is double-buffered through the step program. The
+        # offload tier's host->HBM inserts mutate table state BETWEEN
+        # steps — a prefetched buffer cannot see them, so the two
+        # schedules must not share a variable.
+        self._pipelined = collection.pipelined_names()
+        clash = sorted(set(self._pipelined) & set(self.offload))
+        if clash:
+            raise ValueError(
+                f"offloaded variable(s) {clash} cannot ride a pipelined "
+                "plane: offload host-prepare inserts rows between steps, "
+                "invalidating the prefetched row buffer")
+        self._pipelined_step = None
+        # the batch the live row buffer was prefetched FOR plus the
+        # identity of the buffer it lives in (host-side, like the
+        # offload prep queue); the buffer id catches a caller replaying
+        # an OLD state object — its pipe holds a different batch's rows
+        # even when the batch argument matches, and must re-prime
+        self._pipe_for = None
+        self._pipe_token = None
         # in-flight lookahead prepares, oldest first; each entry's thread
         # CHAINS on the previous one, so host_prepare calls run strictly
         # in batch order (the planned-residency bookkeeping requires it)
@@ -188,29 +213,140 @@ class Trainer:
                           opt_state=opt_state, emb=emb)
 
     # --- steps ---------------------------------------------------------------
+    def _dense_update_and_push(self, state: TrainState, batch, rows,
+                               pull_inputs, dense_ids):
+        """Shared core of the serial AND pipelined step programs: loss
+        + grads on ``rows``, dense optimizer update, sparse push. ONE
+        definition traced by both schedules — the pipelined plane's
+        exact-equivalence guarantee rests on them never diverging."""
+        def lfn(params, rows):
+            logits = self._apply(params, batch.get("dense"), rows,
+                                 dense_ids)
+            return self.loss_fn(logits, batch["label"])
+
+        loss, (dense_g, row_g) = jax.value_and_grad(
+            lfn, argnums=(0, 1))(state.params, rows)
+        updates, opt_state = self.tx.update(dense_g, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+        emb = self.collection.apply_gradients(state.emb, pull_inputs,
+                                              row_g)
+        return params, opt_state, emb, loss
+
     def _build_train_step(self):
-        collection, tx, loss_fn = self.collection, self.tx, self.loss_fn
+        collection = self.collection
 
         def step_fn(state: TrainState, batch) -> tuple:
             pull_inputs, dense_ids = self._split_sparse(batch["sparse"])
             rows = collection.pull(state.emb, pull_inputs)
-
-            def lfn(params, rows):
-                logits = self._apply(params, batch.get("dense"), rows,
-                                     dense_ids)
-                return loss_fn(logits, batch["label"])
-
-            loss, (dense_g, row_g) = jax.value_and_grad(
-                lfn, argnums=(0, 1))(state.params, rows)
-            updates, opt_state = tx.update(dense_g, state.opt_state,
-                                           state.params)
-            params = optax.apply_updates(state.params, updates)
-            emb = collection.apply_gradients(state.emb, pull_inputs, row_g)
+            params, opt_state, emb, loss = self._dense_update_and_push(
+                state, batch, rows, pull_inputs, dense_ids)
             new_state = TrainState(step=state.step + 1, params=params,
                                    opt_state=opt_state, emb=emb)
             return new_state, {"loss": loss}
 
         return jax.jit(step_fn, donate_argnums=(0,))
+
+    # --- pipelined-exchange schedule (parallel/pipelined.py) ---------------
+    @property
+    def pipeline_plane(self) -> str:
+        """Step-span label for the pipelined schedule (plane_timings)."""
+        if self._pipelined and all(
+                self.collection.sharding_spec(n).is_grouped
+                for n in self._pipelined):
+            return "a2a+grouped+pipelined"
+        return "a2a+pipelined"
+
+    def _build_pipelined_train_step(self, force_serialize: bool = False):
+        """One SPMD program per step N: dense fwd/bwd(N) on the
+        PREFETCHED row buffer (no collective ahead of the dots), push(N)
+        commit, then the prefetch pull for batch N+1 — whose index/
+        key-leg collectives depend only on the input index stream, so
+        XLA overlaps them with the dense compute, while its row
+        resolution reads the post-push tables (the reference's
+        per-batch version barrier as an op dependency: bit-identical to
+        the serial ``"a2a"`` schedule). ``force_serialize`` is the
+        negative-contract knob: it routes the loss into the prefetch
+        indices (a zero-valued but real dependency), re-serializing the
+        program — the overlap contract must catch it.
+        """
+        collection = self.collection
+
+        def pipelined_step_fn(state: TrainState, batch, next_pull) -> tuple:
+            pull_inputs, dense_ids = self._split_sparse(batch["sparse"])
+            _pre, inline = pipeline_lib.split_columns(collection,
+                                                      pull_inputs)
+            rows = dict(state.pipe.rows)
+            if inline:
+                # non-pipelined variables (psum/cache members of a mixed
+                # model) keep their serial in-step pull
+                rows.update(collection.pull(state.emb, inline))
+            params, opt_state, emb, loss = self._dense_update_and_push(
+                state, batch, rows, pull_inputs, dense_ids)
+            if force_serialize:
+                zero = (loss * 0).astype(jnp.int32)
+                next_pull = {n: v + zero.astype(v.dtype)
+                             for n, v in next_pull.items()}
+            pipe = pipeline_lib.prefetch_pull(collection, emb, next_pull)
+            new_state = TrainState(step=state.step + 1, params=params,
+                                   opt_state=opt_state, emb=emb, pipe=pipe)
+            return new_state, {"loss": loss}
+
+        return jax.jit(pipelined_step_fn, donate_argnums=(0,))
+
+    def _prime_pipeline(self, state: TrainState, batch) -> TrainState:
+        """Warmup prologue / re-prime: pull ``batch``'s pipelined rows
+        eagerly from the authoritative tables (the exact pull a serial
+        step would have opened with) into a fresh buffer."""
+        pull_inputs, _ = self._split_sparse(batch["sparse"])
+        pre, _ = pipeline_lib.split_columns(self.collection, pull_inputs)
+        pipe = pipeline_lib.prefetch_pull(self.collection, state.emb,
+                                          self.shard_batch(pre))
+        return state.replace(pipe=pipe)
+
+    def drain_pipeline(self, state: TrainState) -> TrainState:
+        """Discard the prefetched row buffer. The tables are
+        authoritative after every step (the pipelined schedule leaves no
+        pending pushes), so draining loses nothing — the next
+        ``train_step`` re-primes. Eval needs no drain at all."""
+        self._pipe_for = None
+        self._pipe_token = None
+        return pipeline_lib.drain(state)
+
+    def _pipelined_train_step(self, state: TrainState, batch,
+                              next_batch) -> tuple:
+        if self._pipelined_step is None:
+            self._pipelined_step = self._build_pipelined_train_step()
+        if state.pipe is None or self._pipe_for is not batch \
+                or self._pipe_token != id(state.pipe):
+            # first step, drain, a batch the lookahead didn't predict,
+            # or a REPLAYED older state (its buffer holds some other
+            # batch's rows): fill the pipeline for THIS batch now.
+            # NOTE the lookahead is keyed on batch OBJECT IDENTITY
+            # (like the offload prep queue): a driver that rebuilds a
+            # value-equal batch dict per step misses EVERY time and
+            # pays the in-program prefetch (discarded) PLUS this eager
+            # re-prime — two exchanges per step, slower than serial.
+            # The counter makes that visible: a steady fit loop primes
+            # exactly once.
+            observability.GLOBAL.add("pipeline_primes", 1)
+            state = self._prime_pipeline(state, batch)
+        nxt = next_batch if next_batch is not None else batch
+        next_inputs, _ = self._split_sparse(nxt["sparse"])
+        pre, _ = pipeline_lib.split_columns(self.collection, next_inputs)
+        # whole-step wall time recorded under the plane (gated, blocking;
+        # the in-program pull/push are NOT separable host-side — see
+        # observability.plane_timings overlap attribution)
+        record = observability.evaluate_performance()
+        state, metrics = observability.plane_timed(
+            "step", self.pipeline_plane, record, self._pipelined_step,
+            state, self.shard_batch(batch), self.shard_batch(pre))
+        # a lookahead miss self-prefetches the CURRENT batch — still a
+        # valid buffer if the caller steps the same batch again (single-
+        # batch smoke loops); any other batch re-primes
+        self._pipe_for = nxt
+        self._pipe_token = id(state.pipe)
+        return state, metrics
 
     def _build_eval_step(self):
         collection = self.collection
@@ -236,8 +372,20 @@ class Trainer:
         up to ``pipeline_depth`` prepared batches in flight automatically;
         callers driving steps by hand pass ``next_batch`` themselves (or
         skip it and keep the serial path).
+
+        With pipelined-plane variables in the collection, ``next_batch``
+        additionally feeds the prefetch: batch N+1's pull rides THIS
+        step's jitted program (``parallel/pipelined.py``). The
+        lookahead is keyed on batch OBJECT IDENTITY (like the offload
+        prep queue): pass the SAME object you will step next, not a
+        rebuilt copy — a value-equal copy misses and the plane pays a
+        discarded prefetch plus an eager re-prime every step (the
+        ``pipeline_primes`` counter stays at 1 over a correct steady
+        loop). Without ``next_batch`` the step self-prefetches and the
+        next call re-primes eagerly — correct at any call pattern, just
+        unoverlapped.
         """
-        if self._train_step is None:
+        if self._train_step is None and not self._pipelined:
             self._train_step = self._build_train_step()
         # graftscope: one span per whole host-visible step, with
         # StepTraceAnnotation pass-through so a concurrent jax.profiler
@@ -250,8 +398,12 @@ class Trainer:
                 # default like the reference's accumulators
                 observability.record_batch_stats(batch["sparse"])
                 state, uniqs = self._apply_prepared_offload(state, batch)
-                state, metrics = self._train_step(state,
-                                                  self.shard_batch(batch))
+                if self._pipelined:
+                    state, metrics = self._pipelined_train_step(
+                        state, batch, next_batch)
+                else:
+                    state, metrics = self._train_step(
+                        state, self.shard_batch(batch))
                 for name, table in self.offload.items():
                     table.note_update(batch["sparse"][name],
                                       uniq=uniqs.get(name))
@@ -500,7 +652,9 @@ class Trainer:
                             self._start_host_prepare(b)
                 batch = window.popleft()
                 refill()
-                state, metrics = self.train_step(state, batch)
+                state, metrics = self.train_step(
+                    state, batch,
+                    next_batch=window[0] if window else None)
                 last = metrics
                 if retrace_budget is not None and guard is None and i >= 1:
                     # two-step warmup: step 1 compiles the step program,
