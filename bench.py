@@ -1202,6 +1202,118 @@ print(json.dumps({{"gb": nbytes / 1e9, "save_s": save_s,
     }
 
 
+def run_ckpt_delta_ab(name, config, *, steps, warmup):
+    """Delta-checkpoint A/B on the dim9 table: parallel-writer FULL save
+    (vs the serialized writer path on the same window) vs dirty-chunk
+    DELTA save (~``dirty_frac`` of rows touched) vs base+chain
+    load-replay. Measured on THIS backend where the disk is local —
+    the committed 0.07x tpu1 entry was bound by the tunneled
+    device->host link, which writer parallelism cannot move; record
+    cpu8 entries with honest notes (delta bytes and writer speedup are
+    the claims, not the absolute link rate)."""
+    import os
+    import shutil
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as ckpt
+    from openembedding_tpu import checkpoint_delta as cdel
+    from openembedding_tpu.parallel.mesh import create_mesh
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    mesh = create_mesh(1, n_dev)
+    vocab, dim = config["vocab"], config["dim"]
+    repeats = config.get("repeats", 3)
+    dirty_frac = config.get("dirty_frac", 0.05)
+    chunks = config.get("chunks", 1024)
+    coll = EmbeddingCollection(
+        (EmbeddingSpec(name="big", input_dim=vocab, output_dim=dim,
+                       optimizer={"category": "adagrad",
+                                  "learning_rate": 0.01}),), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    jax.block_until_ready(jax.tree.leaves(states))
+    base = tempfile.mkdtemp(prefix="bench_ckpt_delta_")
+    try:
+        # -- full save: serialized writer baseline, then the parallel pool
+        d = os.path.join(base, "serial")
+        t0 = time.perf_counter()
+        info = ckpt.save_checkpoint(d, coll, states, max_workers=1)
+        serial_s = time.perf_counter() - t0
+        full_bytes = info["bytes"]
+        shutil.rmtree(d)
+        full_times = []
+        for r in range(repeats):
+            d = os.path.join(base, f"full{r}")
+            t0 = time.perf_counter()
+            ckpt.save_checkpoint(d, coll, states)
+            full_times.append(time.perf_counter() - t0)
+            shutil.rmtree(d)
+        gbps = [full_bytes / t / 1e9 for t in full_times]
+
+        # -- delta save: dirty ~dirty_frac of rows, write only their chunks
+        coll.enable_dirty_tracking(target_chunks=chunks)
+        ddir = os.path.join(base, "delta")
+        ckpt.save_checkpoint(ddir, coll, states, mode="delta", step=0)
+        n_dirty = max(1, int(vocab * dirty_frac))
+        ids = jnp.arange(n_dirty, dtype=jnp.int32)
+        rows = coll.pull(states, {"big": ids}, batch_sharded=False)
+        states = coll.apply_gradients(
+            states, {"big": ids}, {"big": jnp.ones_like(rows["big"])},
+            batch_sharded=False)
+        jax.block_until_ready(jax.tree.leaves(states))
+        delta_times = []
+        delta_bytes = 0
+        for r in range(repeats):
+            if r:
+                # re-mark the same rows: each repeat writes a real delta
+                coll.mark_dirty({"big": np.arange(n_dirty)})
+            info = cdel.save_delta(
+                ddir, coll, states, step=r + 1,
+                compact_chain_len=10**6, compact_bytes_ratio=1e18,
+                background_compact=False)
+            delta_times.append(info["seconds"])
+            delta_bytes = info["bytes"]
+
+        # -- load-replay: base + the chain written above
+        t0 = time.perf_counter()
+        loaded = ckpt.load_checkpoint(ddir, coll)
+        jax.block_until_ready(jax.tree.leaves(loaded))
+        load_s = time.perf_counter() - t0
+        probe = jnp.arange(min(vocab, 4096), dtype=jnp.int32)
+        exact = bool((np.asarray(
+            coll.pull(states, {"big": probe}, batch_sharded=False)["big"])
+            == np.asarray(coll.pull(loaded, {"big": probe},
+                                    batch_sharded=False)["big"])).all())
+        del loaded
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    best = max(gbps)
+    return {
+        "metric": f"{name}_full_gbps_{platform}{n_dev}",
+        "value": round(best, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(best / REF_CKPT_GBPS, 2),
+        "gbps_min": round(min(gbps), 3),
+        "gbps_max": round(max(gbps), 3),
+        "ckpt_gb": round(full_bytes / 1e9, 3),
+        "full_save_s": round(min(full_times), 3),
+        "serial_save_s": round(serial_s, 3),
+        "parallel_speedup": round(serial_s / min(full_times), 2),
+        "delta_save_s": round(min(delta_times), 4),
+        "delta_bytes": int(delta_bytes),
+        "full_bytes": int(full_bytes),
+        "delta_vs_full_bytes": round(full_bytes / max(1, delta_bytes), 1),
+        "dirty_frac": dirty_frac,
+        "ckpt_delta_gbps": round(delta_bytes / max(min(delta_times), 1e-9)
+                                 / 1e9, 3),
+        "load_replay_s": round(load_s, 2),
+        "replay_exact": exact,
+        "config": dict(config),
+    }
+
+
 # The matrix: the reference benchmarks WDL/DeepFM/xDeepFM at dims 9 and 64
 # over hashed Criteo ids (benchmark.md). "vocab" is PER FEATURE (26 features
 # -> total rows = 26 * vocab): bigvocab lands at 26 * 2^22 ~= 2^26.7 total
@@ -1305,6 +1417,11 @@ CONFIGS = {
     # tunneled device->host link is not the thing being measured)
     "ckpt_local_2gb": {"kind": "ckpt_local", "vocab": 1 << 25, "dim": 8,
                        "devices": 4},
+    # delta-checkpoint A/B (checkpoint_delta.py): parallel-writer full
+    # save vs serialized writer vs ~5%-dirty delta save vs base+chain
+    # load-replay, on the dim9 table shape
+    "ckpt_delta_ab": {"kind": "ckpt_delta_ab", "dim": 9, "vocab": 1 << 22,
+                      "dirty_frac": 0.05, "chunks": 1024, "repeats": 3},
     # serving data plane: binary (default) vs JSON lookup latency against a
     # live replica daemon; value = binary ms, vs_baseline = json/bin ratio
     "serving_lookup": {"kind": "serving_lookup", "vocab": 1 << 16,
@@ -1315,6 +1432,7 @@ RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
            "cache_ab": run_cache_ab, "pipelined_ab": run_pipelined_ab,
            "hash_probe": run_hash_probe,
            "auc": run_auc_criteo, "ckpt_local": run_ckpt_local,
+           "ckpt_delta_ab": run_ckpt_delta_ab,
            "serving_lookup": run_serving_lookup,
            "plane_parity": run_plane_parity}
 
@@ -1450,7 +1568,7 @@ def wait_device_healthy(retry_for_s, interval_s, probe_timeout_s=300):
 # backend — faster, no HBM pollution, and a wedged tunnel cannot erase
 # them (their metric name records the platform)
 DEVICELESS = frozenset({"serving_lookup", "ckpt_local_2gb", "auc_criteo",
-                        "plane_parity"})
+                        "plane_parity", "ckpt_delta_ab"})
 
 
 def run_suite_isolated(names, steps, timeout_s=3600, profile=""):

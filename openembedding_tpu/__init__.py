@@ -31,5 +31,6 @@ from .hybrid import (DenseEmbeddings, DenseFeatureSpec, HybridModel,
                      split_sparse_dense)
 from .ragged import pad_ragged, pad_id_for, pool_rows
 from .offload import HostOffloadedTable, ShardedOffloadedTable
+from .dirty import DirtyTracker
 from . import distributed
 from .training import Trainer, TrainState, binary_logloss

@@ -36,6 +36,9 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+from collections import deque
+from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
@@ -44,6 +47,7 @@ import numpy as np
 from flax import serialization
 
 from .analysis import scope
+from .analysis.concurrency import sync_point
 from .embedding import EmbeddingCollection
 from .meta import ModelMeta
 from . import hash_table as hash_lib
@@ -132,6 +136,109 @@ def _sync(name: str) -> None:
         multihost_utils.sync_global_devices(name)
 
 
+# --- parallel shard writers --------------------------------------------------
+
+# window granularity of the PARALLEL full-save path: small enough that a
+# single-table dump still fans out across writers, large enough that each
+# task's file region writes sequentially at disk bandwidth
+_PAR_WINDOW_BYTES = 32 << 20
+
+
+def _default_writers() -> int:
+    """Writer-thread pool width (``OE_CKPT_WRITERS`` overrides; 1 =
+    serialized, the pre-parallel behavior bit-for-bit)."""
+    env = os.environ.get("OE_CKPT_WRITERS", "")
+    if env:
+        return max(1, int(env))
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+def _run_writers(tasks, *, max_workers: Optional[int] = None) -> None:
+    """Run writer callables on a bounded pool of named, joined threads.
+
+    The parallelism unit of both the full-save and delta-save paths:
+    every task owns a DISJOINT file region (its own file, or its own
+    window/shard slice of a pre-sized memmap), so tasks never contend on
+    bytes — only on the device-get and disk queues, which is the point
+    (device->host streams for shard A overlap disk writes for shard B).
+    Threads are non-daemon and always joined here (graftrace JG104);
+    the first task error is re-raised after the join, remaining queued
+    tasks are abandoned (their files are tmp/partial debris the next
+    save's GC or overwrite cleans up).
+    """
+    tasks = deque(tasks)
+    if not tasks:
+        return
+    n = min(len(tasks), max_workers or _default_writers())
+    if n <= 1:
+        while tasks:
+            sync_point("ckpt.writer.run")
+            tasks.popleft()()
+        return
+    errs: list = []
+
+    def _drain():
+        while not errs:
+            try:
+                task = tasks.popleft()   # deque.popleft is atomic
+            except IndexError:
+                return
+            try:
+                sync_point("ckpt.writer.run")
+                task()
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                errs.append(e)
+                return
+
+    threads = [threading.Thread(target=_drain, daemon=False,
+                                name=f"oe-ckpt-writer-{i}")
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        raise errs[0]
+
+
+def _sorted_shards(arr):
+    return sorted((s for s in arr.addressable_shards if s.replica_id == 0),
+                  key=lambda s: s.index[0].start or 0)
+
+
+def gather_logical_window(shards, sspec: st.ShardingSpec, l0: int, l1: int,
+                          row_shape: tuple, dtype) -> np.ndarray:
+    """Assemble logical rows ``[l0, l1)`` of a sharded array into one host
+    buffer. Each shard's contribution is a CONTIGUOUS device slice (bulk
+    transfer); the mod-layout interleave happens in the staging buffer.
+    Shared by the full-save window writers and the delta-chunk writers.
+    """
+    S, rps = sspec.num_shards, sspec.rows_per_shard
+    buf = np.empty((l1 - l0,) + row_shape, dtype)
+    for sh_ in shards:
+        p0 = sh_.index[0].start or 0
+        s = p0 // rps
+        if sspec.layout == "mod":
+            # shard s owns logical ids l = local * S + s
+            lo_s = max(0, -(-(l0 - s) // S))
+            hi_s = max(0, -(-(l1 - s) // S))
+            hi_s = min(hi_s, sh_.data.shape[0])
+            if hi_s <= lo_s:
+                continue
+            block = np.asarray(jax.device_get(sh_.data[lo_s:hi_s]))
+            a = s + lo_s * S - l0
+            buf[a:a + (hi_s - lo_s - 1) * S + 1:S] = block
+        else:
+            # div layout: logical == physical position
+            a = max(l0, p0)
+            b = min(l1, p0 + sh_.data.shape[0])
+            if b <= a:
+                continue
+            block = np.asarray(jax.device_get(sh_.data[a - p0:b - p0]))
+            buf[a - l0:b - l0] = block
+    return buf
+
+
 def save_checkpoint(path: str,
                     collection: EmbeddingCollection,
                     states: Dict[str, Any],
@@ -139,7 +246,10 @@ def save_checkpoint(path: str,
                     dense_state: Any = None,
                     include_optimizer: bool = True,
                     model_sign: str = "",
-                    compress: str = "") -> None:
+                    compress: str = "",
+                    mode: str = "full",
+                    step: int = 0,
+                    max_workers: Optional[int] = None) -> Dict[str, Any]:
     """Dump all embedding variables (+ optional dense pytree) under ``path``.
 
     Works single- or multi-host: with N > 1 processes each host streams its
@@ -161,12 +271,40 @@ def save_checkpoint(path: str,
     Python load path reads them transparently, but the native mmap
     serving library (``native/oe_serving.cc``) needs raw ``.npy`` — keep
     serving dumps uncompressed.
+
+    ``mode="delta"``: write only the chunks dirtied since the last save
+    (``collection.enable_dirty_tracking()`` must be armed) as one delta
+    appended to the checkpoint's chain — the reference's ICDE'23
+    incremental checkpoints (``checkpoint_delta.py``). Falls back to a
+    FULL save (recorded as ``forced_full``) when no base exists yet or
+    the chain was just compacted away. ``step`` stamps the save for the
+    serving hot-swap version protocol. Local full saves fan out over
+    parallel per-shard writer threads (``OE_CKPT_WRITERS`` /
+    ``max_workers``; 1 serializes). Returns an info dict
+    (mode/bytes/seconds, plus seq/chain length for delta saves).
     """
-    with scope.span("checkpoint.save"):
-        return _save_checkpoint_impl(
+    if mode not in ("full", "delta"):
+        raise ValueError(f"unknown checkpoint mode {mode!r}; "
+                         "use 'full' or 'delta'")
+    import time as _time
+    from .utils import observability
+    with scope.span("checkpoint.save", detail={"mode": mode}):
+        if mode == "delta":
+            from . import checkpoint_delta as cd
+            return cd.save_delta(
+                path, collection, states, step=step,
+                dense_state=dense_state,
+                include_optimizer=include_optimizer, compress=compress,
+                model_sign=model_sign, max_workers=max_workers)
+        t0 = _time.perf_counter()
+        nbytes = _save_checkpoint_impl(
             path, collection, states, dense_state=dense_state,
             include_optimizer=include_optimizer, model_sign=model_sign,
-            compress=compress)
+            compress=compress, step=step, max_workers=max_workers)
+        dt = _time.perf_counter() - t0
+        observability.record_ckpt_save("full", nbytes, dt, chain_len=0)
+        return {"mode": "full", "bytes": int(nbytes),
+                "seconds": dt, "seq": 0}
 
 
 def _save_checkpoint_impl(path: str,
@@ -176,13 +314,34 @@ def _save_checkpoint_impl(path: str,
                           dense_state: Any,
                           include_optimizer: bool,
                           model_sign: str,
-                          compress: str) -> None:
+                          compress: str,
+                          step: int = 0,
+                          max_workers: Optional[int] = None) -> int:
+    """Full dump; returns the logical bytes written (table rows + slots,
+    pre-compression — the rate the ``ckpt_write_gbps`` gauge reports)."""
+    from . import checkpoint_delta as cd
     from .utils import compress as compress_lib
     compress = compress_lib.check(compress)
     nproc = jax.process_count()
     rank = jax.process_index()
     remote = fs.is_remote(path)
     fs.makedirs(path)
+    # a running background compactor owns this directory's base files —
+    # join it (and surface its error) BEFORE touching anything, or its
+    # folded-file renames would land over the fresh base mid-save
+    if not remote:
+        cd.join_compactor(path)
+    # a full save RESETS any existing delta chain FIRST (manifest removed
+    # before base files change): a crash mid-save must leave either the
+    # old chain intact-and-referenced or no chain at all — never a stale
+    # chain replayed over a half-new base (checkpoint_delta.reset_chain)
+    if rank == 0:
+        cd.reset_chain(path)
+    # trackers snapshot at the START: marks landing during the save refer
+    # to pushes on NEWER state objects than the pytree being dumped, and
+    # must survive for the next delta
+    for tracker in collection.dirty_trackers.values():
+        tracker.snapshot_clear()
     meta = collection.model_meta(model_sign=model_sign, model_uri=path)
     meta.extra["include_optimizer"] = bool(include_optimizer)
     if nproc > 1:
@@ -211,6 +370,9 @@ def _save_checkpoint_impl(path: str,
             fs.makedirs(vdir)
     _sync("ckpt_dirs_ready")
 
+    tasks: list = []
+    finals: list = []
+    nbytes = 0
     for name, spec in collection.specs.items():
         # a hot-row replica (a2a+cache plane) is derived state: only the
         # authoritative table is dumped
@@ -219,8 +381,15 @@ def _save_checkpoint_impl(path: str,
         vdir = fs.join(path, _var_dir(vid, name))
         part = f"part{rank}_" if (nproc > 1 or remote or compress) else ""
         if spec.use_hash:
-            _save_hash_var(vdir, state, include_optimizer, part=part,
-                           compress=compress)
+            if part:
+                _save_hash_var(vdir, state, include_optimizer, part=part,
+                               compress=compress)
+                nbytes += _hash_state_bytes(state, include_optimizer)
+            else:
+                t, f, b = _hash_save_tasks(vdir, state, include_optimizer)
+                tasks += t
+                finals += f
+                nbytes += b
         elif nproc > 1 or remote or compress:
             # compressed dumps ride the sequential part format — framed
             # streams have no memmap representation
@@ -228,79 +397,181 @@ def _save_checkpoint_impl(path: str,
                                  collection.sharding_spec(name),
                                  spec.input_dim, include_optimizer,
                                  compress=compress)
+            nbytes += _array_state_bytes(state, spec.input_dim,
+                                         collection.sharding_spec(name),
+                                         include_optimizer)
         else:
-            _save_array_var(vdir, state, collection.sharding_spec(name),
-                            spec.input_dim, include_optimizer)
+            t, f, b = _array_save_tasks(vdir, state,
+                                        collection.sharding_spec(name),
+                                        spec.input_dim, include_optimizer)
+            tasks += t
+            finals += f
+            nbytes += b
+    # the parallel shard writers: every task owns a disjoint file region
+    # (a logical window of one field's memmap, or one shard's contiguous
+    # slice of a hash dump), so device->host streams and disk writes for
+    # different shards overlap instead of serializing through one stream
+    _run_writers(tasks, max_workers=max_workers)
+    for fin in finals:
+        fin()
 
     if dense_state is not None and rank == 0:
         with fs.open_file(fs.join(path, DENSE_FILE), "wb") as f:
             f.write(serialization.to_bytes(jax.device_get(dense_state)))
+    if rank == 0 and collection.dirty_trackers \
+            and not (nproc > 1 or remote or compress):
+        # arm the delta chain: later mode="delta" saves append to this
+        # base (the manifest is the single commit point for the chain).
+        # ONLY the local uncompressed single-process layout arms —
+        # part/compressed/remote bases have no raw .npy files for the
+        # compactor to fold, so a chain over them could never rebase;
+        # a delta save into such a dir stays forced-full (and rewrites
+        # the base raw)
+        cd.init_manifest(path, step=step,
+                         include_optimizer=include_optimizer)
     _sync("ckpt_done")
+    return nbytes
 
 
-_SAVE_WINDOW_BYTES = 256 << 20
+def _array_state_bytes(state, vocab: int, sspec: st.ShardingSpec,
+                       include_optimizer: bool) -> int:
+    per_row = state.weights.nbytes // max(1, state.weights.shape[0])
+    if include_optimizer:
+        per_row += sum(v.nbytes // max(1, v.shape[0])
+                       for v in state.slots.values())
+    return int(vocab) * int(per_row)
 
 
-def _save_array_var(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
-                    include_optimizer: bool) -> None:
-    """Stream one bounded variable to ``<vdir>/{weights,slot_*}.npy``.
+def _hash_state_bytes(state, include_optimizer: bool,
+                      live_rows: Optional[int] = None) -> int:
+    cap = max(1, state.keys.shape[0])
+    if live_rows is None:
+        live_rows = int(jax.device_get(state.num_used()))
+    per_row = state.keys.nbytes // cap + state.weights.nbytes // cap
+    if include_optimizer:
+        per_row += sum(v.nbytes // cap for v in state.slots.values())
+    return int(live_rows) * int(per_row)
+
+
+def _array_save_tasks(vdir: str, state, sspec: st.ShardingSpec, vocab: int,
+                      include_optimizer: bool):
+    """Writer tasks dumping one bounded variable to
+    ``<vdir>/{weights,slot_*}.npy``; returns ``(tasks, finals, bytes)``.
 
     Arrays are written in *logical id order* (only the real vocab rows —
     padding rows differ across mesh shapes and are unreachable), so the
-    checkpoint is shard-topology independent. The writer walks LOGICAL
-    windows: each shard's contribution to a window is a CONTIGUOUS slice of
-    its device buffer (device reads stay bulk transfers), the mod-layout
-    interleave happens in a RAM staging buffer, and the file is written
-    strictly sequentially — strided memmap writes measured 0.015 GB/s on
-    local disk (page-granularity random IO); sequential windows run at disk
-    bandwidth. Host memory stays bounded by the window size.
+    checkpoint is shard-topology independent. Each TASK owns one logical
+    WINDOW of one field's pre-sized memmap: each shard's contribution to
+    a window is a CONTIGUOUS slice of its device buffer (device reads
+    stay bulk transfers), the mod-layout interleave happens in a RAM
+    staging buffer, and the window is written as one sequential region —
+    strided memmap writes measured 0.015 GB/s on local disk
+    (page-granularity random IO); window regions run at disk bandwidth.
+    Windows are disjoint file regions, so ``_run_writers`` streams them
+    concurrently; host memory stays bounded by window size x writers.
     """
     targets = {"weights": state.weights}
     if include_optimizer:
         for sname, sval in state.slots.items():
             targets[f"slot_{sname}"] = sval
-    S, rps = sspec.num_shards, sspec.rows_per_shard
+    tasks, finals = [], []
+    nbytes = 0
     for fname, arr in targets.items():
         dtype = np.dtype(arr.dtype)
         row_shape = arr.shape[1:]
         row_bytes = max(1, int(np.prod(row_shape, dtype=np.int64))
                         * dtype.itemsize)
-        win = max(1, _SAVE_WINDOW_BYTES // row_bytes)
-        shards = sorted(
-            (s for s in arr.addressable_shards if s.replica_id == 0),
-            key=lambda s: s.index[0].start or 0)
+        win = max(1, _PAR_WINDOW_BYTES // row_bytes)
+        shards = _sorted_shards(arr)
         mm = np.lib.format.open_memmap(
             os.path.join(vdir, fname + ".npy"), mode="w+",
             dtype=dtype, shape=(vocab,) + row_shape)
+        nbytes += vocab * row_bytes
+
+        def _write(l0, l1, mm=mm, shards=shards, row_shape=row_shape,
+                   dtype=dtype):
+            mm[l0:l1] = gather_logical_window(shards, sspec, l0, l1,
+                                              row_shape, dtype)
+
         for l0 in range(0, vocab, win):
-            l1 = min(vocab, l0 + win)
-            buf = np.empty((l1 - l0,) + row_shape, dtype)
-            for sh in shards:
-                p0 = sh.index[0].start or 0
-                s = p0 // rps
-                if sspec.layout == "mod":
-                    # shard s owns logical ids l = local * S + s
-                    lo_s = max(0, -(-(l0 - s) // S))
-                    hi_s = max(0, -(-(l1 - s) // S))
-                    hi_s = min(hi_s, sh.data.shape[0])
-                    if hi_s <= lo_s:
-                        continue
-                    block = np.asarray(jax.device_get(
-                        sh.data[lo_s:hi_s]))
-                    a = s + lo_s * S - l0
-                    buf[a:a + (hi_s - lo_s - 1) * S + 1:S] = block
-                else:
-                    # div layout: logical == physical position
-                    a = max(l0, p0)
-                    b = min(l1, p0 + sh.data.shape[0])
-                    if b <= a:
-                        continue
-                    block = np.asarray(jax.device_get(
-                        sh.data[a - p0:b - p0]))
-                    buf[a - l0:b - l0] = block
-            mm[l0:l1] = buf
-        mm.flush()
-        del mm
+            tasks.append(partial(_write, l0, min(vocab, l0 + win)))
+
+        def _finish(mm=mm):
+            mm.flush()
+
+        finals.append(_finish)
+    return tasks, finals, nbytes
+
+
+def _hash_save_tasks(vdir: str, state, include_optimizer: bool):
+    """Writer tasks dumping one hash variable's live rows to
+    ``<vdir>/{keys,weights,slot_*}.npy``; returns ``(tasks, finals,
+    bytes)``.
+
+    Pass 1 counts live rows per addressable shard on-device (cheap
+    reductions), which fixes each shard's CONTIGUOUS destination range
+    ``[offset_s, offset_s + count_s)`` in the pre-sized memmaps; one
+    writer task per shard then streams that shard's blocks and writes
+    the live subset — disjoint contiguous file regions, parallel across
+    shards (``_run_writers``), same on-disk format as before.
+    """
+    empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+    wide = hash_lib.is_wide(state.keys)
+    key_dtype = np.dtype(state.keys.dtype)
+    key_shards = _sorted_shards(state.keys)
+    counts = []
+    for s in key_shards:
+        col = s.data[:, 1] if wide else s.data
+        counts.append(int(jax.device_get(
+            jnp.sum(col != np.asarray(empty, dtype=key_dtype)))))
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    total = int(offsets[-1])
+    targets = {"keys": state.keys, "weights": state.weights}
+    if include_optimizer:
+        for sname, sval in state.slots.items():
+            targets[f"slot_{sname}"] = sval
+    shard_lists = {f: _sorted_shards(a) for f, a in targets.items()}
+    mms = {}
+    nbytes = 0
+    for fname, arr in targets.items():
+        mms[fname] = np.lib.format.open_memmap(
+            os.path.join(vdir, fname + ".npy"), mode="w+",
+            dtype=np.dtype(arr.dtype), shape=(total,) + arr.shape[1:])
+        nbytes += total * max(1, int(np.prod(arr.shape[1:],
+                                             dtype=np.int64))
+                              * np.dtype(arr.dtype).itemsize)
+
+    def _write_shard(i: int, off: int) -> None:
+        datas = {f: sl[i].data for f, sl in shard_lists.items()}
+        rows = datas["keys"].shape[0]
+        if not rows:
+            assert counts[i] == 0
+            return
+        row_bytes = sum(max(1, d.nbytes // rows) for d in datas.values())
+        per = max(1, _BLOCK_BYTES // row_bytes)
+        o = off
+        for lo in range(0, rows, per):
+            hi = min(rows, lo + per)
+            blocks = {f: np.asarray(jax.device_get(d[lo:hi]))
+                      for f, d in datas.items()}
+            bk = blocks["keys"]
+            # wide ([cap, 2]) keys: a slot is free iff its HI word is EMPTY
+            live = (bk[:, 1] != empty) if wide else (bk != empty)
+            n = int(live.sum())
+            if n:
+                for f, b in blocks.items():
+                    mms[f][o:o + n] = b[live]
+                o += n
+        assert o - off == counts[i], (i, o - off, counts[i])
+
+    tasks = [partial(_write_shard, i, int(offsets[i]))
+             for i in range(len(key_shards))]
+
+    def _finish():
+        for mm in mms.values():
+            mm.flush()
+
+    return tasks, [_finish], nbytes
 
 
 def _seq_writer(path_npy: str, dtype, shape, compress: str = ""):
@@ -761,11 +1032,43 @@ def load_checkpoint(path: str,
     Bounded variables' local vocab must be ``shard_slice_vocab(V, k, G)``
     (local row ``l`` holds global id ``l * G + k``); hash variables keep
     their keys verbatim and simply skip non-owned ones.
+
+    ``load_checkpoint`` transparently REPLAYS a delta chain on top of the
+    base (``checkpoint_delta.py``): the manifest's committed entries are
+    checksum-verified and applied in order; a torn FINAL delta (a killed
+    writer) is discarded whole — the load recovers to the last complete
+    delta, never a half-applied one.
     """
     with scope.span("checkpoint.load"):
-        return _load_checkpoint_impl(
-            path, collection, dense_state_template=dense_state_template,
-            rng=rng, shard_slice=shard_slice)
+        from . import checkpoint_delta as cd
+        # a loader racing the writer's BACKGROUND COMPACTOR can read base
+        # files from one generation and the manifest from another; the
+        # manifest's base_id pins the generation — retry once when it
+        # moved under the load (folding is idempotent, so one settled
+        # re-read is always consistent)
+        last_err = None
+        for _attempt in range(2):
+            m0 = cd.read_manifest(path)
+            id0 = m0["base_id"] if m0 else None
+            try:
+                out = _load_checkpoint_impl(
+                    path, collection,
+                    dense_state_template=dense_state_template,
+                    rng=rng, shard_slice=shard_slice)
+            except RuntimeError as e:
+                m1 = cd.read_manifest(path)
+                if (m1["base_id"] if m1 else None) != id0:
+                    last_err = e
+                    continue
+                raise
+            m1 = cd.read_manifest(path)
+            if (m1["base_id"] if m1 else None) == id0:
+                return out
+            last_err = RuntimeError("chain compacted under the load")
+        raise RuntimeError(
+            f"checkpoint at {path!r} kept changing under the load "
+            "(background compaction); quiesce the writer or retry"
+        ) from last_err
 
 
 def _load_checkpoint_impl(path: str,
@@ -820,6 +1123,14 @@ def _load_checkpoint_impl(path: str,
                 shardings = shardings.table
             out[name] = _load_array_var(
                 data, spec, sspec, optimizer, shardings, with_opt)
+    # delta chain replay: committed deltas patched over the base, newest
+    # wins; torn final delta discarded whole (checkpoint_delta.py)
+    from . import checkpoint_delta as cd
+    manifest = cd.read_manifest(path)
+    if manifest and manifest.get("chain"):
+        out = cd.replay_chain(path, collection, out, manifest=manifest,
+                              with_opt=with_opt, shard_slice=shard_slice,
+                              dump_meta=dump_meta)
     for name in out:
         # cached-plane variables come back with a fresh all-pad replica;
         # the first HotCacheManager refresh re-admits the hot set

@@ -1,0 +1,1111 @@
+"""Delta checkpoint plane: base snapshot + compacted dirty-chunk chain.
+
+The reference's ICDE 2023 PMem work makes checkpoints cheap with
+lightweight INCREMENTAL saves from dirty tracking
+(PmemEmbeddingTable.h:285-328); the offload tier already reproduces that
+protocol for its own host store (``offload._persist_store``). This
+module generalizes it to the WHOLE-MODEL checkpoint
+(``checkpoint.save_checkpoint(mode="delta")``):
+
+* a FULL save (``checkpoint._save_checkpoint_impl``, parallel shard
+  writers) is the BASE; it arms the chain by writing a fresh manifest
+  (:func:`init_manifest`) when the collection's dirty tracking is on;
+* a DELTA save writes, per variable, only the chunks whose
+  ``DirtyTracker`` bit is set (``dirty.py``; pushes mark chunks) — one
+  ``delta_<seq>_<vid>.npz`` per variable, written by the same parallel
+  writer pool, checksummed per chunk;
+* the MANIFEST (``delta_manifest``, atomic rename) is the single commit
+  point: a kill at ANY instant leaves either the previous chain or the
+  new chain — never a manifest referencing a torn file. Torn/corrupt
+  FINAL entries (crc mismatch after a partial rename on a dying disk)
+  are discarded whole at load; a torn MIDDLE entry fails the load (the
+  chain is replayed in order — skipping the middle would corrupt);
+* a background COMPACTOR folds long chains back into a new base ON DISK
+  (no device involvement — folding is the same newest-wins assignment
+  the replay performs, so a crash mid-compaction leaves a directory
+  that still loads to the identical state) under a chain-length /
+  chain-bytes budget;
+* the SAME delta stream feeds serving hot-swap: :class:`Delta` payloads
+  (``read_delta`` / ``encode_delta``) are applied in place by
+  ``ModelRegistry.apply_delta`` — the train->serve loop the reference
+  closes with TF-Serving + the HA PS, without a full-model reload.
+
+Delta mode is LOCAL + single-process + uncompressed-base (the delta
+files themselves may be compressed): remote/multi-host dumps keep the
+full-save part format. A dump written with dirty tracking DISABLED
+never has a manifest and loads exactly as before.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import threading
+import time
+import uuid
+import warnings
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .analysis.concurrency import make_lock, sync_point
+from .embedding import EmbeddingCollection
+from .parallel import hot_cache
+from .parallel import sharded_hash as sh
+from .parallel import sharded_table as st
+from .utils import fs
+from . import hash_table as hash_lib
+from . import table as table_lib
+
+DELTA_MANIFEST_FILE = "delta_manifest"
+DELTA_FORMAT = 1
+# compaction budget: fold the chain into a new base past either bound
+COMPACT_CHAIN_LEN = 8
+COMPACT_BYTES_RATIO = 0.5
+_APPLY_CHUNK = 1 << 16
+
+
+def _delta_fname(seq: int, vid: int) -> str:
+    return f"delta_{seq:06d}_{vid}.npz"
+
+
+# --- manifest ----------------------------------------------------------------
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The committed manifest, or None (a plain full checkpoint)."""
+    mpath = fs.join(path, DELTA_MANIFEST_FILE)
+    if not fs.exists(mpath):
+        return None
+    manifest = fs.read_json(mpath)
+    if manifest.get("format") != DELTA_FORMAT:
+        raise ValueError(
+            f"unknown delta manifest format {manifest.get('format')!r} "
+            f"at {path!r} (this build reads format {DELTA_FORMAT})")
+    return manifest
+
+
+def _write_manifest(path: str, manifest: Dict[str, Any]) -> None:
+    fs.write_json_atomic(fs.join(path, DELTA_MANIFEST_FILE), manifest)
+
+
+def init_manifest(path: str, *, step: int, include_optimizer: bool,
+                  last_seq: int = 0) -> Dict[str, Any]:
+    """Arm a fresh chain over a just-written full base. ``last_seq``
+    carries the version counter across a compaction (seqs are burned,
+    never reused — the serving hot-swap version protocol needs
+    monotonicity)."""
+    manifest = {"format": DELTA_FORMAT,
+                "base_id": uuid.uuid4().hex,
+                "base_step": int(step),
+                "include_optimizer": bool(include_optimizer),
+                "last_seq": int(last_seq),
+                "chain": []}
+    _write_manifest(path, manifest)
+    return manifest
+
+
+def reset_chain(path: str) -> None:
+    """Remove the manifest (FIRST — the atomic commit point) and GC every
+    delta file. Called by a full save before it touches base files, so a
+    crash mid-save can never leave a stale chain to be replayed over a
+    half-new base."""
+    mpath = fs.join(path, DELTA_MANIFEST_FILE)
+    if fs.exists(mpath):
+        fs.remove(mpath)
+    _gc_orphans(path, chain=())
+
+
+def chain_state(path: str) -> Dict[str, Any]:
+    """Chain summary for version bookkeeping (the serving registry sets
+    a loaded model's hot-swap version from ``last_seq``)."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return {"base_id": "", "base_step": 0, "last_seq": 0,
+                "chain_len": 0, "chain_bytes": 0}
+    return {"base_id": manifest["base_id"],
+            "base_step": manifest["base_step"],
+            "last_seq": manifest["last_seq"],
+            "chain_len": len(manifest["chain"]),
+            "chain_bytes": sum(int(e.get("bytes", 0))
+                               for e in manifest["chain"])}
+
+
+def _gc_orphans(path: str, chain) -> int:
+    """Remove delta files the committed manifest does not reference, plus
+    leftover atomic-write tmps and compaction tmps — the debris of a kill
+    between a delta-file rename and the manifest commit. Runs on the
+    WRITE path only (the saving process owns the directory)."""
+    live = set()
+    for entry in chain:
+        for info in entry.get("vars", {}).values():
+            live.add(info["file"])
+    n = 0
+    try:
+        names = fs.listdir(path)
+    except OSError:  # pragma: no cover — listing is best-effort
+        return 0
+    for fname in names:
+        orphan = (fname.startswith("delta_") and fname.endswith(".npz")
+                  and fname not in live)
+        if orphan or fs.is_tmp_orphan(fname):
+            try:
+                fs.remove(fs.join(path, fname))
+                n += 1
+            except OSError:  # pragma: no cover
+                pass
+        elif fname.startswith("var_") and fname.endswith(".d"):
+            # a killed compaction leaves <field>.npy.compact.tmp inside
+            # var dirs (each commits via atomic rename; debris is inert)
+            vdir = fs.join(path, fname)
+            try:
+                subnames = fs.listdir(vdir)
+            except OSError:  # pragma: no cover
+                continue
+            for sub in subnames:
+                if sub.endswith(".compact.tmp") or fs.is_tmp_orphan(sub):
+                    try:
+                        fs.remove(fs.join(vdir, sub))
+                        n += 1
+                    except OSError:  # pragma: no cover
+                        pass
+    return n
+
+
+# --- delta payloads ----------------------------------------------------------
+
+def _field_order(payload: Dict[str, np.ndarray]) -> List[str]:
+    """Deterministic field order for checksums/wire framing: id column
+    first, then weights, then slots sorted by name."""
+    fields = []
+    for f in ("keys", "weights"):
+        if f in payload:
+            fields.append(f)
+    fields += sorted(k for k in payload if k.startswith("slot_"))
+    return fields
+
+
+def _array_delta_payload(state, sspec, vocab: int, rows_per_chunk: int,
+                         chunks: np.ndarray, include_optimizer: bool
+                         ) -> Tuple[Dict[str, np.ndarray], List[int]]:
+    """Gather one bounded variable's dirty chunks into a payload dict +
+    per-chunk crc32 list (crc over the chunk's weights+slots bytes, in
+    field order). Contiguous chunk runs gather as one logical window —
+    the same bulk device->host streams as the full save."""
+    from . import checkpoint as ckpt
+    fields: Dict[str, Any] = {"weights": state.weights}
+    if include_optimizer:
+        for sname, sval in state.slots.items():
+            fields[f"slot_{sname}"] = sval
+    shards = {f: ckpt._sorted_shards(a) for f, a in fields.items()}
+    chunks = np.asarray(chunks, np.int64)
+    parts: Dict[str, list] = {f: [] for f in fields}
+    chunk_crcs: List[int] = []
+    R = int(rows_per_chunk)
+    # group consecutive chunk ids into runs
+    runs: List[Tuple[int, int]] = []
+    for c in chunks:
+        c = int(c)
+        if runs and runs[-1][1] == c:
+            runs[-1] = (runs[-1][0], c + 1)
+        else:
+            runs.append((c, c + 1))
+    order = _field_order({f: None for f in fields})
+    for c0, c1 in runs:
+        l0 = c0 * R
+        l1 = min(c1 * R, vocab)
+        if l1 <= l0:
+            continue
+        bufs = {}
+        for f, arr in fields.items():
+            bufs[f] = ckpt.gather_logical_window(
+                shards[f], sspec, l0, l1, arr.shape[1:],
+                np.dtype(arr.dtype))
+            parts[f].append(bufs[f])
+        for c in range(c0, c1):
+            a = c * R - l0
+            b = min((c + 1) * R, vocab) - l0
+            if b <= a:
+                continue
+            crc = 0
+            for f in order:
+                crc = zlib.crc32(bufs[f][a:b].tobytes(), crc)
+            chunk_crcs.append(crc)
+    payload = {}
+    for f, arr in fields.items():
+        if parts[f]:
+            payload[f] = np.concatenate(parts[f])
+        else:
+            payload[f] = np.zeros((0,) + arr.shape[1:],
+                                  np.dtype(arr.dtype))
+    payload["chunks"] = chunks
+    payload["rows_per_chunk"] = np.int64(R)
+    payload["vocab"] = np.int64(vocab)
+    return payload, chunk_crcs
+
+
+def _hash_delta_payload(state, tracker, chunks: np.ndarray,
+                        include_optimizer: bool
+                        ) -> Dict[str, np.ndarray]:
+    """Gather one hash variable's live rows whose key chunk is dirty.
+    Newest-wins replay makes over-collection safe: every live row of a
+    dirty chunk ships, whether or not that specific key changed."""
+    from . import checkpoint as ckpt
+    targets = {"keys": state.keys, "weights": state.weights}
+    if include_optimizer:
+        for sname, sval in state.slots.items():
+            targets[f"slot_{sname}"] = sval
+    dirty = np.zeros(tracker.num_chunks, bool)
+    dirty[np.asarray(chunks, np.int64)] = True
+    empty = hash_lib.empty_key(np.dtype(state.keys.dtype))
+    wide = hash_lib.is_wide(state.keys)
+    parts: Dict[str, list] = {f: [] for f in targets}
+    for blocks in ckpt._aligned_shard_blocks(targets):
+        bk = blocks["keys"]
+        live = (bk[:, 1] != empty) if wide else (bk != empty)
+        if not live.any():
+            continue
+        k64 = hash_lib.join64(bk[live]) if wide \
+            else bk[live].astype(np.int64)
+        sel = dirty[k64 % np.int64(tracker.num_chunks)]
+        if not sel.any():
+            continue
+        for f, block in blocks.items():
+            parts[f].append(block[live][sel])
+    payload = {}
+    for f, arr in targets.items():
+        if parts[f]:
+            payload[f] = np.concatenate(parts[f])
+        else:
+            payload[f] = np.zeros((0,) + arr.shape[1:],
+                                  np.dtype(arr.dtype))
+    payload["chunks"] = np.asarray(chunks, np.int64)
+    payload["num_chunks"] = np.int64(tracker.num_chunks)
+    return payload
+
+
+def _serialize_payload(payload: Dict[str, np.ndarray],
+                       compress: str) -> Tuple[bytes, int]:
+    """npz bytes + file crc32 (the whole-file checksum the manifest
+    records; verified before any byte of the delta is applied)."""
+    from .utils import compress as compress_lib
+    savez = np.savez_compressed \
+        if compress_lib.check_persist_codec(compress) else np.savez
+    bio = io.BytesIO()
+    savez(bio, **payload)
+    raw = bio.getvalue()
+    return raw, zlib.crc32(raw)
+
+
+def _parse_payload(raw: bytes) -> Dict[str, np.ndarray]:
+    data = np.load(io.BytesIO(raw))
+    return {k: data[k] for k in data.files}
+
+
+def _verify_array_chunks(payload: Dict[str, np.ndarray],
+                         chunk_crc: List[int]) -> bool:
+    """Recompute per-chunk crcs of a parsed array payload."""
+    chunks = np.asarray(payload["chunks"], np.int64)
+    R = int(payload["rows_per_chunk"])
+    vocab = int(payload["vocab"])
+    order = _field_order(payload)
+    if len(chunk_crc) != chunks.size:
+        return False
+    off = 0
+    for i, c in enumerate(chunks):
+        n = min((int(c) + 1) * R, vocab) - int(c) * R
+        crc = 0
+        for f in order:
+            crc = zlib.crc32(payload[f][off:off + n].tobytes(), crc)
+        if crc != int(chunk_crc[i]):
+            return False
+        off += n
+    return all(payload[f].shape[0] == off for f in order)
+
+
+# --- delta save --------------------------------------------------------------
+
+def save_delta(path: str, collection: EmbeddingCollection,
+               states: Dict[str, Any], *, step: int,
+               dense_state: Any = None,
+               include_optimizer: bool = True,
+               compress: str = "",
+               model_sign: str = "",
+               max_workers: Optional[int] = None,
+               compact_chain_len: int = COMPACT_CHAIN_LEN,
+               compact_bytes_ratio: float = COMPACT_BYTES_RATIO,
+               background_compact: bool = True,
+               return_payload: bool = False) -> Dict[str, Any]:
+    """One incremental save: dirty chunks since the last save -> one new
+    chain entry. Forces a FULL save when no armed base exists (first
+    save into a directory, or the previous dump predates dirty
+    tracking). See ``checkpoint.save_checkpoint`` for the public entry.
+
+    ``return_payload=True`` attaches the committed :class:`Delta` to the
+    info dict (``info["delta"]``) straight from memory — the PUBLISH
+    path for serving hot-swap. Prefer it over a post-save
+    :func:`read_delta`: the background compactor may fold the chain
+    (deleting the file) before a disk read lands.
+    """
+    from . import checkpoint as ckpt
+    from .utils import compress as compress_lib
+    from .utils import observability
+    compress = compress_lib.check_persist_codec(compress)
+    if fs.is_remote(path):
+        raise ValueError(
+            "mode='delta' needs a local path (the compactor folds chain "
+            "files into the base in place); dump remote checkpoints full")
+    if jax.process_count() > 1:
+        raise ValueError("mode='delta' is single-process; multi-host "
+                         "dumps use the full part format")
+    trackers = collection.dirty_trackers
+    if not trackers:
+        raise ValueError(
+            "mode='delta' needs dirty tracking: call "
+            "collection.enable_dirty_tracking() before training")
+    # a running background compaction owns the directory — join it (and
+    # surface its error) before writing anything
+    join_compactor(path)
+    manifest = read_manifest(path)
+    t0 = time.perf_counter()
+    if manifest is None:
+        # no armed base: the full save writes one and arms the chain
+        nbytes = ckpt._save_checkpoint_impl(
+            path, collection, states, dense_state=dense_state,
+            include_optimizer=include_optimizer, model_sign=model_sign,
+            compress="", step=step, max_workers=max_workers)
+        dt = time.perf_counter() - t0
+        observability.record_ckpt_save("full", nbytes, dt, chain_len=0)
+        return {"mode": "full", "forced_full": True, "bytes": int(nbytes),
+                "seconds": dt, "seq": 0}
+    if bool(manifest.get("include_optimizer", True)) \
+            != bool(include_optimizer):
+        raise ValueError(
+            "delta save include_optimizer does not match the base "
+            f"(base={manifest.get('include_optimizer')}); re-save full")
+    _gc_orphans(path, manifest["chain"])
+
+    # DENSE params ride OUTSIDE the chain protocol: small, replicated,
+    # rewritten whole (atomically) on every save — including a SKIPPED
+    # one, so a dense-only training window still persists its params.
+    # Last-writer-wins; a torn-tail recovery keeps the newest dense
+    # file next to the recovered sparse state (document'd divergence —
+    # chain guarantees cover the sparse tables).
+    if dense_state is not None:
+        from flax import serialization
+        with fs.open_atomic(fs.join(path, ckpt.DENSE_FILE)) as f:
+            f.write(serialization.to_bytes(jax.device_get(dense_state)))
+
+    snaps = {name: trackers[name].snapshot_clear() for name in trackers}
+    total_dirty = sum(s.size for s in snaps.values())
+    if total_dirty == 0:
+        return {"mode": "delta", "seq": int(manifest["last_seq"]),
+                "skipped": True, "bytes": 0, "rows": 0,
+                "chain_len": len(manifest["chain"])}
+    seq = int(manifest["last_seq"]) + 1
+    results: Dict[str, Dict[str, Any]] = {}
+    kept_payloads: Dict[str, Dict[str, np.ndarray]] = {}
+    tasks = []
+
+    def _write_var(name: str) -> None:
+        spec = collection.specs[name]
+        tracker = trackers[name]
+        state = hot_cache.unwrap(states[name])
+        chunks = snaps[name]
+        if spec.use_hash:
+            payload = _hash_delta_payload(state, tracker, chunks,
+                                          include_optimizer)
+            chunk_crc = None
+        else:
+            payload, chunk_crc = _array_delta_payload(
+                state, collection.sharding_spec(name), spec.input_dim,
+                tracker.rows_per_chunk, chunks, include_optimizer)
+        rows = int(payload["weights"].shape[0])
+        raw, crc = _serialize_payload(payload, compress)
+        fname = _delta_fname(seq, collection.variable_id(name))
+        with fs.open_atomic(fs.join(path, fname)) as f:
+            f.write(raw)
+        info = {"file": fname, "bytes": len(raw), "crc32": int(crc),
+                "kind": "hash" if spec.use_hash else "array",
+                "rows": rows, "dirty_chunks": int(chunks.size)}
+        if chunk_crc is not None:
+            info["chunk_crc"] = [int(c) for c in chunk_crc]
+        results[name] = info
+        if return_payload:
+            kept_payloads[name] = payload
+
+    for name in trackers:
+        if snaps[name].size:
+            tasks.append(lambda n=name: _write_var(n))
+    try:
+        ckpt._run_writers(tasks, max_workers=max_workers)
+
+        entry = {"seq": seq, "step": int(step),
+                 "bytes": sum(i["bytes"] for i in results.values()),
+                 "rows": sum(i["rows"] for i in results.values()),
+                 "vars": results}
+        manifest["chain"].append(entry)
+        manifest["last_seq"] = seq
+        # the commit point: before this rename readers replay the old
+        # chain
+        sync_point("ckpt.delta.commit")
+        _write_manifest(path, manifest)
+    except BaseException:
+        # failed write OR failed commit: restore every claim so the next
+        # save re-covers it (completed-but-uncommitted files are
+        # orphans, GC'd next save); marks that landed during the attempt
+        # are preserved either way
+        for name, chunks in snaps.items():
+            trackers[name].restore(chunks)
+        raise
+    dt = time.perf_counter() - t0
+    observability.record_ckpt_save("delta", entry["bytes"], dt,
+                                   chain_len=len(manifest["chain"]))
+    info = {"mode": "delta", "seq": seq, "step": int(step),
+            "bytes": int(entry["bytes"]), "rows": int(entry["rows"]),
+            "seconds": dt, "chain_len": len(manifest["chain"]),
+            "skipped": False}
+    if return_payload:
+        info["delta"] = Delta(seq=seq, step=int(step), vars=kept_payloads)
+    if compact_due(manifest, _base_bytes(path),
+                   chain_len=compact_chain_len,
+                   bytes_ratio=compact_bytes_ratio):
+        compact(path, background=background_compact,
+                max_workers=max_workers)
+        info["compaction"] = "background" if background_compact \
+            else "done"
+    return info
+
+
+def _base_bytes(path: str) -> int:
+    total = 0
+    for d in os.listdir(path):
+        if d.startswith("var_") and d.endswith(".d"):
+            vd = os.path.join(path, d)
+            for f in os.listdir(vd):
+                if f.endswith(".npy"):
+                    total += os.path.getsize(os.path.join(vd, f))
+    return total
+
+
+def compact_due(manifest: Dict[str, Any], base_bytes: int, *,
+                chain_len: int = COMPACT_CHAIN_LEN,
+                bytes_ratio: float = COMPACT_BYTES_RATIO) -> bool:
+    """Chain budget: past ``chain_len`` entries, or chain bytes past
+    ``bytes_ratio`` of the base — both bound replay time and file count
+    over arbitrarily long runs (the reference's periodic rebase)."""
+    chain = manifest.get("chain", [])
+    if len(chain) >= chain_len:
+        return True
+    cb = sum(int(e.get("bytes", 0)) for e in chain)
+    return base_bytes > 0 and cb >= bytes_ratio * base_bytes
+
+
+# --- chain verification + replay ---------------------------------------------
+
+def verify_chain(path: str, manifest: Dict[str, Any],
+                 keep_payloads: bool = True
+                 ) -> Tuple[List[Tuple[Dict[str, Any],
+                                       Dict[str, Dict[str, np.ndarray]]]],
+                            bool]:
+    """Read + checksum every committed entry; returns ``(list of
+    (entry, {var: payload}), dropped_last)``.
+
+    A torn/corrupt/missing FINAL entry is DISCARDED whole (the state as
+    of the previous entry is complete and consistent — a partial last
+    delta must never be half-applied); the same damage mid-chain raises
+    (later entries were built on top of it). ``keep_payloads=False``
+    verifies without holding the parsed arrays (the compactor's
+    bounded-memory pass; payloads are re-read one at a time during the
+    fold — the chain-bytes budget can be a large fraction of the base,
+    which must never be required to fit in RAM at once)."""
+    entries = manifest.get("chain", [])
+    out = []
+    for i, entry in enumerate(entries):
+        payloads: Dict[str, Dict[str, np.ndarray]] = {}
+        bad = None
+        for name, info in entry["vars"].items():
+            fpath = fs.join(path, info["file"])
+            try:
+                with fs.open_file(fpath, "rb") as f:
+                    raw = f.read()
+            except (OSError, FileNotFoundError):
+                bad = f"{info['file']}: missing/unreadable"
+                break
+            if zlib.crc32(raw) != int(info["crc32"]):
+                bad = f"{info['file']}: crc mismatch"
+                break
+            payload = _parse_payload(raw)
+            if info.get("chunk_crc") is not None \
+                    and not _verify_array_chunks(payload,
+                                                 info["chunk_crc"]):
+                bad = f"{info['file']}: chunk checksum mismatch"
+                break
+            if keep_payloads:
+                payloads[name] = payload
+            del payload
+        if bad is None:
+            out.append((entry, payloads))
+            continue
+        if i == len(entries) - 1:
+            warnings.warn(
+                f"delta chain at {path!r}: final entry seq="
+                f"{entry['seq']} is torn ({bad}); discarded — "
+                "recovering to the last complete delta", RuntimeWarning)
+            return out, True
+        raise RuntimeError(
+            f"delta chain at {path!r} is torn mid-chain at seq="
+            f"{entry['seq']} ({bad}); later deltas build on it — "
+            "restore the file or fall back to an older full checkpoint")
+    return out, False
+
+
+def _entry_payload(path: str, entry: Dict[str, Any],
+                   name: str) -> Optional[Dict[str, np.ndarray]]:
+    """Re-read one verified entry's payload for one variable (the
+    compactor's one-at-a-time loader; crc already checked)."""
+    info = entry["vars"].get(name)
+    if info is None:
+        return None
+    with fs.open_file(fs.join(path, info["file"]), "rb") as f:
+        return _parse_payload(f.read())
+
+
+def replay_chain(path: str, collection: EmbeddingCollection,
+                 states: Dict[str, Any], *, manifest: Dict[str, Any],
+                 with_opt: bool, shard_slice: Optional[tuple],
+                 dump_meta: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Apply the committed chain over freshly-loaded base states, in
+    order (newest wins by construction). Called by ``load_checkpoint``;
+    states are UNWRAPPED table states (hot-cache wrap happens after).
+    Payloads stream one ENTRY at a time (host memory bounded by one
+    delta, never the whole chain — which the compaction budget allows
+    to reach a large fraction of the base)."""
+    verified, _dropped = verify_chain(path, manifest, keep_payloads=False)
+    for entry, _ in verified:
+        payloads = {name: _entry_payload(path, entry, name)
+                    for name in entry["vars"]}
+        states = apply_delta_to_states(
+            collection, states, payloads, shard_slice=shard_slice,
+            with_opt=with_opt, donate=True)
+        del payloads
+    return states
+
+
+def applied_seq(path: str) -> int:
+    """Chain seq a load of ``path`` replays up to (torn tail excluded) —
+    the hot-swap version a freshly loaded serving model starts at.
+
+    Deliberately re-verifies the chain (one extra checksum pass per
+    MODEL LOAD — rare and bounded): the version must reflect exactly
+    what a load applies, including a dropped torn tail, and the
+    manifest's ``last_seq`` alone cannot say that."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return 0
+    verified, _ = verify_chain(path, manifest, keep_payloads=False)
+    if verified:
+        return int(verified[-1][0]["seq"])
+    return 0
+
+
+def apply_delta_to_states(collection: EmbeddingCollection,
+                          states: Dict[str, Any],
+                          payloads: Dict[str, Dict[str, np.ndarray]],
+                          *, shard_slice: Optional[tuple] = None,
+                          with_opt: bool = True,
+                          donate: bool = True) -> Dict[str, Any]:
+    """Patch variable states with delta payloads (functional: returns a
+    NEW states dict; inputs stay valid unless ``donate``). Shared by the
+    load-path replay (donate, with optimizer slots) and the serving
+    hot-swap (no donation — in-flight readers keep the pre-swap state;
+    serving's stateless optimizer carries no slots)."""
+    out = dict(states)
+    for name, payload in payloads.items():
+        if name not in collection.specs:
+            continue
+        spec = collection.specs[name]
+        state = hot_cache.unwrap(out[name])
+        if "keys" in payload:
+            if not spec.use_hash:
+                raise ValueError(
+                    f"delta for {name!r} is a hash payload but the "
+                    "variable is bounded — delta chains cannot "
+                    "category-swap; load the base full or re-save")
+            state = _apply_hash_payload(collection, name, state, payload,
+                                        shard_slice=shard_slice,
+                                        with_opt=with_opt)
+        else:
+            if spec.use_hash:
+                raise ValueError(
+                    f"delta for {name!r} is an array payload but the "
+                    "variable is hash — delta chains cannot "
+                    "category-swap; load the base full or re-save")
+            state = _apply_array_payload(collection, name, state, payload,
+                                        shard_slice=shard_slice,
+                                        with_opt=with_opt, donate=donate)
+        out[name] = collection.wrap_hot_cache(name, state)
+    return out
+
+
+def _payload_ids(payload: Dict[str, np.ndarray]) -> np.ndarray:
+    """Global logical row ids of an ARRAY payload's rows (chunk ranges
+    expanded in order)."""
+    chunks = np.asarray(payload["chunks"], np.int64)
+    R = int(payload["rows_per_chunk"])
+    vocab = int(payload["vocab"])
+    if not chunks.size:
+        return np.zeros(0, np.int64)
+    return np.concatenate([
+        np.arange(int(c) * R, min((int(c) + 1) * R, vocab),
+                  dtype=np.int64) for c in chunks])
+
+
+def _apply_array_payload(collection, name, state, payload, *,
+                         shard_slice, with_opt, donate):
+    spec = collection.specs[name]
+    sspec = collection.sharding_spec(name)
+    dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
+    ids = _payload_ids(payload)
+    fields = [("weights", dtype)]
+    if with_opt:
+        for sname, sval in state.slots.items():
+            if f"slot_{sname}" in payload:
+                fields.append((f"slot_{sname}",
+                               np.dtype(sval.dtype)))
+    weights = state.weights
+    slots = dict(state.slots)
+    size = min(_APPLY_CHUNK, max(int(ids.size), 1))
+    for lo in range(0, ids.size, size):
+        sub = ids[lo:lo + size]
+        if shard_slice is not None:
+            # serving shard group: keep owned global ids, map to the
+            # local row space (local l holds id l*G + k)
+            k, G = shard_slice
+            sel = (sub % G) == k
+            local_ids = sub[sel] // G
+        else:
+            sel = None
+            local_ids = sub
+        shard, local = sspec.shard_and_local(local_ids)
+        phys = shard * sspec.rows_per_shard + local
+        n = phys.shape[0]
+        phys_p = np.full((size,), -1, np.int64)
+        phys_p[:n] = phys
+        jphys = jnp.asarray(phys_p)
+        for fname, fdtype in fields:
+            rows = payload[fname][lo:lo + size]
+            if sel is not None:
+                rows = rows[sel]
+            buf = np.zeros((size,) + rows.shape[1:], fdtype)
+            buf[:n] = fs.view_as(np.asarray(rows), fdtype)
+            target = weights if fname == "weights" \
+                else slots[fname[len("slot_"):]]
+            patched = st.deliver_rows_sharded(
+                target, jphys, jnp.asarray(buf), mesh=collection.mesh,
+                spec=sspec, donate=donate)
+            if fname == "weights":
+                weights = patched
+            else:
+                slots[fname[len("slot_"):]] = patched
+    return table_lib.TableState(weights=weights, slots=slots)
+
+
+def _apply_hash_payload(collection, name, state, payload, *,
+                        shard_slice, with_opt):
+    sspec = collection.sharding_spec(name)
+    keys = np.asarray(payload["keys"])
+    key_dtype = np.dtype(state.keys.dtype)
+    empty = hash_lib.empty_key(key_dtype)
+    table_wide = hash_lib.is_wide(state.keys)
+    payload_wide = keys.ndim == 2
+    if table_wide != payload_wide:
+        raise ValueError(
+            f"delta for {name!r}: key width mismatch (payload "
+            f"{'wide' if payload_wide else 'narrow'}, table "
+            f"{'wide' if table_wide else 'narrow'}) — delta chains "
+            "cannot key-migrate; load the base full instead")
+    slot_names = [s for s in state.slots
+                  if with_opt and f"slot_{s}" in payload] if with_opt \
+        else []
+    wdtype = np.dtype(state.weights.dtype)
+    before = state.insert_failures
+    n = keys.shape[0]
+    size = min(_APPLY_CHUNK, max(n, 1))
+    for lo in range(0, n, size):
+        sub = keys[lo:lo + size]
+        got = sub.shape[0]
+        ck = np.full((size,) + sub.shape[1:], empty, dtype=key_dtype)
+        ck[:got] = sub.astype(key_dtype)
+        if shard_slice is not None:
+            k, G = shard_slice
+            ids64 = hash_lib.join64(sub) if payload_wide \
+                else sub.astype(np.int64)
+            ck[:got][(ids64 % G) != k] = empty
+        cw = np.zeros((size,) + payload["weights"].shape[1:], wdtype)
+        cw[:got] = fs.view_as(
+            np.asarray(payload["weights"][lo:lo + size]), wdtype)
+        srows = {}
+        for sname in slot_names:
+            sdtype = np.dtype(state.slots[sname].dtype)
+            block = payload[f"slot_{sname}"][lo:lo + size]
+            cs = np.zeros((size,) + block.shape[1:], sdtype)
+            cs[:got] = fs.view_as(np.asarray(block), sdtype)
+            srows[sname] = jnp.asarray(cs)
+        state = sh.insert_rows_sharded(
+            state, jnp.asarray(ck), jnp.asarray(cw), srows,
+            mesh=collection.mesh, spec=sspec)
+    grew = int(jax.device_get(state.insert_failures - before))
+    if grew > 0:
+        raise RuntimeError(
+            f"hash variable {name!r}: {grew} delta rows did not fit "
+            "(hash_capacity too small); a delta apply must deliver "
+            "every row or fail")
+    return state
+
+
+# --- hot-swap payloads (the train->serve stream) -----------------------------
+
+@dataclasses.dataclass
+class Delta:
+    """One committed delta as an in-memory payload — the unit the
+    trainer publishes and ``ModelRegistry.apply_delta`` patches in.
+    ``vars`` holds the same per-variable dicts the chain files store."""
+
+    seq: int
+    step: int
+    vars: Dict[str, Dict[str, np.ndarray]]
+
+    @property
+    def rows(self) -> int:
+        return sum(int(p["weights"].shape[0]) for p in self.vars.values())
+
+
+def read_delta(path: str, seq: Optional[int] = None) -> Delta:
+    """Load one committed delta (default: the newest) for publishing."""
+    manifest = read_manifest(path)
+    if manifest is None or not manifest["chain"]:
+        raise ValueError(f"no committed deltas at {path!r}")
+    entries = manifest["chain"]
+    if seq is None:
+        entry = entries[-1]
+    else:
+        match = [e for e in entries if e["seq"] == seq]
+        if not match:
+            raise KeyError(f"no delta seq={seq} at {path!r} "
+                           f"(chain has {[e['seq'] for e in entries]})")
+        entry = match[0]
+    payloads = {}
+    for name, info in entry["vars"].items():
+        with fs.open_file(fs.join(path, info["file"]), "rb") as f:
+            raw = f.read()
+        if zlib.crc32(raw) != int(info["crc32"]):
+            raise RuntimeError(
+                f"delta seq={entry['seq']} file {info['file']} fails "
+                "its checksum; refusing to publish a corrupt delta")
+        payloads[name] = _parse_payload(raw)
+    return Delta(seq=int(entry["seq"]), step=int(entry["step"]),
+                 vars=payloads)
+
+
+def read_deltas_since(path: str, after_seq: int) -> List[Delta]:
+    """Committed deltas with ``seq > after_seq``, in order — the catch-up
+    stream for a replica that fell behind."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return []
+    return [read_delta(path, e["seq"]) for e in manifest["chain"]
+            if int(e["seq"]) > int(after_seq)]
+
+
+def encode_delta(delta: Delta, compress: str = "") -> bytes:
+    """Wire-frame a delta: one JSON header line (seq/step/field specs)
+    + concatenated raw array bytes, optionally compressed — the same
+    header-line + packed-body shape as the serving ``lookup_bin`` and
+    peer-restore row pages."""
+    from .utils import compress as compress_lib
+    compress = compress_lib.check(compress)
+    head: Dict[str, Any] = {"seq": delta.seq, "step": delta.step,
+                            "vars": {}}
+    body = bytearray()
+    for name in sorted(delta.vars):
+        payload = delta.vars[name]
+        specs = []
+        for f in sorted(payload):
+            arr = np.ascontiguousarray(np.asarray(payload[f]))
+            specs.append([f, np.lib.format.dtype_to_descr(arr.dtype),
+                          list(arr.shape)])
+            body += arr.tobytes()
+        head["vars"][name] = specs
+    raw = bytes(body)
+    if compress:
+        head["compress"] = compress
+        raw = compress_lib.compress(compress, raw)
+    return json.dumps(head).encode() + b"\n" + raw
+
+
+def decode_delta(data: bytes) -> Delta:
+    from .utils import compress as compress_lib
+    nl = data.index(b"\n")
+    head = json.loads(data[:nl])
+    raw = data[nl + 1:]
+    codec = head.get("compress", "")
+    if codec:
+        raw = compress_lib.decompress(codec, raw)
+    off = 0
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for name, specs in head["vars"].items():
+        payload = {}
+        for f, descr, shape in specs:
+            dtype = np.dtype(np.lib.format.descr_to_dtype(descr))
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            nb = count * dtype.itemsize
+            arr = np.frombuffer(raw[off:off + nb], dtype=dtype)
+            payload[f] = arr.reshape(shape) if shape else arr[0]
+            off += nb
+        out[name] = payload
+    return Delta(seq=int(head["seq"]), step=int(head["step"]), vars=out)
+
+
+# --- the compactor -----------------------------------------------------------
+
+class _Compactor:
+    def __init__(self, thread: threading.Thread):
+        self.thread = thread
+        self.err: Optional[BaseException] = None
+
+
+_COMPACT_LOCK = make_lock("ckpt.compactors")
+_COMPACTORS: Dict[str, _Compactor] = {}
+
+
+def join_compactor(path: str) -> None:
+    """Join (and surface the error of) any background compaction of
+    ``path``. Every delta save calls this first — the compactor and the
+    saver are the directory's only writers and never run concurrently."""
+    key = os.path.realpath(path)
+    with _COMPACT_LOCK:
+        holder = _COMPACTORS.pop(key, None)
+    if holder is None:
+        return
+    holder.thread.join()
+    if holder.err is not None:
+        raise RuntimeError("background chain compaction failed") \
+            from holder.err
+
+
+def compact(path: str, *, background: bool = False,
+            max_workers: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """Fold the committed chain into a new base ON DISK.
+
+    Pure file work (base memmaps + chain payloads; no device, no live
+    states), so it runs on a background thread while training continues.
+    CRASH-SAFE by idempotence: folding performs exactly the newest-wins
+    assignments the load-time replay would, and each base file commits
+    via tmp + atomic rename — a kill mid-compaction leaves the OLD
+    manifest (still referencing the chain) over partially-folded base
+    files, and replaying the chain over a partially-folded base yields
+    the identical state. The new manifest (empty chain, new base_id,
+    ``last_seq`` preserved — seqs are burned, never reused) is the
+    single commit point; superseded delta files are GC'd after it.
+    """
+    if background:
+        key = os.path.realpath(path)
+        join_compactor(path)
+        holder_ref: List[_Compactor] = []
+
+        def _run():
+            sync_point("ckpt.compact.run")
+            try:
+                _compact_impl(path, max_workers=max_workers)
+            except BaseException as e:  # noqa: BLE001 — re-raised at join
+                holder_ref[0].err = e
+
+        t = threading.Thread(target=_run, daemon=False,
+                             name="oe-ckpt-compact")
+        holder = _Compactor(t)
+        holder_ref.append(holder)
+        with _COMPACT_LOCK:
+            _COMPACTORS[key] = holder
+        t.start()
+        return None
+    return _compact_impl(path, max_workers=max_workers)
+
+
+def _compact_impl(path: str, *,
+                  max_workers: Optional[int] = None) -> Dict[str, Any]:
+    from . import checkpoint as ckpt
+    from .meta import ModelMeta, UNBOUNDED_VOCAB
+    manifest = read_manifest(path)
+    if manifest is None or not manifest["chain"]:
+        return {"compacted": False}
+    # bounded-memory verification: payloads re-read one at a time below
+    verified, _dropped = verify_chain(path, manifest, keep_payloads=False)
+    entries = [e for e, _p in verified]
+    with fs.open_file(fs.join(path, ckpt.MODEL_META_FILE), "rb") as f:
+        meta = ModelMeta.loads(f.read().decode("utf-8"))
+    by_name = {v.name: v for v in meta.variables}
+    # fold per variable: every chain payload for it, in order
+    folded_steps = [e["step"] for e in entries]
+    for name, v in by_name.items():
+        has = [e for e in entries if name in e["vars"]]
+        if not has:
+            continue
+        vdir = os.path.join(path, ckpt._var_dir(v.variable_id, name))
+        if v.meta.vocabulary_size >= UNBOUNDED_VOCAB:
+            # hash folds need every payload's keys up front for the
+            # newest-wins merge + sizing; hash deltas carry live rows
+            # only, so this is the dirty working set, not the table
+            _fold_hash_var(vdir, [_entry_payload(path, e, name)
+                                  for e in has])
+        else:
+            _fold_array_var(vdir, path, has, name,
+                            max_workers=max_workers)
+    new_manifest = {"format": DELTA_FORMAT,
+                    "base_id": uuid.uuid4().hex,
+                    "base_step": int(folded_steps[-1]) if folded_steps
+                    else manifest["base_step"],
+                    "include_optimizer":
+                        bool(manifest.get("include_optimizer", True)),
+                    "last_seq": int(manifest["last_seq"]),
+                    "chain": []}
+    sync_point("ckpt.compact.commit")
+    _write_manifest(path, new_manifest)
+    _gc_orphans(path, chain=())
+    return {"compacted": True, "folded": len(verified),
+            "last_seq": new_manifest["last_seq"]}
+
+
+def _commit_file(tmp: str, final: str) -> None:
+    os.replace(tmp, final)
+
+
+def _fold_array_var(vdir: str, path: str, entries: List[Dict[str, Any]],
+                    name: str,
+                    max_workers: Optional[int] = None) -> None:
+    """New base field files = old base with every payload's chunk rows
+    overwritten (in chain order; later payloads win by overwrite).
+    Payloads are loaded ONE AT A TIME (memory stays bounded by one
+    delta, not the chain)."""
+    from . import checkpoint as ckpt
+    fields = sorted(f[:-4] for f in os.listdir(vdir)
+                    if f.endswith(".npy"))
+    srcs, dsts = {}, {}
+    tasks = []
+    for field in fields:
+        src_path = os.path.join(vdir, field + ".npy")
+        src = np.load(src_path, mmap_mode="r")
+        dst = np.lib.format.open_memmap(
+            src_path + ".compact.tmp", mode="w+",
+            dtype=src.dtype, shape=src.shape)
+        srcs[field], dsts[field] = src, dst
+        row_bytes = max(1, src.nbytes // max(1, src.shape[0]))
+        win = max(1, ckpt._PAR_WINDOW_BYTES // row_bytes)
+        for lo in range(0, src.shape[0], win):
+            hi = min(src.shape[0], lo + win)
+            tasks.append(lambda lo=lo, hi=hi, src=src, dst=dst:
+                         dst.__setitem__(slice(lo, hi), src[lo:hi]))
+    ckpt._run_writers(tasks, max_workers=max_workers)
+    for entry in entries:
+        payload = _entry_payload(path, entry, name)
+        if payload is None:
+            continue
+        ids = _payload_ids(payload)
+        for field in fields:
+            if field not in payload:
+                continue
+            # delta-sized scatter (random IO bounded by the delta, not
+            # the base)
+            dsts[field][ids] = fs.view_as(np.asarray(payload[field]),
+                                          srcs[field].dtype)
+        del payload
+    for field in fields:
+        dsts[field].flush()
+        del dsts[field], srcs[field]
+        _commit_file(os.path.join(vdir, field + ".npy.compact.tmp"),
+                     os.path.join(vdir, field + ".npy"))
+
+
+def _fold_hash_var(vdir: str, payloads: List[Dict[str, np.ndarray]]
+                   ) -> None:
+    """New base = old live rows with payload rows merged newest-wins by
+    64-bit key; keys absent from the base append at the end."""
+    key_path = os.path.join(vdir, "keys.npy")
+    base_keys = np.load(key_path, mmap_mode="r")
+    wide = base_keys.ndim == 2
+    k64_base = hash_lib.join64(np.asarray(base_keys)) if wide \
+        else np.asarray(base_keys).astype(np.int64)
+    order = np.argsort(k64_base, kind="stable")
+    sorted_base = k64_base[order]
+    # newest-wins merge across payloads: last occurrence of each key
+    all_k, all_src = [], []
+    for pi, payload in enumerate(payloads):
+        pk = np.asarray(payload["keys"])
+        k64 = hash_lib.join64(pk) if pk.ndim == 2 \
+            else pk.astype(np.int64)
+        all_k.append(k64)
+        all_src.append(np.stack(
+            [np.full(k64.shape, pi, np.int64),
+             np.arange(k64.shape[0], dtype=np.int64)], axis=1))
+    cat_k = np.concatenate(all_k) if all_k else np.zeros(0, np.int64)
+    cat_src = np.concatenate(all_src) if all_src \
+        else np.zeros((0, 2), np.int64)
+    rev_k = cat_k[::-1]
+    uniq, ridx = np.unique(rev_k, return_index=True)
+    take = cat_k.shape[0] - 1 - ridx          # last occurrence, keys sorted
+    src = cat_src[take]
+    pos = np.searchsorted(sorted_base, uniq)
+    pos_c = np.minimum(pos, max(0, sorted_base.shape[0] - 1))
+    hit = (pos < sorted_base.shape[0]) & (sorted_base[pos_c] == uniq) \
+        if sorted_base.size else np.zeros(uniq.shape, bool)
+    exist_rows = order[pos_c[hit]] if sorted_base.size \
+        else np.zeros(0, np.int64)
+    new_src = src[~hit]
+    n_base = int(base_keys.shape[0])
+    total = n_base + int(new_src.shape[0])
+    fields = sorted(f[:-4] for f in os.listdir(vdir)
+                    if f.endswith(".npy"))
+    del base_keys
+    for field in fields:
+        src_path = os.path.join(vdir, field + ".npy")
+        base = np.load(src_path, mmap_mode="r")
+        tmp_path = src_path + ".compact.tmp"
+        dst = np.lib.format.open_memmap(
+            tmp_path, mode="w+", dtype=base.dtype,
+            shape=(total,) + base.shape[1:])
+        chunk = max(1, (32 << 20) // max(1, base.nbytes
+                                         // max(1, n_base or 1)))
+        for lo in range(0, n_base, chunk):
+            hi = min(n_base, lo + chunk)
+            dst[lo:hi] = base[lo:hi]
+
+        def rows_for(sel_src):
+            parts = []
+            for pi, payload in enumerate(payloads):
+                mask = sel_src[:, 0] == pi
+                if mask.any():
+                    parts.append((mask, payload[field][sel_src[mask, 1]]))
+            out = None
+            for mask, rows in parts:
+                if out is None:
+                    out = np.zeros((sel_src.shape[0],) + rows.shape[1:],
+                                   base.dtype)
+                out[mask] = fs.view_as(np.asarray(rows), base.dtype)
+            return out
+
+        if exist_rows.size:
+            upd = rows_for(src[hit])
+            if upd is not None:
+                dst[exist_rows] = upd
+        if new_src.size:
+            app = rows_for(new_src)
+            if app is not None:
+                dst[n_base:] = app
+        dst.flush()
+        del dst, base
+        _commit_file(tmp_path, src_path)

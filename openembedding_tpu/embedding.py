@@ -131,6 +131,10 @@ class EmbeddingCollection:
             default_initializer = dict(table_lib.DEFAULT_INITIALIZER)
         self.mesh = mesh
         self.specs: Dict[str, EmbeddingSpec] = {}
+        # chunk-level dirty bitmaps for delta checkpoints (dirty.py);
+        # empty until enable_dirty_tracking() — marking is then fed by
+        # the Trainer's host loop and by eager apply_gradients calls
+        self._dirty_trackers: Dict[str, Any] = {}
         self._variable_ids: Dict[str, int] = {}
         self._optimizers = {}
         self._initializers = {}
@@ -161,6 +165,72 @@ class EmbeddingCollection:
                     layout=spec.layout, plane=spec.plane,
                     a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack,
                     cache_k=spec.cache_k)
+
+    # --- dirty tracking (delta checkpoints, checkpoint.py mode="delta") ----
+    def enable_dirty_tracking(self, *, target_chunks: int = 1024) -> None:
+        """Arm chunk-level dirty bitmaps for every variable (idempotent).
+
+        Required before ``checkpoint.save_checkpoint(mode="delta")``:
+        pushes mark chunks (the Trainer feeds every stepped batch's ids
+        via :meth:`mark_dirty`; eager ``apply_gradients`` calls mark
+        directly), and a delta save writes only the marked chunks —
+        the reference's ICDE'23 incremental checkpoints from dirty
+        tracking, generalized out of the offload tier (``dirty.py``).
+
+        CUSTOM JITTED LOOPS: inside a jit the indices are tracers and
+        cannot mark (the skip is deliberate and silent — marking at
+        trace time would record once per COMPILE). A loop that jits its
+        own step around ``apply_gradients`` must call
+        ``collection.mark_dirty(batch["sparse"])`` host-side once per
+        step, exactly as ``Trainer.train_step`` does — otherwise delta
+        saves see nothing dirty and a chain restore silently reverts
+        to the base.
+        """
+        from .dirty import make_array_tracker, make_hash_tracker
+        for name, spec in self.specs.items():
+            if name in self._dirty_trackers:
+                continue
+            if spec.use_hash:
+                self._dirty_trackers[name] = make_hash_tracker(
+                    name, spec.hash_capacity, target_chunks)
+            else:
+                self._dirty_trackers[name] = make_array_tracker(
+                    name, spec.input_dim, target_chunks)
+
+    @property
+    def dirty_trackers(self) -> Dict[str, Any]:
+        """``name -> DirtyTracker`` (empty unless tracking is enabled)."""
+        return self._dirty_trackers
+
+    def mark_dirty(self, sparse_inputs: Dict[str, Any]) -> None:
+        """Mark the chunks a batch's pushes touched (host-side; a no-op
+        unless tracking is enabled). Safe to over-mark — ids whose
+        gradient was zero just cost delta bytes. Tracer inputs (an
+        outer jit trace) are skipped: the Trainer marks from the HOST
+        batch once per step instead, so marks count per step, not per
+        compile."""
+        if not self._dirty_trackers:
+            return
+        from . import hash_table as hash_lib
+        for name, idx in sparse_inputs.items():
+            tracker = self._dirty_trackers.get(name)
+            if tracker is None or idx is None:
+                continue
+            if isinstance(idx, jax.core.Tracer):
+                continue
+            arr = np.asarray(jax.device_get(idx)) \
+                if isinstance(idx, jax.Array) else np.asarray(idx)
+            spec = self.specs[name]
+            if spec.use_hash:
+                if spec.key_dtype == "wide" and arr.ndim >= 2 \
+                        and arr.shape[-1] == 2:
+                    keys = hash_lib.join64(arr.reshape(-1, 2))
+                else:
+                    keys = arr.astype(np.int64).ravel()
+                tracker.mark_keys(keys)
+            else:
+                ids = arr.astype(np.int64).ravel()
+                tracker.mark_rows(ids[(ids >= 0) & (ids < spec.input_dim)])
 
     # --- introspection -----------------------------------------------------
     def variable_id(self, name: str) -> int:
@@ -411,6 +481,9 @@ class EmbeddingCollection:
         ``row_grads[name]`` has the shape of the pulled rows. Untouched
         variables keep their state object unchanged.
         """
+        # delta-checkpoint dirty marks for EAGER pushes (tracer inputs —
+        # the jitted Trainer step — skip; the Trainer marks host-side)
+        self.mark_dirty({n: inputs.get(n) for n in row_grads})
         new_states = dict(states)
         grouped_idx: Dict[str, jnp.ndarray] = {}
         grouped_grads: Dict[str, jnp.ndarray] = {}

@@ -44,6 +44,7 @@ import numpy as np
 
 from .analysis import scope
 from .analysis.concurrency import make_rlock, sync_point
+from .dirty import DirtyTracker
 from .embedding import EmbeddingSpec
 from .meta import EmbeddingVariableMeta
 from .optim.initializers import make_initializer
@@ -498,7 +499,6 @@ class ShardedOffloadedTable:
         # prepares/applies redone because an eviction rebuilt residency
         # under them (the generation protocol's retry paths)
         self.gen_retries = 0
-        self._dirty = np.zeros(self.vocab, bool)
         self._last_touch = np.zeros(self.vocab, np.int64)
         self.work_id = 1
         self.persisted_work = 0
@@ -511,6 +511,14 @@ class ShardedOffloadedTable:
         # would deadlock). Written by the writer, read at join: the
         # thread join is the happens-before edge, no lock involved.
         self._writer_err_dirty: Optional[np.ndarray] = None
+        # row-granular dirty book (rows_per_chunk=1: the writeback
+        # scatter is row-exact); shares _book so dirty marks stay atomic
+        # with the residency bookkeeping. The same DirtyTracker, at
+        # chunk granularity, drives the whole-model delta checkpoints
+        # (checkpoint.save_checkpoint mode="delta") — this tier is where
+        # the machinery was generalized FROM (dirty.py).
+        self._dirty = DirtyTracker(self.vocab, rows_per_chunk=1,
+                                   name=f"offload.{name}", lock=self._book)
         self._persister: Optional[threading.Thread] = None
         self._persister_err: Optional[BaseException] = None
         # latest cumulative insert_failures copy; read ONLY at join
@@ -582,7 +590,7 @@ class ShardedOffloadedTable:
                 # updates not written: re-mark so a later flush retries
                 # (over-marking rows re-dirtied meanwhile is harmless)
                 with self._book:
-                    self._dirty[redo] = True
+                    self._dirty.restore(redo)
             raise RuntimeError("async writeback failed") from err
 
     def _start_writeback(self, cache, dirty_ids: np.ndarray) -> None:
@@ -633,7 +641,7 @@ class ShardedOffloadedTable:
         # clear eagerly so updates landing DURING the writeback re-mark
         # their rows; restored at the join on failure
         with self._book:
-            self._dirty[dirty_ids] = False
+            self._dirty.clear_chunks(dirty_ids)
         self._writer = threading.Thread(
             target=_run, daemon=True, name=f"oe-writeback-{self.name}")
         self._writer.start()
@@ -962,7 +970,7 @@ class ShardedOffloadedTable:
             # writeback every dirty resident row (host becomes fully
             # current), synchronously — the rebuild below must read
             # current host rows
-            dirty_ids = resident_ids[self._dirty[resident_ids]]
+            dirty_ids = resident_ids[self._dirty.mask_rows(resident_ids)]
             self._start_writeback(cache, dirty_ids)
             self._join_writeback()
             cache = self.create_cache(jax.random.PRNGKey(int(self.work_id)))
@@ -996,7 +1004,7 @@ class ShardedOffloadedTable:
             uniq = np.unique(np.asarray(ids).ravel())
             uniq = uniq[(uniq >= 0) & (uniq < self.vocab)]
         with self._book:
-            self._dirty[uniq] = True
+            self._dirty.mark_rows(uniq)
         self.work_id += 1
         self._batches_since_persist += 1
         n = self.overflow_check_every_n_batches
@@ -1016,7 +1024,7 @@ class ShardedOffloadedTable:
             self.check_overflow(cache)
             sync_point("offload.flush")
             with self._book:
-                dirty_ids = np.nonzero(self._dirty)[0]
+                dirty_ids = self._dirty.dirty_chunks()
             if dirty_ids.size:
                 self._start_writeback(cache, dirty_ids)
             return int(dirty_ids.size)
@@ -1129,6 +1137,6 @@ class ShardedOffloadedTable:
             self._gen += 1
             self._planned[:] = False
             self._planned_count = 0
-            self._dirty[:] = False
+            self._dirty.clear_all()
             self._last_touch[:] = 0
         return self.create_cache(jax.random.PRNGKey(int(self.work_id)))

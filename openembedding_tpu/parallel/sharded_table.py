@@ -273,11 +273,14 @@ def filled_sharded(mesh: Mesh, spec: ShardingSpec, tail: tuple,
 
 
 @functools.lru_cache(maxsize=None)
-def _deliver_program(mesh: Mesh, spec: ShardingSpec, tail: tuple, dtype):
+def _deliver_program(mesh: Mesh, spec: ShardingSpec, tail: tuple, dtype,
+                     donate: bool = True):
     """Cached scatter program: place replicated (phys_row, value) chunks
     onto the owning device shards — the array-table twin of the hash
     loader's ``insert_rows_sharded`` chunk delivery, so a REMOTE checkpoint
-    (sequential chunk stream, no memmap) loads with bounded host memory."""
+    (sequential chunk stream, no memmap) loads with bounded host memory.
+    ``donate=False`` keeps the input buffers alive (the serving hot-swap
+    patches a COPY while in-flight readers keep the published state)."""
     rps = spec.rows_per_shard
     axes = spec.shard_axes
     sizes = tuple(mesh.shape[a] for a in axes)
@@ -292,20 +295,23 @@ def _deliver_program(mesh: Mesh, spec: ShardingSpec, tail: tuple, dtype):
     row = spec.row_spec()
     fn = shard_map(_deliver, mesh=mesh, in_specs=(row, P(), P()),
                    out_specs=row, check_vma=False)
-    return jax.jit(fn, donate_argnums=(0,))
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 def deliver_rows_sharded(arr: jnp.ndarray, phys: jnp.ndarray,
                          rows: jnp.ndarray, *, mesh: Mesh,
-                         spec: ShardingSpec) -> jnp.ndarray:
+                         spec: ShardingSpec,
+                         donate: bool = True) -> jnp.ndarray:
     """Scatter rows at PHYSICAL positions into a sharded array.
 
     ``phys``/``rows`` are replicated host chunks (phys = shard *
     rows_per_shard + local; -1 = padding). Chunks of one size reuse one
-    compiled program.
+    compiled program. The checkpoint loader donates (the blank canvas is
+    dead after delivery); the serving hot-swap passes ``donate=False`` so
+    readers holding the pre-swap state never see a deleted buffer.
     """
     fn = _deliver_program(mesh, spec, tuple(rows.shape[1:]),
-                          np.dtype(arr.dtype).name)
+                          np.dtype(arr.dtype).name, donate)
     return fn(arr, phys, rows)
 
 

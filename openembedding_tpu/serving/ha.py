@@ -335,7 +335,10 @@ def restore_model_from_peer(registry, endpoint: str, sign: str, *,
                     mesh=coll.mesh, spec=sspec)
             out[name] = coll.wrap_hot_cache(
                 name, table_lib.TableState(weights=weights, slots={}))
-    model = ServingModel(sign, coll, out, meta, shard_slice=shard_slice)
+    # carry the peer's hot-swap version: the streamed rows already
+    # reflect every delta it applied (pre-upgrade peers send none -> 0)
+    model = ServingModel(sign, coll, out, meta, shard_slice=shard_slice,
+                         version=int(info.get("version", 0)))
     return registry.register_model(model)
 
 
@@ -531,6 +534,29 @@ class RoutingClient:
             signs.append(out["model_sign"])
         return signs
 
+    def push_delta(self, sign: str, delta) -> List[Dict[str, Any]]:
+        """BROADCAST a trainer-published delta to every replica (the
+        streaming train->serve hot-swap, ``registry.apply_delta``) —
+        unlike lookups this is not a failover pick: every replica must
+        converge to the published version. ``delta`` is a
+        ``checkpoint_delta.Delta`` or its ``encode_delta`` bytes.
+        Per-endpoint results carry ``error`` instead of raising, so one
+        dead replica does not stop the rest of the fleet from advancing
+        (it catches up at respawn via ``read_deltas_since`` or reload).
+        """
+        from .. import checkpoint_delta as cd
+        body = bytes(delta) if isinstance(delta, (bytes, bytearray)) \
+            else cd.encode_delta(delta)
+        out: List[Dict[str, Any]] = []
+        for ep in self.endpoints:
+            try:
+                raw = self._request_bin(ep, f"/models/{sign}/delta", body)
+                out.append({"endpoint": ep, **json.loads(raw)})
+            except Exception as e:  # noqa: BLE001 — per-replica verdict
+                out.append({"endpoint": ep, "applied": False,
+                            "error": f"{type(e).__name__}: {e}"})
+        return out
+
     def nodes(self) -> List[Dict[str, Any]]:
         """Cluster liveness, client-side aggregated."""
         from .rest import probe_nodes
@@ -625,6 +651,17 @@ class ShardedRoutingClient:
                      "block": block})
                 signs.append(out["model_sign"])
         return signs
+
+    def push_delta(self, sign: str, delta) -> List[Dict[str, Any]]:
+        """Broadcast a delta to every replica of every shard group (each
+        process's shard slice keeps only its owned rows, exactly like
+        the load path's slice filter). Encoded ONCE here, not once per
+        group."""
+        from .. import checkpoint_delta as cd
+        body = bytes(delta) if isinstance(delta, (bytes, bytearray)) \
+            else cd.encode_delta(delta)
+        return [res for g in self.groups
+                for res in g.push_delta(sign, body)]
 
     def nodes(self) -> List[Dict[str, Any]]:
         from .rest import probe_nodes

@@ -48,12 +48,22 @@ class ServingModel:
 
     def __init__(self, sign: str, collection: EmbeddingCollection,
                  states: Dict[str, Any], meta: ModelMeta,
-                 shard_slice=None):
+                 shard_slice=None, version: int = 0):
         self.sign = sign
         self.collection = collection
         self.states = states
         self.meta = meta
         self.shard_slice = tuple(shard_slice) if shard_slice else None
+        # hot-swap version: the delta-chain seq this model's states
+        # reflect (checkpoint_delta.py). apply_delta bumps it together
+        # with the states swap under the registry lock; readers snapshot
+        # (states, version) in one reference grab, so a lookup is always
+        # served from exactly one version
+        self.version = int(version)
+        # serializes CONCURRENT apply_delta builds for this model (the
+        # build runs device programs; the registry lock only guards the
+        # final publish)
+        self.swap_lock = make_lock(f"serving.swap.{sign}")
         self._by_id = {collection.variable_id(name): name
                        for name in collection.specs}
 
@@ -166,8 +176,15 @@ class ServingModel:
                 from .. import hash_table as hash_lib
                 empty = hash_lib.empty_key(idx.dtype)
                 idx = jnp.where(idx % G == k, idx, empty)
+        # ONE reference grab = one consistent version: a concurrent
+        # apply_delta publishes a whole NEW states dict (never mutates
+        # this one), so every row this lookup returns comes from exactly
+        # one version — the swap-during-lookup interleaving schedule
+        # pins this (tests/test_delta_checkpoint.py)
+        states = self.states
+        sync_point("serving.lookup.snapshot")
         with scope.span("serving.lookup", table=name):
-            rows = self.collection.pull(self.states, {name: idx},
+            rows = self.collection.pull(states, {name: idx},
                                         batch_sharded=False,
                                         read_only=True,
                                         serving_rows=as_rows)
@@ -285,12 +302,18 @@ class ModelRegistry:
                     coll = EmbeddingCollection(specs, self.mesh)
                     states = ckpt_lib.load_checkpoint(
                         model_uri, coll, shard_slice=shard_slice)
+                    # hot-swap version = the delta-chain seq the load
+                    # replayed up to (0 for plain full checkpoints)
+                    from .. import checkpoint_delta as cd
+                    version = cd.applied_seq(model_uri)
                     model = ServingModel(sign, coll, states, meta,
-                                         shard_slice=shard_slice)
+                                         shard_slice=shard_slice,
+                                         version=version)
                 sync_point("registry.load.commit")
                 with self._lock:
                     self._models[sign] = model
                     self._status[sign]["model_status"] = ModelStatus.NORMAL
+                    self._status[sign]["version"] = model.version
             except Exception as e:  # noqa: BLE001 — recorded, not swallowed
                 with self._lock:
                     self._status[sign]["model_status"] = ModelStatus.ERROR
@@ -353,8 +376,61 @@ class ModelRegistry:
                 "model_status": ModelStatus.NORMAL, "model_error": "",
                 "replica_num": replica_num,
                 "shard_index": ss[0], "shard_count": ss[1],
+                "version": model.version,
             }
         return model.sign
+
+    def apply_delta(self, sign: str, delta) -> Dict[str, Any]:
+        """Streaming hot-swap: patch a loaded model's rows in place from
+        a trainer-published delta (``checkpoint_delta.Delta`` or its
+        ``encode_delta`` wire bytes) — live model updates every N steps
+        WITHOUT a full-model reload, the train->serve loop the reference
+        closes with TF-Serving + the HA PS.
+
+        Version-gated: the delta's ``seq`` must be exactly
+        ``model.version + 1`` (deltas are incremental; a gap would lose
+        the skipped delta's rows — catch up via
+        ``checkpoint_delta.read_deltas_since`` or reload). A stale seq
+        is acknowledged as a no-op (replays from a retrying publisher
+        are idempotent). The patched states are built FUNCTIONALLY
+        (non-donating scatter/insert) and published as one reference
+        swap under the registry lock, so in-flight lookups keep their
+        snapshot and new lookups see the new version whole — readers
+        never observe a mixed version.
+        """
+        from .. import checkpoint_delta as cd
+        from ..utils import observability
+        if isinstance(delta, (bytes, bytearray)):
+            delta = cd.decode_delta(bytes(delta))
+        model = self.find_model(sign)
+        with model.swap_lock:
+            if delta.seq <= model.version:
+                return {"applied": False, "version": model.version,
+                        "reason": f"stale delta seq {delta.seq}"}
+            if delta.seq != model.version + 1:
+                raise RuntimeError(
+                    f"model {sign!r} is at version {model.version}; "
+                    f"delta seq {delta.seq} leaves a gap — apply the "
+                    "chain in order (read_deltas_since) or reload")
+            sync_point("registry.swap.build")
+            with scope.span("registry.apply_delta",
+                            detail={"sign": sign, "seq": delta.seq}):
+                new_states = cd.apply_delta_to_states(
+                    model.collection, model.states, delta.vars,
+                    shard_slice=model.shard_slice,
+                    with_opt=False, donate=False)
+                # surface apply errors HERE, not under a later reader
+                import jax as _jax
+                _jax.block_until_ready(_jax.tree.leaves(new_states))
+            sync_point("registry.swap.commit")
+            with self._lock:
+                model.states = new_states
+                model.version = int(delta.seq)
+                if sign in self._status:
+                    self._status[sign]["version"] = model.version
+        observability.record_swap(delta.rows, delta.seq)
+        return {"applied": True, "version": int(delta.seq),
+                "rows": int(delta.rows)}
 
     def delete_model(self, sign: str) -> None:
         with self._lock:

@@ -136,6 +136,11 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                         "meta": model.meta.dumps(),
                         "shard_index": st.get("shard_index", 0),
                         "shard_count": st.get("shard_count", 1),
+                        # hot-swap version: the restorer's rows reflect
+                        # every delta this peer applied, so the restored
+                        # model must START at this version or it would
+                        # refuse the next push_delta as a gap
+                        "version": model.version,
                         "variables": [
                             {"name": name,
                              "use_hash": model.collection.specs[
@@ -222,6 +227,15 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                         np.asarray(req["indices"], dtype=np.int64
                                    if req.get("int64") else np.int32))
                     return self._send(200, {"rows": np.asarray(rows).tolist()})
+                m = re.fullmatch(r"/models/([^/]+)/delta", self.path)
+                if m:
+                    # streaming hot-swap: trainer-published delta bytes
+                    # (checkpoint_delta.encode_delta wire frame) patched
+                    # into the loaded model under version gating
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n)
+                    return self._send(200,
+                                      registry.apply_delta(m.group(1), raw))
                 m = re.fullmatch(r"/models/([^/]+)/lookup_bin", self.path)
                 if m:
                     # serving-grade data plane: packed ids in, packed f32

@@ -404,6 +404,14 @@ class Trainer:
                 else:
                     state, metrics = self._train_step(
                         state, self.shard_batch(batch))
+                if self.collection.dirty_trackers:
+                    # delta-checkpoint dirty marks from the HOST batch:
+                    # the jitted step's in-trace ids are tracers, so the
+                    # collection cannot mark there (once per compile);
+                    # here marks land once per step, pipelined plane
+                    # included (its push(N) commits inside step N)
+                    cols, _ = self._split_sparse(batch["sparse"])
+                    self.collection.mark_dirty(cols)
                 for name, table in self.offload.items():
                     table.note_update(batch["sparse"][name],
                                       uniq=uniqs.get(name))

@@ -257,6 +257,87 @@ def potential_deadlocks() -> list:
     return concurrency.potential_deadlocks()
 
 
+# --- last-value gauges -------------------------------------------------------
+
+# process-wide gauges (latest value wins, unlike the monotonic
+# Accumulator counters): checkpoint chain length / write rate, serving
+# swap version — exported on /metrics as prometheus gauges
+_GAUGE_LOCK = make_lock("observability.gauges")
+_GAUGES: Dict[str, float] = {}
+
+
+def set_gauge(name: str, value: float) -> None:
+    with _GAUGE_LOCK:
+        _GAUGES[name] = float(value)
+
+
+def gauges() -> Dict[str, float]:
+    with _GAUGE_LOCK:
+        return dict(_GAUGES)
+
+
+# --- checkpoint / serving-swap counters (delta checkpoint plane) -------------
+
+def record_ckpt_save(mode: str, nbytes: int, seconds: float, *,
+                     chain_len: Optional[int] = None,
+                     accumulator: Optional[Accumulator] = None) -> None:
+    """One checkpoint save's ledger entry (``checkpoint.save_checkpoint``):
+    ``ckpt_full_bytes``/``ckpt_delta_bytes`` counters accumulate bytes
+    moved per mode — the delta plane's headline claim (a ≤5%-dirty delta
+    moves ≥10x fewer bytes than a full save) is asserted against exactly
+    these counters — plus ``ckpt_write_gbps``/``ckpt_chain_len`` gauges
+    and a per-mode write-rate histogram for /metrics."""
+    acc = accumulator or GLOBAL
+    acc.add(f"ckpt_{mode}_bytes", float(nbytes))
+    acc.add(f"ckpt_{mode}_saves", 1.0)
+    gbps = nbytes / max(seconds, 1e-9) / 1e9
+    set_gauge("ckpt_write_gbps", gbps)
+    if chain_len is not None:
+        set_gauge("ckpt_chain_len", float(chain_len))
+    scope.HISTOGRAMS.observe("ckpt_write_gbps", gbps, mode=mode)
+
+
+def ckpt_stats(accumulator: Optional[Accumulator] = None) -> Dict[str, float]:
+    """Checkpoint-plane counters: bytes/saves per mode (monotonic) plus
+    the latest chain length and write rate."""
+    snap = (accumulator or GLOBAL).snapshot()
+    g = gauges()
+
+    def _count(name: str) -> float:
+        return snap.get(name, {}).get("count", 0.0)
+
+    return {
+        "ckpt_full_bytes": _count("ckpt_full_bytes"),
+        "ckpt_delta_bytes": _count("ckpt_delta_bytes"),
+        "ckpt_full_saves": _count("ckpt_full_saves"),
+        "ckpt_delta_saves": _count("ckpt_delta_saves"),
+        "ckpt_chain_len": g.get("ckpt_chain_len", 0.0),
+        "ckpt_write_gbps": g.get("ckpt_write_gbps", 0.0),
+    }
+
+
+def record_swap(rows: int, version: int, *,
+                accumulator: Optional[Accumulator] = None) -> None:
+    """One serving hot-swap (``ModelRegistry.apply_delta``): swap count +
+    rows patched (counters) and the published version (gauge)."""
+    acc = accumulator or GLOBAL
+    acc.add("serving_swap_total", 1.0)
+    acc.add("serving_swap_rows", float(rows))
+    set_gauge("serving_swap_version", float(version))
+
+
+def swap_stats(accumulator: Optional[Accumulator] = None) -> Dict[str, float]:
+    snap = (accumulator or GLOBAL).snapshot()
+    g = gauges()
+    return {
+        "serving_swap_total": snap.get("serving_swap_total",
+                                       {}).get("count", 0.0),
+        "serving_swap_rows": snap.get("serving_swap_rows",
+                                      {}).get("count", 0.0),
+        "serving_swap_version": g.get("serving_swap_version", 0.0),
+    }
+
+
 # --- host-memory ledger (graftwatch) -----------------------------------------
 
 # live memory sources, keyed by object id -> (kind, name, weakref):
@@ -365,6 +446,13 @@ def prometheus_text(accumulator: Optional[Accumulator] = None,
                          f"`{name}`")
             lines.append(f"# TYPE {base}_calls_total counter")
             lines.append(f"{base}_calls_total {fields['calls']}")
+    # last-value gauges (checkpoint chain length / write rate, serving
+    # swap version, ...)
+    for name, value in sorted(gauges().items()):
+        base = f"{prefix}_{_prom_name(name)}"
+        lines.append(f"# HELP {base} last-value gauge `{name}`")
+        lines.append(f"# TYPE {base} gauge")
+        lines.append(f"{base} {value:.10g}")
     # graftrace traced-lock counters (empty unless OE_REPORT_TRACE_LOCKS)
     for name, st in sorted(lock_stats().items()):
         base = f"{prefix}_lock_{_prom_name(name)}"

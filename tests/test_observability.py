@@ -179,9 +179,13 @@ def test_prometheus_text_golden(monkeypatch):
     scope.HISTOGRAMS.reset()
     scope.HISTOGRAMS.observe("span_pull_seconds", 0.25, plane="a2a")
     # deterministic memory section: only the span-ring source (emptied),
-    # no leftover registered tables from earlier tests in the session
+    # no leftover registered tables from earlier tests in the session;
+    # gauges likewise start clean (earlier checkpoint saves in the
+    # session set ckpt_* gauges), with one known value for the section
     scope.reset()
     monkeypatch.setattr(obs, "_MEM_SOURCES", {})
+    monkeypatch.setattr(obs, "_GAUGES", {})
+    obs.set_gauge("ckpt_chain_len", 3)
     got = obs.prometheus_text(acc)
     want = """\
 # HELP oe_pull_indices_total accumulated count of `pull_indices`
@@ -193,6 +197,9 @@ oe_train_step_seconds_total 0.5
 # HELP oe_train_step_calls_total timed calls of `train_step`
 # TYPE oe_train_step_calls_total counter
 oe_train_step_calls_total 1
+# HELP oe_ckpt_chain_len last-value gauge `ckpt_chain_len`
+# TYPE oe_ckpt_chain_len gauge
+oe_ckpt_chain_len 3
 # HELP oe_span_pull_seconds graftscope histogram `span_pull_seconds` (log-spaced buckets)
 # TYPE oe_span_pull_seconds histogram
 oe_span_pull_seconds_bucket{plane="a2a",le="0.3162"} 1
@@ -377,11 +384,20 @@ def test_prometheus_text_and_endpoint(devices8):
             assert r.headers["Content-Type"].startswith("text/plain")
             body = r.read().decode()
         assert "oe_pull_indices_total 512" in body
-        # the scrape itself ran under a request span — the SECOND scrape
-        # must expose the http latency histogram series
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
-            body2 = r.read().decode()
+        # the scrape itself ran under a request span — a LATER scrape
+        # must expose the http latency histogram series. The span's
+        # histogram sample lands a hair after the response bytes (the
+        # handler thread exits its span after writing), so poll briefly
+        # instead of racing it on a loaded box
+        import time as _time
+        body2 = ""
+        for _ in range(40):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+                body2 = r.read().decode()
+            if "# TYPE oe_span_http_seconds histogram" in body2:
+                break
+            _time.sleep(0.05)
         assert "# TYPE oe_span_http_seconds histogram" in body2
         assert 'oe_span_http_seconds_bucket{method="GET",' \
                'route="/metrics",le="+Inf"}' in body2

@@ -1,0 +1,128 @@
+"""Delta-checkpoint round-trip smoke for CI (beside the graftscope smoke).
+
+save base -> train -> save delta (x2) -> load -> BIT-compare against the
+live states, then simulate a torn final delta and assert the load
+recovers to the previous complete delta. Exits nonzero on any mismatch;
+writes a JSON summary (uploaded as a CI artifact).
+
+    python -m tools.ckpt_delta_smoke [--out /tmp/ckpt_delta_smoke.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import warnings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="", help="JSON summary path")
+    ap.add_argument("--vocab", type=int, default=4096)
+    ap.add_argument("--dim", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as ckpt
+    from openembedding_tpu import checkpoint_delta as cd
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.utils import observability as obs
+
+    mesh = create_mesh(2, 4, jax.devices()[:8])
+    coll = EmbeddingCollection(
+        (EmbeddingSpec(name="arr", input_dim=args.vocab,
+                       output_dim=args.dim),
+         EmbeddingSpec(name="hsh", input_dim=-1, output_dim=args.dim,
+                       hash_capacity=2048)),
+        mesh, default_optimizer={"category": "adagrad",
+                                 "learning_rate": 0.1})
+    coll.enable_dirty_tracking(target_chunks=128)
+    states = coll.init(jax.random.PRNGKey(0))
+
+    def train(states, seed):
+        rng = np.random.RandomState(seed)
+        idx = {"arr": jnp.asarray(
+            rng.randint(0, args.vocab, 64).astype(np.int32)),
+            "hsh": jnp.asarray(rng.randint(0, 2**20, 64)
+                               .astype(np.int32))}
+        rows = coll.pull(states, idx, batch_sharded=False)
+        grads = {k: jnp.ones_like(v) * 0.1 for k, v in rows.items()}
+        return coll.apply_gradients(states, idx, grads,
+                                    batch_sharded=False), idx
+
+    summary = {"ok": False, "checks": []}
+
+    def check(name, cond):
+        summary["checks"].append({"name": name, "ok": bool(cond)})
+        if not cond:
+            print(f"ckpt_delta_smoke: FAIL {name}", file=sys.stderr)
+        return bool(cond)
+
+    def states_equal(a, b, probe):
+        allv = jnp.arange(args.vocab, dtype=jnp.int32)
+        eq = (np.asarray(coll.pull(a, {"arr": allv},
+                                   batch_sharded=False)["arr"])
+              == np.asarray(coll.pull(b, {"arr": allv},
+                                      batch_sharded=False)["arr"])).all()
+        pk = {"hsh": jnp.asarray(probe)}
+        eq &= (np.asarray(coll.pull(a, pk, batch_sharded=False,
+                                    read_only=True)["hsh"])
+               == np.asarray(coll.pull(b, pk, batch_sharded=False,
+                                       read_only=True)["hsh"])).all()
+        return bool(eq)
+
+    d = tempfile.mkdtemp(prefix="ckpt_delta_smoke_")
+    ok = True
+    states, _ = train(states, 0)
+    info = ckpt.save_checkpoint(d, coll, states, mode="delta", step=0)
+    ok &= check("base forced_full", info.get("forced_full"))
+    probes = []
+    after = {}
+    for seed in (1, 2):
+        states, idx = train(states, seed)
+        probes.append(np.asarray(idx["hsh"]))
+        info = cd.save_delta(d, coll, states, step=seed,
+                             compact_bytes_ratio=1e18,
+                             background_compact=False)
+        ok &= check(f"delta seq {seed}", info["seq"] == seed
+                    and not info["skipped"])
+        after[seed] = states
+    probe = np.concatenate(probes)
+    loaded = ckpt.load_checkpoint(d, coll)
+    ok &= check("base+chain bit-identical",
+                states_equal(states, loaded, probe))
+    # torn final delta: corrupt it, the load must recover to seq 1
+    manifest = cd.read_manifest(d)
+    last = manifest["chain"][-1]["vars"]["arr"]["file"]
+    fp = os.path.join(d, last)
+    raw = bytearray(open(fp, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(fp, "wb").write(bytes(raw))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        recovered = ckpt.load_checkpoint(d, coll)
+    ok &= check("torn final delta recovers to previous",
+                states_equal(after[1], recovered, probes[0]))
+    summary["ckpt_stats"] = obs.ckpt_stats()
+    summary["ok"] = bool(ok)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps({"ok": summary["ok"],
+                      "checks": len(summary["checks"])}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -328,19 +328,30 @@ def record_from_bench(result: Mapping[str, Any], *,
                       fingerprint: Optional[str] = None,
                       device: Optional[Mapping[str, Any]] = None
                       ) -> Optional[Dict[str, Any]]:
-    """Convert one bench.py result dict into a trajectory record
-    (throughput entries only — they carry the eps_min/eps_max band the
-    gate's noise model needs); None for inconvertible entries."""
+    """Convert one bench.py result dict into a trajectory record:
+    throughput entries (examples/s with an eps band) and checkpoint
+    write-rate entries (GB/s with a gbps band, recorded under the
+    synthetic ``ckpt`` plane so checkpoint perf gates like step perf);
+    None for inconvertible entries."""
     if not isinstance(result, dict) or "error" in result:
         return None
+    cfg = dict(result.get("config") or {})
+    cfg["source"] = "bench"
+    cfg["metric"] = result.get("metric", "")
+    if result.get("unit") == "GB/s" \
+            and all(isinstance(result.get(k), _NUM)
+                    for k in ("value", "gbps_min", "gbps_max")):
+        return make_record(
+            plane="ckpt", config=cfg,
+            eps=result["value"], eps_min=result["gbps_min"],
+            eps_max=max(result["gbps_max"], result["value"]),
+            fingerprint=fingerprint, device=device,
+            ts=result.get("ts"))
     if result.get("unit") != "examples/s":
         return None
     if not all(isinstance(result.get(k), _NUM)
                for k in ("value", "eps_min", "eps_max")):
         return None
-    cfg = dict(result.get("config") or {})
-    cfg["source"] = "bench"
-    cfg["metric"] = result.get("metric", "")
     return make_record(
         plane=str(cfg.get("plane", "a2a")), config=cfg,
         eps=result["value"], eps_min=result["eps_min"],
@@ -377,12 +388,19 @@ def _median(xs: List[float]) -> float:
 
 
 def gate(records: List[Dict[str, Any]], *, window: int = BASELINE_WINDOW,
-         min_band: float = MIN_BAND, safety: float = BAND_SAFETY
+         min_band: float = MIN_BAND, safety: float = BAND_SAFETY,
+         strict_fingerprint: Optional[str] = None
          ) -> Tuple[int, List[str]]:
     """(regressions, report lines): for each (plane, fingerprint,
     config) group, the newest record vs the trailing-median baseline
     with a spread-derived noise band. Groups without a baseline warn
-    and pass (first run on new hardware — "soft-fail" mode)."""
+    and pass (first run on new hardware — "soft-fail" mode) — unless
+    ``strict_fingerprint`` is set (the ``--strict`` ARMED mode): then a
+    no-baseline group on THAT fingerprint fails loudly — with baselines
+    committed for the hardware the gate runs on, a missing one means
+    the record/commit pipeline broke, not a new machine. Other
+    machines' historical single-record groups stay soft (their
+    baselines are not this runner's to demand)."""
     groups: Dict[Tuple[str, str, str], List[Dict[str, Any]]] = {}
     for rec in records:
         groups.setdefault(_group_key(rec), []).append(rec)
@@ -393,9 +411,17 @@ def gate(records: List[Dict[str, Any]], *, window: int = BASELINE_WINDOW,
         seq = sorted(groups[key], key=lambda r: r["ts"])
         newest, base = seq[-1], seq[:-1][-window:]
         if not base:
-            lines.append(f"warn {plane} [{fp}]: no baseline record yet — "
-                         "soft pass (gate arms once this record lands "
-                         "in the trajectory)")
+            if strict_fingerprint is not None \
+                    and fp == strict_fingerprint:
+                failures += 1
+                lines.append(
+                    f"NO-BASELINE {plane} [{fp}]: strict gate — commit "
+                    "a baseline record for this fingerprint (run "
+                    "--record twice) or drop --strict on new hardware")
+            else:
+                lines.append(f"warn {plane} [{fp}]: no baseline record "
+                             "yet — soft pass (gate arms once this "
+                             "record lands in the trajectory)")
             continue
         band = safety * max([min_band, _rel_spread(newest)]
                             + [_rel_spread(r) for r in base])
@@ -558,6 +584,10 @@ def main(argv=None) -> int:
     ap.add_argument("--gate", action="store_true",
                     help="compare newest records against the trailing "
                          "baseline; exit 1 on regression beyond band")
+    ap.add_argument("--strict", action="store_true",
+                    help="armed gate: a group with no baseline FAILS "
+                         "instead of soft-passing (use once baselines "
+                         "for this fingerprint are committed)")
     ap.add_argument("--validate-bench", action="store_true",
                     help="audit bench_suite.json + BENCH_r0*.json "
                          "against the bench-entry schema")
@@ -624,9 +654,11 @@ def main(argv=None) -> int:
         except ValueError as e:
             print(f"graftwatch: {e}", file=sys.stderr)
             return 2
+        strict_fp = device_fingerprint()[0] if args.strict else None
         failures, lines = gate(records, window=args.window,
                                min_band=args.min_band,
-                               safety=args.safety)
+                               safety=args.safety,
+                               strict_fingerprint=strict_fp)
         for ln in lines:
             print(ln)
         if failures:
