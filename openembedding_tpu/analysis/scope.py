@@ -47,6 +47,8 @@ for it.
 from __future__ import annotations
 
 import bisect
+import contextlib
+import contextvars
 import dataclasses
 import json
 import os
@@ -54,6 +56,7 @@ import re
 import sys
 import threading
 import time
+import uuid
 import weakref
 from collections import deque
 from typing import Any, Dict, List, Mapping, Optional, Tuple
@@ -103,6 +106,50 @@ def _profiler():
     into a host-only process."""
     jax = sys.modules.get("jax")
     return getattr(jax, "profiler", None) if jax is not None else None
+
+
+# ---------------------------------------------------------------------------
+# request-scoped trace ids
+# ---------------------------------------------------------------------------
+
+# the active trace/request id: set by the serving clients at request
+# entry and by the REST handlers from the X-OE-Trace header, read by
+# record_span so every span closed on the request path carries the same
+# ``trace`` arg in the exported Perfetto trace. A contextvar (not a
+# bare thread-local) so async frameworks hosting the client still
+# scope it per task; plain threads each start with the default (None).
+_TRACE_ID: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("oe_trace_id", default=None)
+
+# trace ids are for stitching, not identity — 16 hex chars keep trace
+# args short while collisions stay vanishingly rare per capture window
+TRACE_ID_CHARS = 16
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:TRACE_ID_CHARS]
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id of the enclosing :func:`trace_context`, or None."""
+    return _TRACE_ID.get()
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str] = None):
+    """Scope a trace/request id: spans recorded inside carry it as the
+    ``trace`` arg in the exported trace, so one request's client span,
+    router fan-out spans, and server-side lookup spans stitch into one
+    story. With no argument, the ENCLOSING id is reused if one is
+    active (a sharded fan-out keeps its parent's id) and a fresh id is
+    minted otherwise. Propagate across processes via the ``X-OE-Trace``
+    HTTP header (serving/rest.py reads it back into this context)."""
+    tid = str(trace_id) if trace_id else (_TRACE_ID.get() or new_trace_id())
+    token = _TRACE_ID.set(tid)
+    try:
+        yield tid
+    finally:
+        _TRACE_ID.reset(token)
 
 
 # ---------------------------------------------------------------------------
@@ -288,6 +335,17 @@ class HistogramRegistry:
             h = self._hists.get((name, _labels_key(labels)))
             return h.count if h is not None else 0
 
+    def counter(self, name: str, **labels) -> float:
+        """Current value of one labeled counter (0.0 when never bumped)
+        — with no labels, the SUM across every label set of ``name``
+        (the serving clients label connection/request counters by
+        endpoint; callers usually want the fleet total)."""
+        with self._lock:
+            if labels:
+                return self._counters.get((name, _labels_key(labels)), 0.0)
+            return sum(v for (n, _l), v in self._counters.items()
+                       if n == name)
+
     def sum(self, name: str, **labels) -> float:
         with self._lock:
             h = self._hists.get((name, _labels_key(labels)))
@@ -400,8 +458,15 @@ def record_span(kind: str, t0: float, dt: float,
         if error is not None:
             HISTOGRAMS.inc("span_errors", kind=kind, **labels)
     if tracing_enabled():
+        det = dict(detail) if detail else None
+        # the active request trace id rides in the trace args ONLY —
+        # per-request ids in histogram labels would explode the registry
+        tid = _TRACE_ID.get()
+        if tid is not None and (det is None or "trace" not in det):
+            det = dict(det or {})
+            det["trace"] = tid
         _my_ring().append((kind, t0, dt, dict(labels) or None, error,
-                           trace_time, dict(detail) if detail else None))
+                           trace_time, det))
 
 
 class Span:
@@ -416,6 +481,15 @@ class Span:
         self.labels = labels
         self.detail = detail
         self._ann = annotation
+
+    def set_label(self, key: str, value: Any) -> "Span":
+        """Attach/overwrite one histogram label BEFORE the span closes
+        (labels are read at exit) — how the HTTP handlers stamp the
+        response status code onto the request span they run under."""
+        if self.labels is None:
+            self.labels = {}
+        self.labels[str(key)] = value
+        return self
 
     def __enter__(self) -> "Span":
         self._trace_time = not _trace_state_clean()
@@ -480,13 +554,22 @@ def step_span(step: int, name: str = "step") -> Span:
 # Chrome-trace / Perfetto export
 # ---------------------------------------------------------------------------
 
-def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
+def export_chrome_trace(path: Optional[str] = None, *,
+                        process_name: Optional[str] = None
+                        ) -> Dict[str, Any]:
     """Snapshot every thread's ring as Chrome-trace JSON (Perfetto- and
     ``chrome://tracing``-loadable). Returns the trace dict; writes it to
     ``path`` when given. Timestamps are microseconds from the module's
-    load-time origin; per-thread metadata events carry thread names."""
+    load-time origin; per-thread metadata events carry thread names,
+    and ``process_name`` labels this process in the viewer. The
+    ``oeEpoch`` key records the origin on the system-wide monotonic
+    clock so multi-process captures (serving replicas + load
+    generator) merge onto ONE timeline (``merge_chrome_traces``)."""
     pid = os.getpid()
     events: List[Dict[str, Any]] = []
+    if process_name:
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": str(process_name)}})
 
     def _event(tid: int, ev: tuple) -> Dict[str, Any]:
         kind, t0, dt, labels, error, trace_time, detail = ev
@@ -529,11 +612,41 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
                            "tid": tid, "args": {"name": name}})
         events.append(_event(tid, ev))
     events.sort(key=lambda e: e.get("ts", -1.0))
-    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    trace = {"traceEvents": events, "displayTimeUnit": "ms",
+             "oeEpoch": _EPOCH}
     if path:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(trace, f)
     return trace
+
+
+def merge_chrome_traces(base: Dict[str, Any],
+                        others: List[Dict[str, Any]],
+                        path: Optional[str] = None) -> Dict[str, Any]:
+    """Fold traces captured by OTHER processes (serving replicas) into
+    ``base`` (the client's capture) on one timeline: each process's
+    ``oeEpoch`` offsets its microsecond timestamps onto the base
+    origin. ``time.perf_counter`` is the system-wide monotonic clock on
+    Linux, so cross-process spans line up for real — a request's
+    server-side span sits inside its client span in Perfetto. Distinct
+    pids keep per-process tracks separate; the shared ``trace`` args
+    stitch one request's story across them."""
+    base_epoch = float(base.get("oeEpoch", 0.0))
+    events = list(base.get("traceEvents", []))
+    for tr in others:
+        off_us = (float(tr.get("oeEpoch", base_epoch)) - base_epoch) * 1e6
+        for e in tr.get("traceEvents", []):
+            e = dict(e)
+            if "ts" in e:
+                e["ts"] = e["ts"] + off_us
+            events.append(e)
+    events.sort(key=lambda e: e.get("ts", -1.0))
+    merged = {"traceEvents": events, "displayTimeUnit": "ms",
+              "oeEpoch": base_epoch}
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(merged, f)
+    return merged
 
 
 # ---------------------------------------------------------------------------

@@ -34,11 +34,13 @@ Capability parity with the reference's serving HA plane:
 from __future__ import annotations
 
 import http.client
+import io
 import json
 import os
 import random
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -46,7 +48,9 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .rest import probe_health
+from ..analysis import scope
+from ..analysis.concurrency import sync_point
+from .rest import TRACE_HEADER, probe_health
 
 
 # --- replica daemon ---------------------------------------------------------
@@ -79,6 +83,12 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
                    help="binary data-plane codec (''|zlib|zstd) — the "
                         "reference's server.message_compress; overrides "
                         "the config file")
+    p.add_argument("--trace-out", default="",
+                   help="record graftscope spans and export them as "
+                        "Chrome-trace JSON here on (SIGTERM/ctrl-C) "
+                        "shutdown — the server-side half of a "
+                        "request-scoped trace (tools/graftload merges "
+                        "it with the client capture)")
     args = p.parse_args(argv)
 
     import jax
@@ -93,6 +103,15 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
                      else cfg.hash_capacity)
     compress = (args.compress if args.compress is not None
                 else cfg.message_compress)
+    if args.trace_out:
+        # arm span recording BEFORE any request lands, and convert
+        # SIGTERM into an orderly unwind so the finally below exports
+        # the rings (SIGKILL still loses them — chaos kills are honest)
+        import signal as signal_mod
+        scope.set_tracing(True)
+        signal_mod.signal(signal_mod.SIGTERM,
+                          lambda *_: sys.exit(0))
+
     mesh = create_mesh(1, len(jax.devices()))
     registry = ModelRegistry(mesh, default_hash_capacity=hash_capacity)
     peers = [e for e in args.peers.split(",") if e]
@@ -117,7 +136,7 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
         print("replica: ready", flush=True)
         while True:
             time.sleep(3600)
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, SystemExit):
         pass
     finally:
         # graceful — on ANY exit, including a failed boot load: join the
@@ -125,6 +144,11 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
         # teardown kill them mid-commit (graftrace JG104 discipline
         # applied to the daemon entry point)
         server.stop()
+        if args.trace_out:
+            scope.export_chrome_trace(
+                args.trace_out,
+                process_name=f"oe-replica:{server.port}")
+            print(f"replica: trace -> {args.trace_out}", flush=True)
     return 0
 
 
@@ -348,12 +372,15 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
                   devices: int = 1,
                   shard_index: int = 0,
                   shard_count: int = 1,
-                  compress: str = "") -> subprocess.Popen:
+                  compress: str = "",
+                  trace_out: str = "") -> subprocess.Popen:
     """Start a replica daemon as a child process (test/driver helper)."""
     cmd = [sys.executable, "-m", "openembedding_tpu.serving.ha",
            "--port", str(port)]
     if compress:
         cmd += ["--compress", compress]
+    if trace_out:
+        cmd += ["--trace-out", trace_out]
     for item in load:
         cmd += ["--load", item]
     if peers:
@@ -397,6 +424,27 @@ def wait_ready(endpoint: str, timeout: float = 120.0,
 
 # --- routing client ---------------------------------------------------------
 
+class _NoDelayHTTPConnection(http.client.HTTPConnection):
+    """Persistent client connection with Nagle disabled.
+
+    A kept-alive connection carries each request as (at least) two
+    small writes — header block, then body. With Nagle on, the second
+    write queues behind the server's delayed ACK of the first: a flat
+    ~40 ms added to EVERY request (measured on loopback; the
+    interaction the keep-alive satellite exists to remove, reappearing
+    one layer down). The server handler disables Nagle on its side for
+    the same reason (rest.py ``disable_nagle_algorithm``)."""
+
+    def connect(self):
+        super().connect()
+        import socket as socket_mod
+        try:
+            self.sock.setsockopt(socket_mod.IPPROTO_TCP,
+                                 socket_mod.TCP_NODELAY, 1)
+        except OSError:
+            pass  # non-TCP transports (tests with mocks) just skip it
+
+
 class RoutingClient:
     """Failover lookup client over N replica endpoints.
 
@@ -418,41 +466,163 @@ class RoutingClient:
         # advertised to servers on binary lookups; responses from servers
         # configured with the same message_compress codec arrive packed
         self.compress = compress_lib.check(compress)
+        # keep-alive connection pool: one persistent HTTP/1.1 connection
+        # per (thread, endpoint) — lookups used to open a fresh TCP
+        # connection per request, so connect setup inflated every
+        # measured serving latency. Per-THREAD pools keep the hot path
+        # lock-free (http.client connections are not thread-safe); the
+        # flat registry below exists only so close() can drop sockets
+        # opened by worker threads that already exited.
+        self._tls = threading.local()
+        self._conns_lock = threading.Lock()
+        self._conns: List[http.client.HTTPConnection] = []
 
-    # -- raw http ----------------------------------------------------------
+    # -- raw http (keep-alive pool) ----------------------------------------
+    def _connection(self, endpoint: str):
+        """(conn, reused): this thread's persistent connection to
+        ``endpoint``, opening one on first use."""
+        pool = getattr(self._tls, "conns", None)
+        if pool is None:
+            pool = self._tls.conns = {}
+        conn = pool.get(endpoint)
+        if conn is not None:
+            if conn.sock is not None:
+                return conn, True
+            # a pooled conn whose socket is gone (client close(), idle
+            # teardown): http.client's auto_open would silently
+            # reconnect with a socket neither close() nor the
+            # connection counter ever sees — treat as a pool miss
+            self._drop_connection(endpoint)
+        host, sep, port = endpoint.rpartition(":")
+        if not sep:
+            host, port = endpoint, "80"   # bare hostname, like urllib
+        conn = _NoDelayHTTPConnection(host, int(port),
+                                      timeout=self.timeout)
+        pool[endpoint] = conn
+        with self._conns_lock:
+            self._conns.append(conn)
+        scope.HISTOGRAMS.inc("serving_client_connections",
+                             endpoint=endpoint)
+        return conn, False
+
+    def _drop_connection(self, endpoint: str) -> None:
+        pool = getattr(self._tls, "conns", None)
+        conn = pool.pop(endpoint, None) if pool else None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def close(self) -> None:
+        """Close every pooled connection (all threads). Call when done
+        with the client — otherwise each idle kept-alive socket pins a
+        server handler thread until the server-side idle timeout."""
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), []
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    def __enter__(self) -> "RoutingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _raw(self, endpoint: str, method: str, path: str,
+             body: Optional[bytes], content_type: str) -> bytes:
+        """One HTTP round trip on the pooled connection. A failure on a
+        REUSED connection retries once on a fresh one (a server-side
+        idle close is not a dead replica); HTTP error statuses raise
+        ``urllib.error.HTTPError`` so the failover rotation keeps its
+        status-code semantics."""
+        headers = {"Content-Type": content_type}
+        tid = scope.current_trace_id()
+        if tid:
+            headers[TRACE_HEADER] = tid
+        while True:
+            conn, reused = self._connection(endpoint)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()   # drain fully: keeps conn reusable
+                status, reason = resp.status, resp.reason
+                rheaders = resp.headers
+                break
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._drop_connection(endpoint)
+                if not reused:
+                    raise
+                # stale keep-alive connection — one fresh retry (reads
+                # and delta pushes are both idempotent)
+        if status >= 400:
+            raise urllib.error.HTTPError(
+                f"http://{endpoint}{path}", status, reason, rheaders,
+                io.BytesIO(data))
+        return data
+
     def _request(self, endpoint: str, method: str, path: str,
                  body: Optional[dict] = None) -> Any:
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            f"http://{endpoint}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            payload = r.read()
+        payload = self._raw(endpoint, method, path, data,
+                            "application/json")
         return json.loads(payload) if payload else None
 
     def _rotate(self, attempt) -> Any:
         """Shared failover rotation: start at a random replica (load
         spreading), rotate on dead/busy replicas, raise only when every
-        replica failed — the reference's pick_one_replica + retry."""
+        replica failed — the reference's pick_one_replica + retry.
+        Every attempt is recorded as a ``serving.rpc`` span labeled
+        with the replica and its outcome (ok / ok_failover / busy /
+        failover), carrying the active trace id — the router leg of the
+        request-scoped Perfetto story — and bumps the
+        ``serving_request_retries`` / ``serving_request_failovers``
+        counters on /metrics."""
         order = list(self.endpoints)
         start = random.randrange(len(order))
         order = order[start:] + order[:start]
         last_err: Optional[Exception] = None
-        for ep in order:
+        for i, ep in enumerate(order):
+            sync_point("routing.attempt")
+            t0 = time.perf_counter()
             try:
-                return attempt(ep)
+                out = attempt(ep)
             # NOTE: HTTPError subclasses URLError — it must be caught first,
             # else every 404 would read as a dead replica
             except urllib.error.HTTPError as e:
+                dt = time.perf_counter() - t0
                 if e.code in (409, 503):  # CREATING etc: try another replica
+                    scope.record_span("serving.rpc", t0, dt,
+                                      {"replica": ep, "outcome": "busy"},
+                                      error=f"HTTP{e.code}")
+                    scope.HISTOGRAMS.inc("serving_request_retries")
                     last_err = e
                     continue
+                scope.record_span("serving.rpc", t0, dt,
+                                  {"replica": ep, "outcome": "error"},
+                                  error=f"HTTP{e.code}")
                 raise
             except (urllib.error.URLError, http.client.HTTPException,
                     ConnectionError, OSError, TimeoutError) as e:
                 # dead/unreachable replica — including one killed mid-
                 # response (IncompleteRead/RemoteDisconnected): rotate
+                scope.record_span("serving.rpc", t0,
+                                  time.perf_counter() - t0,
+                                  {"replica": ep, "outcome": "failover"},
+                                  error=type(e).__name__)
+                scope.HISTOGRAMS.inc("serving_request_failovers")
                 last_err = e
+                continue
+            scope.record_span("serving.rpc", t0, time.perf_counter() - t0,
+                              {"replica": ep,
+                               "outcome": "ok" if i == 0 else "ok_failover"})
+            return out
         raise ConnectionError(
             f"no live replica among {self.endpoints}: {last_err}")
 
@@ -461,11 +631,8 @@ class RoutingClient:
             lambda ep: self._request(ep, method, path, body))
 
     def _request_bin(self, endpoint: str, path: str, body: bytes) -> bytes:
-        req = urllib.request.Request(
-            f"http://{endpoint}{path}", data=body, method="POST",
-            headers={"Content-Type": "application/octet-stream"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return r.read()
+        return self._raw(endpoint, "POST", path, body,
+                         "application/octet-stream")
 
     # -- serving API -------------------------------------------------------
     def lookup(self, sign: str, variable: Any, indices) -> np.ndarray:
@@ -478,10 +645,12 @@ class RoutingClient:
 
     def lookup_json(self, sign: str, variable: Any, indices) -> np.ndarray:
         """JSON-marshalled pull (human-readable wire, for debugging)."""
-        out = self._failover(
-            "POST", f"/models/{sign}/lookup",
-            {"variable": variable,
-             "indices": np.asarray(indices).tolist()})
+        with scope.trace_context(), \
+                scope.span("client.lookup", proto="json"):
+            out = self._failover(
+                "POST", f"/models/{sign}/lookup",
+                {"variable": variable,
+                 "indices": np.asarray(indices).tolist()})
         return np.asarray(out["rows"], dtype=np.float32)
 
     def lookup_bin(self, sign: str, variable: Any, indices) -> np.ndarray:
@@ -520,7 +689,12 @@ class RoutingClient:
             shape = h.get("shape") or [int(h["n"]), int(h["dim"])]
             return np.frombuffer(payload, np.float32).reshape(shape)
 
-        return self._rotate(attempt)
+        # trace_context with no arg: a fresh request id — or the
+        # enclosing one when this is a ShardedRoutingClient fan-out leg,
+        # so every shard's spans stitch into the SAME trace
+        with scope.trace_context(), \
+                scope.span("client.lookup", proto="bin"):
+            return self._rotate(attempt)
 
     def create_model(self, model_uri: str, *,
                      model_sign: Optional[str] = None,
@@ -588,6 +762,16 @@ class ShardedRoutingClient:
     def shard_count(self) -> int:
         return len(self.groups)
 
+    def close(self) -> None:
+        for g in self.groups:
+            g.close()
+
+    def __enter__(self) -> "ShardedRoutingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def lookup(self, sign: str, variable: Any, indices, *,
                wide: bool = False) -> np.ndarray:
         """Partition ``indices`` by owner group, fan out, merge by position.
@@ -623,16 +807,29 @@ class ShardedRoutingClient:
             flat = idx.ravel()
             owner = flat % G
             out_shape = idx.shape
-        rows = None
-        for k in range(G):
-            sel = np.nonzero(owner == k)[0]
-            if not sel.size:
-                continue
-            part = self.groups[k].lookup(sign, variable, flat[sel])
-            if rows is None:
-                rows = np.zeros((flat.shape[0],) + part.shape[1:],
-                                part.dtype)
-            rows[sel] = part
+        # ONE trace id for the whole fan-out: each owner-group leg runs
+        # its RoutingClient.lookup INSIDE this context, so its client/
+        # rpc spans — and the server-side spans they propagate to —
+        # stitch into a single Perfetto trace. Fan-out width lands on
+        # /metrics as a counter + distribution.
+        with scope.trace_context(), \
+                scope.span("client.lookup", proto="sharded") as sp:
+            rows = None
+            fanout = 0
+            for k in range(G):
+                sel = np.nonzero(owner == k)[0]
+                if not sel.size:
+                    continue
+                fanout += 1
+                part = self.groups[k].lookup(sign, variable, flat[sel])
+                if rows is None:
+                    rows = np.zeros((flat.shape[0],) + part.shape[1:],
+                                    part.dtype)
+                rows[sel] = part
+            sp.detail = dict(sp.detail or {}, fanout=fanout)
+            scope.HISTOGRAMS.inc("serving_request_fanout", float(fanout))
+            scope.HISTOGRAMS.observe("serving_fanout_width",
+                                     float(fanout))
         if rows is None:
             rows = np.zeros((0, 0), np.float32)
         return rows.reshape(out_shape + rows.shape[1:])

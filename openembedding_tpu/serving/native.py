@@ -19,6 +19,10 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+# stdlib-only observability: the zero-JAX lookup path stays zero-JAX
+# (scope + observability import nothing heavier than numpy)
+from ..analysis import scope
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "liboe_serving.so")
@@ -121,7 +125,16 @@ class NativeModel:
         values — the native index is keyed by joined ids."""
         v = self._var(variable)
         dim = self._lib.oe_variable_dim(v)
+        # resolve the NAME for the metric label (like the registry
+        # path): an id-based lookup(0, ...) must not split the same
+        # table's series into table="0" vs table="emb"
+        name = self._lib.oe_variable_name(v).decode()
         arr = np.asarray(keys)
+        # record BEFORE the wide-pair join: the registry path records
+        # the raw element count (2n for [n, 2] pairs — wire volume),
+        # and both paths must feed the same units into one series
+        from ..utils.observability import record_serving_lookup
+        record_serving_lookup(name, arr.size)
         if arr.ndim >= 2 and arr.shape[-1] == 2 and arr.dtype == np.int32:
             # wide pairs of ANY batch shape ([n, 2], [B, F, 2], ...):
             # join over the last axis
@@ -129,9 +142,14 @@ class NativeModel:
             arr = hash_lib.join64(arr)
         k = np.ascontiguousarray(arr.astype(np.int64).ravel())
         out = np.zeros((k.size, dim), np.float32)
-        rc = self._lib.oe_pull_weights(
-            v, k.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), k.size,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        # request-scoped span: the native leg of a traced serving
+        # request (graftload --path native) lands in the same Perfetto
+        # trace as the REST legs
+        with scope.span("serving.native_lookup", table=name):
+            rc = self._lib.oe_pull_weights(
+                v, k.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                k.size,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if rc != 0:
             raise RuntimeError(self._lib.oe_last_error().decode())
         # batch shape AFTER the join: pair inputs collapse their last axis

@@ -145,6 +145,16 @@ class ServingModel:
         name = (variable if isinstance(variable, str)
                 else self._by_id[int(variable)])
         spec = self.collection.specs[name]
+        # serving-side batch stats: lookup-size histogram (always on)
+        # + the gated uniqueness counters, through the same machinery
+        # the training pull uses (record_batch_stats) — both land on
+        # /metrics and in the graftscope distribution listing
+        from ..utils import observability
+        observability.record_serving_lookup(
+            name, getattr(indices, "size", None)
+            or np.asarray(indices).size)
+        if observability.evaluate_performance():
+            observability.record_batch_stats({name: np.asarray(indices)})
         idx = jnp.asarray(indices)
         # narrow id columns address wide tables via the same widening
         # bridge the training pull uses; pair_ndim=2 so the serving wire's

@@ -22,10 +22,13 @@ data plane is in-process jitted XLA (ServingModel.lookup).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_NULL_CTX = contextlib.nullcontext
 
 import numpy as np
 
@@ -34,13 +37,32 @@ from .registry import ModelRegistry
 
 DEFAULT_PORT = 8010
 
+# request trace propagation: clients stamp each request with this header
+# (ha.RoutingClient) and the handlers re-enter the id into
+# scope.trace_context, so server-side spans stitch into the client's
+# Perfetto trace
+TRACE_HEADER = "X-OE-Trace"
+
+# the per-model OPERATIONS get their own route label (the data-plane
+# latency of /lookup_bin must not average into control-plane creates) —
+# still low-cardinality: the <sign> segment is folded away
+_MODEL_OPS = ("lookup_bin", "lookup", "delta", "rows", "meta")
+
 
 def _route(path: str) -> str:
     """Low-cardinality route label for request spans: the first path
-    segment (``/models/<sign>/lookup`` -> ``/models``) — per-sign labels
-    would explode the histogram registry on a long-lived server."""
-    seg = path.lstrip("/").split("?", 1)[0].split("/", 1)[0]
-    return "/" + seg if seg else "/"
+    segment (``/models/<sign>`` -> ``/models``) plus the operation
+    segment for per-model ops (``/models/<sign>/lookup_bin`` ->
+    ``/models/lookup_bin``) — per-sign labels would explode the
+    histogram registry on a long-lived server."""
+    segs = path.lstrip("/").split("?", 1)[0].split("/")
+    if not segs or not segs[0]:
+        return "/"
+    if segs[0] == "models" and len(segs) >= 3:
+        op = segs[2]
+        if op in _MODEL_OPS:
+            return f"/models/{op}"
+    return "/" + segs[0]
 
 
 def probe_health(endpoint: str, timeout: float = 1.0):
@@ -77,8 +99,55 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
     peers = list(peers or [])
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1: clients reuse one connection across lookups
+        # (ha.RoutingClient keep-alive) — per-request TCP setup was
+        # inflating every measured serving latency. Every response path
+        # sends Content-Length, which 1.1 keep-alive requires. The
+        # socket timeout bounds how long an IDLE kept-alive connection
+        # pins its handler thread once the client goes quiet, so
+        # ControllerServer.stop()'s handler join stays bounded.
+        # TCP_NODELAY is mandatory on a persistent connection: header
+        # and body go out as separate small writes, and Nagle queuing
+        # the second behind the peer's delayed ACK adds a flat ~40 ms
+        # to EVERY response (measured; the keep-alive client disables
+        # it on its side too).
+        protocol_version = "HTTP/1.1"
+        timeout = 5
+        disable_nagle_algorithm = True
+
         def log_message(self, *a):  # quiet test output
             pass
+
+        def send_response(self, code, message=None):
+            # stamp the status onto the request span (and the counter
+            # below): 4xx/5xx latency must be distinguishable from
+            # success latency on /metrics. Covers EVERY response path —
+            # _send, the binary planes, /metrics — since they all funnel
+            # through here.
+            sp = getattr(self, "_span", None)
+            if sp is not None:
+                sp.set_label("status", str(int(code)))
+            super().send_response(code, message)
+
+        def _serve(self, method: str, handler):
+            """One request: re-enter the client's trace id (X-OE-Trace)
+            so the server-side spans stitch into its Perfetto trace,
+            time the handler under the ``http`` span (method/route/
+            status labels), and count the request per route x status."""
+            tid = (self.headers.get(TRACE_HEADER) or "")[:64]
+            route = _route(self.path)
+            with scope.trace_context(tid) if tid else _NULL_CTX():
+                with scope.span("http", method=method, route=route,
+                                detail={"path": self.path}) as sp:
+                    self._span = sp
+                    try:
+                        handler()
+                    finally:
+                        self._span = None
+                        status = (sp.labels or {}).get("status", "none")
+                        scope.HISTOGRAMS.inc("serving_requests",
+                                             method=method, route=route,
+                                             status=status)
 
         def _send(self, code: int, obj=None, location: str = None):
             body = json.dumps(obj).encode() if obj is not None else b""
@@ -95,12 +164,10 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
             return json.loads(self.rfile.read(n) or b"{}")
 
         def do_GET(self):
-            # graftscope request span: every verb/route pair feeds the
-            # span_http_seconds histogram exposed right back on /metrics
-            with scope.span("http", method="GET",
-                            route=_route(self.path),
-                            detail={"path": self.path}):
-                self._handle_GET()
+            # graftscope request span: every verb/route/status triple
+            # feeds the span_http_seconds histogram exposed right back
+            # on /metrics
+            self._serve("GET", self._handle_GET)
 
         def _handle_GET(self):
             try:
@@ -199,10 +266,7 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 self._send(500, {"error": str(e)})
 
         def do_POST(self):
-            with scope.span("http", method="POST",
-                            route=_route(self.path),
-                            detail={"path": self.path}):
-                self._handle_POST()
+            self._serve("POST", self._handle_POST)
 
         def _handle_POST(self):
             try:
@@ -283,10 +347,7 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 self._send(500, {"error": str(e)})
 
         def do_DELETE(self):
-            with scope.span("http", method="DELETE",
-                            route=_route(self.path),
-                            detail={"path": self.path}):
-                self._handle_DELETE()
+            self._serve("DELETE", self._handle_DELETE)
 
         def _handle_DELETE(self):
             try:

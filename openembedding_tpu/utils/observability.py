@@ -119,6 +119,26 @@ def record_batch_stats(sparse: Dict[str, np.ndarray],
                                      counts.max() / arr.size, table=name)
 
 
+def record_serving_lookup(name: str, size: float,
+                          accumulator: Optional[Accumulator] = None) -> None:
+    """Serving-side batch statistics for ONE lookup request.
+
+    Feeds the per-variable lookup-size distribution
+    (``serving_lookup_rows{table=...}``, graftscope histogram registry
+    -> ``/metrics`` ``_bucket`` series — the input the micro-batching
+    scheduler will be sized from) plus request/id counters. Always on:
+    unlike :func:`record_batch_stats`' uniqueness scan this is one
+    histogram bump, cheap enough for the serving hot path. ``size`` is
+    the number of index ELEMENTS in the request (a wide ``[n, 2]`` pair
+    query counts 2n — the wire-level volume, not the row count).
+    """
+    acc = accumulator or GLOBAL
+    acc.add("serving_lookup_requests", 1.0)
+    acc.add("serving_lookup_ids", float(size))
+    scope.HISTOGRAMS.observe("serving_lookup_rows", float(size),
+                             table=str(name))
+
+
 def cache_stats(accumulator: Optional[Accumulator] = None
                 ) -> Dict[str, float]:
     """Hot-row replica-cache counters (``parallel/hot_cache.py``).

@@ -1,0 +1,217 @@
+"""graftload: Poisson scheduler + coordinated-omission accounting.
+
+Pure-host lanes (no servers, no jax): the open-loop property is pinned
+against a synthetic slow service — when the service stalls, the
+measured quantiles must GROW (latency from intended send time), where
+a closed-loop driver's clock would have flattered them — plus the
+serving trajectory record schema and the p99/QPS regression gate.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tools import graftload as gl
+from tools import graftwatch as gw
+
+
+# --- Poisson scheduler -------------------------------------------------------
+
+def test_poisson_arrivals_shape_and_rate():
+    rate, duration = 500.0, 2.0
+    a = gl.poisson_arrivals(rate, duration, seed=3)
+    assert a.ndim == 1 and a.size > 0
+    assert float(a[0]) >= 0.0 and float(a[-1]) < duration
+    assert (np.diff(a) >= 0).all()          # sorted intended times
+    # count ~ Poisson(1000): 5 sigma ~ 160
+    assert 840 < a.size < 1160
+    # gaps are exponential with mean 1/rate (loose 15% tolerance)
+    gaps = np.diff(a)
+    assert abs(float(gaps.mean()) - 1.0 / rate) < 0.15 / rate
+    # a Poisson process bursts: the gap cv is ~1, a metronome's is 0
+    assert float(gaps.std() / gaps.mean()) > 0.7
+
+
+def test_poisson_arrivals_deterministic_and_degenerate():
+    a = gl.poisson_arrivals(100, 1.0, seed=7)
+    b = gl.poisson_arrivals(100, 1.0, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert gl.poisson_arrivals(0, 1.0).size == 0
+    assert gl.poisson_arrivals(100, 0.0).size == 0
+
+
+# --- coordinated-omission accounting -----------------------------------------
+
+SERVICE_S = 0.02
+
+
+def _slow_send(i):
+    time.sleep(SERVICE_S)
+
+
+def test_open_loop_latency_measured_from_intended_time():
+    """THE coordinated-omission pin: a 20 ms service stormed at 100/s
+    through ONE worker can complete only ~50/s — the backlog must land
+    in the measured latency (minutes-scale p99 at steady state; here
+    the window bounds it), not silently slow the arrival clock. A
+    closed-loop driver would report ~20 ms p99 here, flat and wrong."""
+    rate, duration = 100.0, 0.5
+    arrivals = gl.poisson_arrivals(rate, duration, seed=0)
+    res = gl.run_storm(_slow_send, arrivals, route="synthetic",
+                       offered_qps=arrivals.size / duration,
+                       duration=duration, workers=1)
+    assert res.errors == 0
+    assert res.calls == arrivals.size
+    # the LAST request waited behind ~half the backlog: far above the
+    # 20 ms service time a closed-loop driver would have reported
+    assert res.quantile_ms(0.99) > 5 * SERVICE_S * 1e3
+    assert res.quantile_ms(0.50) > 2 * SERVICE_S * 1e3
+    # and the achieved rate honestly reports the saturation
+    assert res.achieved_qps < 0.75 * res.offered_qps
+
+
+def test_open_loop_keeps_up_with_headroom():
+    """With worker headroom and a fast service, achieved tracks offered
+    and the quantiles sit near the service time."""
+    rate, duration = 50.0, 0.6
+    arrivals = gl.poisson_arrivals(rate, duration, seed=1)
+    res = gl.run_storm(lambda i: time.sleep(0.001), arrivals,
+                       route="synthetic",
+                       offered_qps=arrivals.size / duration,
+                       duration=duration, workers=8)
+    assert res.errors == 0
+    assert res.achieved_qps > 0.8 * res.offered_qps
+    # generous bound: CI boxes jitter, but nothing should queue
+    assert res.quantile_ms(0.50) < 100.0
+
+
+def test_storm_counts_errors_without_crashing():
+    def flaky(i):
+        if i % 3 == 0:
+            raise RuntimeError("boom")
+
+    arrivals = gl.poisson_arrivals(200, 0.2, seed=2)
+    res = gl.run_storm(flaky, arrivals, route="synthetic",
+                       offered_qps=arrivals.size / 0.2, duration=0.2,
+                       workers=4)
+    assert res.errors > 0
+    assert res.calls == arrivals.size
+    assert res.latencies_ms.size == arrivals.size - res.errors
+    assert 0.0 < res.error_rate < 1.0
+    assert "boom" in getattr(res, "first_error", "")
+
+
+def test_storm_runs_concurrently_from_worker_pool():
+    """The pool really overlaps requests: 8 workers on a 20 ms service
+    must beat the serial wall by a wide margin."""
+    seen = []
+    lock = threading.Lock()
+
+    def send(i):
+        with lock:
+            seen.append(threading.current_thread().name)
+        time.sleep(SERVICE_S)
+
+    arrivals = np.linspace(0.0, 0.1, 32)
+    t0 = time.perf_counter()
+    res = gl.run_storm(send, arrivals, route="synthetic",
+                       offered_qps=320.0, duration=0.1, workers=8)
+    wall = time.perf_counter() - t0
+    assert res.errors == 0
+    assert wall < 32 * SERVICE_S * 0.8          # serial would be 640 ms
+    assert len({n for n in seen}) > 1           # >1 worker actually sent
+
+
+def test_find_knee():
+    # built via the real accounting (achieved ~ samples/duration), so
+    # the knee rule is tested against StormResult itself
+    def real(offered, n, errors=0):
+        lat = np.full(n, 1.0)
+        arr = np.linspace(0, 0.99, n)
+        return gl.StormResult("rest", offered, 1.0, lat, arr, errors)
+
+    rs = [real(100, 100), real(200, 198), real(400, 220)]
+    knee = gl.find_knee(rs)
+    assert knee is not None and knee.offered_qps == 200
+    # errors disqualify a rate outright
+    rs = [real(100, 100, errors=1)]
+    assert gl.find_knee(rs) is None
+
+
+# --- serving trajectory records + the latency gate ---------------------------
+
+_FP = "cpu8-test-c2"
+_DEV = {"platform": "cpu", "n_devices": 8, "device_kind": "cpu"}
+
+
+def _serving_record(ts, qps=200.0, p99=8.0):
+    return gw.make_serving_record(
+        routes={"rest": {"calls": 400, "p50_ms": 2.0, "p95_ms": 5.0,
+                         "p99_ms": p99},
+                "native": {"calls": 400, "p50_ms": 0.5, "p95_ms": 1.0,
+                           "p99_ms": 2.0}},
+        offered_qps=qps * 1.02, achieved_qps=qps, errors=0, replicas=2,
+        qps_band=(qps * 0.9, qps * 1.1),
+        config={"source": "graftload", "qps": 200.0, "duration": 5.0,
+                "batch": 16, "workers": 32, "path": "both",
+                "replicas": 2, "sweep": False, "chaos": False},
+        fingerprint=_FP, device=_DEV, ts=ts)
+
+
+def test_serving_record_schema_roundtrip():
+    rec = _serving_record("2026-08-01T00:00:00+00:00")
+    assert gw.validate_record(rec) == []
+    assert rec["plane"] == "serving"
+    assert rec["serving"]["replicas"] == 2
+    assert rec["scope"]["rest"]["p99_ms"] == 8.0
+
+
+@pytest.mark.parametrize("mutate,fragment", [
+    (lambda r: r["serving"].pop("achieved_qps"), "achieved_qps"),
+    (lambda r: r["serving"].update(offered_qps=-1), "offered_qps"),
+    (lambda r: r["serving"].update(errors=-2), "errors"),
+    (lambda r: r["serving"].update(replicas=0), "replicas"),
+    (lambda r: r["scope"]["rest"].update(p99_ms="fast"), "p99_ms"),
+])
+def test_serving_record_schema_lists_problems(mutate, fragment):
+    rec = _serving_record("2026-08-01T00:00:00+00:00")
+    mutate(rec)
+    problems = gw.validate_record(rec)
+    assert problems and any(fragment in p for p in problems), problems
+
+
+def test_gate_fails_on_2x_p99_regression(tmp_path):
+    """THE acceptance-criterion negative: same sustained QPS, p99
+    doubled -> the serving group regresses (latency quantiles gate like
+    throughput) and the CLI exits 1. Dropping the injected record
+    gates clean again."""
+    import json
+    records = [_serving_record(f"2026-08-0{d}T00:00:00+00:00")
+               for d in (1, 2, 3)]
+    records.append(_serving_record("2026-08-04T00:00:00+00:00",
+                                   p99=16.0))
+    failures, lines = gw.gate(records)
+    assert failures >= 1
+    assert any("REGRESSION" in ln and "rest_p99_ms" in ln
+               for ln in lines), lines
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    assert gw.main(["--gate", "--trajectory", str(path)]) == 1
+    with open(path, "w") as f:
+        for r in records[:-1]:
+            f.write(json.dumps(r) + "\n")
+    assert gw.main(["--gate", "--trajectory", str(path)]) == 0
+
+
+def test_gate_fails_on_sustained_qps_drop():
+    records = [_serving_record(f"2026-08-0{d}T00:00:00+00:00")
+               for d in (1, 2, 3)]
+    records.append(_serving_record("2026-08-04T00:00:00+00:00",
+                                   qps=90.0))
+    failures, lines = gw.gate(records)
+    assert failures >= 1
+    assert any("REGRESSION" in ln and "/eps" in ln for ln in lines), lines

@@ -399,10 +399,31 @@ def test_prometheus_text_and_endpoint(devices8):
                 break
             _time.sleep(0.05)
         assert "# TYPE oe_span_http_seconds histogram" in body2
+        # the STATUS label (ISSUE 11 satellite): 4xx/5xx latency must be
+        # a separate series from success latency
         assert 'oe_span_http_seconds_bucket{method="GET",' \
-               'route="/metrics",le="+Inf"}' in body2
+               'route="/metrics",status="200",le="+Inf"}' in body2
         assert 'oe_span_http_seconds_count{method="GET",' \
-               'route="/metrics"}' in body2
+               'route="/metrics",status="200"}' in body2
+        # per route x status request counter rides along
+        assert 'oe_serving_requests_total{method="GET",' \
+               'route="/metrics",status="200"}' in body2
+        # a 404 lands in its OWN status series (and its own counter)
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/models/nope", timeout=5)
+        for _ in range(40):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+                body2 = r.read().decode()
+            if 'status="404"' in body2:
+                break
+            _time.sleep(0.05)
+        assert 'oe_span_http_seconds_count{method="GET",' \
+               'route="/models",status="404"}' in body2
+        assert 'oe_serving_requests_total{method="GET",' \
+               'route="/models",status="404"}' in body2
         # graftwatch host-memory gauges are on the page and parse
         # scraper-side: the registry this server fronts accounts its
         # loaded models (zero here), span rings always report

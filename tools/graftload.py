@@ -1,0 +1,584 @@
+"""graftload: open-loop serving load generator + latency-SLO sweep.
+
+    # storm an existing cluster over REST
+    python -m tools.graftload --endpoints 127.0.0.1:8010,127.0.0.1:8011 \
+        --sign model-1 --variable emb --vocab 64 --qps 200 --duration 5
+
+    # self-contained: boot a 2-replica cluster, storm REST + native,
+    # kill one replica mid-storm, record + trace (the CI smoke)
+    python -m tools.graftload --demo --replicas 2 --qps 40 --duration 4 \
+        --path both --chaos --trace /tmp/graftload_trace.json \
+        --trajectory BENCH_trajectory.jsonl
+
+    # sweep offered QPS to find the sustained knee
+    python -m tools.graftload --demo --sweep 50,100,200,400,800
+
+Open-loop discipline: arrivals are a Poisson process at the OFFERED
+rate and every request's latency is measured from its INTENDED send
+time, not from when a worker got around to sending it. A closed-loop
+driver slows its own clock when the server stalls — the stall eats the
+arrivals that would have observed it, and p99 comes out flat exactly
+when it matters (coordinated omission). Here a backlog shows up AS
+latency: if all workers are busy when an arrival comes due, the wait
+lands in that request's measured latency. The worker pool bounds
+concurrency, not the accounting.
+
+Output: per-route p50/p95/p99 (ms), achieved vs offered QPS, error
+rate. ``--trace`` writes the storm's request-scoped spans (client,
+router fan-out, server-side — one trace id per request) as a
+Perfetto-loadable JSON; ``--trajectory`` appends a schema-versioned
+``serving`` record that ``tools.graftwatch --gate`` regression-gates
+(p99 up OR sustained QPS down) exactly like step throughput.
+
+Exit nonzero on request errors (the chaos invariant: reads never fail
+while >= 1 replica per group lives) or a broken record/trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+DEMO_SIGN = "graftload-demo"
+DEMO_VOCAB = 1024
+DEMO_DIM = 8
+
+
+# --- open-loop scheduling ----------------------------------------------------
+
+def poisson_arrivals(rate: float, duration: float,
+                     seed: int = 0) -> np.ndarray:
+    """Intended send times (seconds from storm start) of a Poisson
+    arrival process at ``rate``/s over ``duration`` s: i.i.d.
+    exponential gaps, so bursts and lulls occur like real traffic
+    instead of a metronome that never tests queueing."""
+    if rate <= 0 or duration <= 0:
+        return np.zeros((0,), np.float64)
+    rng = np.random.RandomState(seed)
+    out: List[np.ndarray] = []
+    t = 0.0
+    while t < duration:
+        gaps = rng.exponential(1.0 / rate,
+                               size=max(64, int(rate * duration * 0.5)))
+        ts = t + np.cumsum(gaps)
+        out.append(ts)
+        t = float(ts[-1])
+    arrivals = np.concatenate(out)
+    return arrivals[arrivals < duration]
+
+
+class StormResult:
+    """One storm's coordinated-omission-free accounting."""
+
+    def __init__(self, route: str, offered_qps: float, duration: float,
+                 latencies_ms: np.ndarray, arrival_s: np.ndarray,
+                 errors: int):
+        self.route = route
+        self.offered_qps = float(offered_qps)
+        self.duration = float(duration)
+        self.latencies_ms = np.asarray(latencies_ms, np.float64)
+        self.arrival_s = np.asarray(arrival_s, np.float64)
+        self.errors = int(errors)
+
+    @property
+    def calls(self) -> int:
+        return int(self.latencies_ms.size) + self.errors
+
+    @property
+    def achieved_qps(self) -> float:
+        """Completed-ok requests over the OFFERED window. When the
+        server cannot keep up, completions spill past the window and
+        this honestly under-reports the offered rate — the knee
+        detector keys off exactly that."""
+        n = self.latencies_ms.size
+        if not n:
+            return 0.0
+        # wall time from storm start to last completion, floored at the
+        # offered window (a fast server must not report > offered)
+        wall = max(self.duration,
+                   float((self.arrival_s + self.latencies_ms / 1e3).max()))
+        return n / wall
+
+    @property
+    def error_rate(self) -> float:
+        return self.errors / max(1, self.calls)
+
+    def quantile_ms(self, q: float) -> float:
+        if not self.latencies_ms.size:
+            return float("nan")
+        return float(np.percentile(self.latencies_ms, q * 100.0))
+
+    def per_chunk_qps(self, chunks: int = 4) -> Tuple[float, float]:
+        """(min, max) achieved QPS over ``chunks`` equal slices of the
+        offered window — the noise band the regression gate widens by."""
+        if not self.latencies_ms.size:
+            return 0.0, 0.0
+        done = self.arrival_s + self.latencies_ms / 1e3
+        edges = np.linspace(0.0, max(self.duration, float(done.max())),
+                            chunks + 1)
+        counts, _ = np.histogram(done, bins=edges)
+        width = edges[1] - edges[0]
+        qps = counts / max(width, 1e-9)
+        return float(qps.min()), float(qps.max())
+
+    def summary(self) -> Dict[str, Any]:
+        return {"route": self.route,
+                "offered_qps": round(self.offered_qps, 2),
+                "achieved_qps": round(self.achieved_qps, 2),
+                "calls": self.calls, "errors": self.errors,
+                "error_rate": round(self.error_rate, 4),
+                "p50_ms": round(self.quantile_ms(0.50), 3),
+                "p95_ms": round(self.quantile_ms(0.95), 3),
+                "p99_ms": round(self.quantile_ms(0.99), 3)}
+
+
+def run_storm(send: Callable[[int], None], arrivals: np.ndarray, *,
+              route: str, offered_qps: float, duration: float,
+              workers: int = 16) -> StormResult:
+    """Fire ``send(i)`` at each intended arrival time from a worker
+    pool; latency is completion minus INTENDED time (see module
+    docstring). ``send`` raises on error; errors are counted, their
+    latency excluded (an error is not a service time)."""
+    workers = max(1, min(int(workers), max(1, arrivals.size)))
+    lock = threading.Lock()
+    state = {"next": 0, "errors": 0}
+    lat: List[float] = []
+    arr: List[float] = []
+    err_first: List[BaseException] = []
+    # small lead-in so worker startup cannot eat the first arrivals
+    t0 = time.perf_counter() + 0.05
+
+    def worker():
+        while True:
+            with lock:
+                i = state["next"]
+                state["next"] += 1
+            if i >= arrivals.size:
+                return
+            target = t0 + arrivals[i]
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                send(i)
+            except Exception as e:  # noqa: BLE001 — counted, not fatal
+                with lock:
+                    state["errors"] += 1
+                    if not err_first:
+                        err_first.append(e)
+                continue
+            done = time.perf_counter()
+            with lock:
+                lat.append((done - target) * 1e3)
+                arr.append(float(arrivals[i]))
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"graftload-{k}")
+               for k in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    res = StormResult(route, offered_qps, duration,
+                      np.asarray(lat), np.asarray(arr), state["errors"])
+    if err_first:
+        res.first_error = repr(err_first[0])  # type: ignore[attr-defined]
+    return res
+
+
+def find_knee(results: List[StormResult], *, sustain: float = 0.9
+              ) -> Optional[StormResult]:
+    """Highest offered rate the cluster SUSTAINED: achieved/offered >=
+    ``sustain`` with zero errors. None when even the lowest rate
+    saturated."""
+    ok = [r for r in results
+          if r.errors == 0 and r.achieved_qps >= sustain * r.offered_qps]
+    return max(ok, key=lambda r: r.offered_qps) if ok else None
+
+
+# --- request senders ---------------------------------------------------------
+
+def make_rest_sender(router, sign: str, variable: str, vocab: int,
+                     batch: int, seed: int = 1) -> Callable[[int], None]:
+    """Per-request REST lookup through the routing client: fresh random
+    ids per request (pre-drawn — the storm loop must not pay RNG time),
+    each under its own trace id so the Perfetto story is per-request."""
+    from openembedding_tpu.analysis import scope
+    rng = np.random.RandomState(seed)
+    pool = rng.randint(0, vocab, size=(256, batch)).astype(np.int32)
+
+    def send(i: int) -> None:
+        ids = pool[i % pool.shape[0]]
+        with scope.trace_context():
+            rows = router.lookup(sign, variable, ids)
+        if rows.shape[0] != batch:
+            raise RuntimeError(f"short read: {rows.shape}")
+
+    return send
+
+
+def make_native_sender(model, variable: str, vocab: int, batch: int,
+                       seed: int = 2) -> Callable[[int], None]:
+    """Per-request native (zero-JAX mmap) lookup — the latency floor."""
+    from openembedding_tpu.analysis import scope
+    rng = np.random.RandomState(seed)
+    pool = rng.randint(0, vocab, size=(256, batch)).astype(np.int64)
+    lock = threading.Lock()   # one ctypes handle; serialize calls
+
+    def send(i: int) -> None:
+        ids = pool[i % pool.shape[0]]
+        with scope.trace_context(), lock:
+            rows = model.lookup(variable, ids)
+        if rows.shape[0] != batch:
+            raise RuntimeError(f"short read: {rows.shape}")
+
+    return send
+
+
+# --- demo cluster ------------------------------------------------------------
+
+def build_demo_checkpoint(out_dir: str) -> str:
+    """Train-free tiny checkpoint the demo replicas serve (constant
+    0.5 rows — lookups are value-checkable)."""
+    import jax
+    from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+    from openembedding_tpu import checkpoint as ckpt
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(1, 1, jax.devices()[:1])
+    spec = EmbeddingSpec(
+        name="emb", input_dim=DEMO_VOCAB, output_dim=DEMO_DIM,
+        initializer={"category": "constant", "value": 0.5})
+    coll = EmbeddingCollection((spec,), mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    ckpt.save_checkpoint(out_dir, coll, states, model_sign=DEMO_SIGN)
+    return out_dir
+
+
+def boot_demo_cluster(model_dir: str, replicas: int,
+                      trace_dir: str = ""):
+    """Spawn ``replicas`` replica daemons serving the demo checkpoint;
+    returns (endpoints, procs, trace_paths). With ``trace_dir`` each
+    replica records spans and exports them on graceful (SIGTERM)
+    shutdown — the server-side half of the merged Perfetto story."""
+    import socket
+    from openembedding_tpu.serving import ha
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port() for _ in range(replicas)]
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    traces = [os.path.join(trace_dir, f"replica_{i}.json") if trace_dir
+              else "" for i in range(replicas)]
+    procs = [ha.spawn_replica(p, load=[f"{DEMO_SIGN}={model_dir}"],
+                              trace_out=tr)
+             for p, tr in zip(ports, traces)]
+    for ep, proc in zip(eps, procs):
+        if not ha.wait_ready(ep, sign=DEMO_SIGN):
+            tail = ""
+            if proc.poll() is not None:
+                tail = (proc.stdout.read() or "")[-2000:]
+            raise RuntimeError(f"replica {ep} never became ready: {tail}")
+    return eps, procs, [t for t in traces if t]
+
+
+# --- CLI ---------------------------------------------------------------------
+
+def _storm_once(args, route: str, send, rate: float,
+                seed: int) -> StormResult:
+    arrivals = poisson_arrivals(rate, args.duration, seed=seed)
+    # offered = the rate actually DRAWN (short windows make the Poisson
+    # count itself noisy; achieved must compare against what was sent)
+    offered = arrivals.size / args.duration
+    return run_storm(send, arrivals, route=route, offered_qps=offered,
+                     duration=args.duration, workers=args.workers)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop serving load generator + SLO sweep")
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated replica endpoints (one shard "
+                         "group); omit with --demo")
+    ap.add_argument("--sign", default=DEMO_SIGN)
+    ap.add_argument("--variable", default="emb")
+    ap.add_argument("--vocab", type=int, default=DEMO_VOCAB,
+                    help="id range for the random lookup batches")
+    ap.add_argument("--batch", type=int, default=16,
+                    help="ids per lookup request")
+    ap.add_argument("--qps", type=float, default=100.0,
+                    help="offered rate (open-loop Poisson arrivals)")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--workers", type=int, default=32,
+                    help="max in-flight requests (bounds concurrency, "
+                         "NOT the accounting — a full pool shows up as "
+                         "latency, never as a slower arrival clock)")
+    ap.add_argument("--sweep", default="",
+                    help="comma-separated offered rates; reports the "
+                         "sustained knee (achieved >= 0.9 x offered, "
+                         "zero errors)")
+    ap.add_argument("--path", choices=("rest", "native", "both"),
+                    default="rest")
+    ap.add_argument("--demo", action="store_true",
+                    help="boot a --replicas local cluster on a tiny "
+                         "generated checkpoint, storm it, tear it down")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--model-dir", default="",
+                    help="checkpoint dir for --path native (implied by "
+                         "--demo)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="SIGKILL one replica halfway through the REST "
+                         "storm (demo mode): reads must never error "
+                         "while a replica lives, and the trace shows "
+                         "the reroute")
+    ap.add_argument("--trace", default="",
+                    help="write the storm's request-scoped spans as "
+                         "Perfetto-loadable JSON")
+    ap.add_argument("--trajectory", default="",
+                    help="append a `serving` record to this "
+                         "BENCH_trajectory.jsonl (graftwatch --gate "
+                         "covers it)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU devices for THIS process (keys "
+                         "the hardware fingerprint; replicas always "
+                         "run 1)")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    # fingerprint parity with the committed cpu8 baselines: force the
+    # virtual device count BEFORE jax initializes
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from openembedding_tpu.utils.jaxcompat import set_num_cpu_devices
+    set_num_cpu_devices(args.devices)
+
+    from openembedding_tpu.analysis import scope
+    from openembedding_tpu.serving import ha
+    from tools import graftwatch
+
+    rc = 0
+    procs: List[Any] = []
+    replica_traces: List[str] = []
+    router = None
+    native_model = None
+    tmp_dir = None
+    try:
+        # --- target selection ---------------------------------------------
+        if args.demo:
+            import tempfile
+            tmp_dir = tempfile.mkdtemp(prefix="graftload_demo_")
+            model_dir = build_demo_checkpoint(
+                os.path.join(tmp_dir, "model"))
+            args.sign, args.variable = DEMO_SIGN, "emb"
+            args.vocab = DEMO_VOCAB
+            print(f"graftload: demo checkpoint at {model_dir}",
+                  flush=True)
+            endpoints, procs, replica_traces = boot_demo_cluster(
+                model_dir, args.replicas,
+                trace_dir=tmp_dir if args.trace else "")
+            print(f"graftload: {len(endpoints)} replica(s) ready: "
+                  f"{endpoints}", flush=True)
+        else:
+            endpoints = [e for e in args.endpoints.split(",") if e]
+            model_dir = args.model_dir
+            if not endpoints and args.path != "native":
+                ap.error("--endpoints required without --demo")
+        if args.path in ("rest", "both"):
+            router = ha.RoutingClient(endpoints, timeout=args.timeout)
+        if args.path in ("native", "both"):
+            if not model_dir:
+                ap.error("--model-dir required for --path native")
+            from openembedding_tpu.serving.native import NativeModel
+            native_model = NativeModel(model_dir)
+
+        if args.trace:
+            scope.set_tracing(True)
+
+        # --- storms --------------------------------------------------------
+        rates = ([float(x) for x in args.sweep.split(",") if x]
+                 if args.sweep else [args.qps])
+        by_route: Dict[str, StormResult] = {}
+        all_storms: List[StormResult] = []
+        sweep_results: List[StormResult] = []
+        head = (f"{'route':<8}{'offered':>9}{'achieved':>10}{'calls':>7}"
+                f"{'err':>5}{'p50_ms':>9}{'p95_ms':>9}{'p99_ms':>9}")
+        print("\n" + head + "\n" + "-" * len(head))
+
+        def run_and_print(route: str, send, rate: float,
+                          seed: int) -> StormResult:
+            kill_at = None
+            if args.chaos and route == "rest" and len(procs) > 1:
+                kill_at = threading.Timer(
+                    args.duration / 2.0,
+                    lambda: (procs[-1].kill(), procs[-1].wait()))
+                kill_at.start()
+            res = _storm_once(args, route, send, rate, seed)
+            if kill_at is not None:
+                kill_at.cancel()
+            all_storms.append(res)
+            s = res.summary()
+            print(f"{route:<8}{s['offered_qps']:>9}{s['achieved_qps']:>10}"
+                  f"{s['calls']:>7}{s['errors']:>5}{s['p50_ms']:>9}"
+                  f"{s['p95_ms']:>9}{s['p99_ms']:>9}"
+                  + ("   CHAOS: killed 1 replica mid-storm"
+                     if kill_at is not None else ""), flush=True)
+            return res
+
+        for ri, rate in enumerate(rates):
+            if router is not None:
+                send = make_rest_sender(router, args.sign, args.variable,
+                                        args.vocab, args.batch, seed=ri)
+                res = run_and_print("rest", send, rate, seed=100 + ri)
+                by_route["rest"] = res
+                sweep_results.append(res)
+            if native_model is not None:
+                send = make_native_sender(native_model, args.variable,
+                                          args.vocab, args.batch,
+                                          seed=50 + ri)
+                res = run_and_print("native", send, rate, seed=200 + ri)
+                by_route["native"] = res
+                if router is None:
+                    sweep_results.append(res)
+
+        if args.sweep:
+            knee = find_knee(sweep_results)
+            if knee is not None:
+                print(f"\nknee: sustained {knee.achieved_qps:.1f} QPS at "
+                      f"offered {knee.offered_qps:.0f} "
+                      f"(p99 {knee.quantile_ms(0.99):.1f} ms)")
+                # the record below reflects ONLY the knee: every other
+                # route/rate in the sweep ran at rates chosen to find
+                # saturation, and saturated quantiles are not a
+                # latency baseline
+                by_route = {knee.route: knee}
+            else:
+                print("\nknee: NOT FOUND — even the lowest offered rate "
+                      "saturated or errored")
+                by_route = {}
+
+        # errors are judged over EVERY storm run, not just the ones the
+        # record keeps — a chaos-kill error in an early sweep rate must
+        # fail the invariant even when later rates ran clean
+        errors = sum(r.errors for r in all_storms)
+        if errors:
+            for r in all_storms:
+                if getattr(r, "first_error", ""):
+                    print(f"graftload: first {r.route} error: "
+                          f"{r.first_error}", file=sys.stderr)
+                    break
+            print(f"graftload: {errors} request error(s) — the chaos "
+                  "invariant is reads NEVER error while a replica "
+                  "lives", file=sys.stderr)
+            rc = 1
+
+        # client-side request counters (also on /metrics when the
+        # client is in-process with a server)
+        for name in ("serving_client_connections",
+                     "serving_request_retries",
+                     "serving_request_failovers"):
+            v = scope.HISTOGRAMS.counter(name)
+            if v:
+                print(f"  {name}: {v:.0f}")
+
+        # --- artifacts -----------------------------------------------------
+        if args.trace:
+            client_trace = scope.export_chrome_trace(
+                process_name="graftload")
+            # fold the replicas' server-side spans in: SIGTERM each
+            # daemon (its --trace-out export runs in the shutdown
+            # path), then merge every process onto the client timeline
+            server_traces: List[Dict[str, Any]] = []
+            if replica_traces:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    p.wait()
+                for path in replica_traces:
+                    try:
+                        with open(path, encoding="utf-8") as f:
+                            server_traces.append(json.load(f))
+                    except (OSError, json.JSONDecodeError):
+                        # a chaos-killed replica (SIGKILL) never wrote
+                        # its trace — expected, not a failure
+                        pass
+            trace = scope.merge_chrome_traces(client_trace,
+                                              server_traces, args.trace)
+            n = sum(1 for e in trace["traceEvents"]
+                    if e.get("ph") == "X")
+            traced = {e["args"]["trace"] for e in trace["traceEvents"]
+                      if e.get("args", {}).get("trace")}
+            sides = {e.get("pid") for e in trace["traceEvents"]}
+            print(f"wrote {args.trace}: {n} span events across "
+                  f"{len(sides)} process(es), {len(traced)} request "
+                  "traces (open in https://ui.perfetto.dev)")
+            if not traced:
+                print("graftload: trace carries no request ids",
+                      file=sys.stderr)
+                rc = 1
+
+        if args.trajectory:
+            primary = by_route.get("rest") or by_route.get("native")
+            if primary is None or primary.achieved_qps <= 0:
+                # nothing sustainable to record (every request errored,
+                # or the sweep found no knee): refuse the record, fail
+                # the run — never die on the schema validator's
+                # positive-QPS check with a traceback
+                print("graftload: no successful storm to record — "
+                      "skipping the trajectory record", file=sys.stderr)
+                rc = 1
+            else:
+                rec = graftwatch.make_serving_record(
+                    routes={k: v.summary()
+                            for k, v in by_route.items()},
+                    offered_qps=primary.offered_qps,
+                    achieved_qps=primary.achieved_qps,
+                    errors=errors, replicas=max(1, len(endpoints)),
+                    qps_band=primary.per_chunk_qps(),
+                    config={"source": "graftload", "qps": args.qps,
+                            "duration": args.duration,
+                            "batch": args.batch,
+                            "workers": args.workers, "path": args.path,
+                            "replicas": args.replicas,
+                            "sweep": bool(args.sweep),
+                            "chaos": bool(args.chaos)})
+                graftwatch.append_record(args.trajectory, rec)
+                print(f"graftload: appended serving record to "
+                      f"{args.trajectory} (achieved "
+                      f"{rec['eps']:.1f} QPS, rest p99 "
+                      f"{rec['scope'].get('rest', {}).get('p99_ms')} "
+                      "ms)")
+    finally:
+        if router is not None:
+            router.close()
+        if native_model is not None:
+            native_model.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
+        if tmp_dir:
+            import shutil
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    print("graftload: ok" if rc == 0 else "graftload: FAILED",
+          flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,7 +142,9 @@ def main(argv=None) -> int:
             coll.pull(states, idxs)      # plane_timed blocks + records
             states = coll.apply_gradients(states, idxs, grads)
         worlds[plane] = (coll, states)
-    observability.set_evaluate_performance(False)
+    # evaluate_performance stays ON through the traced Trainer run so
+    # record_batch_stats feeds the per-table distributions printed
+    # below (the host-side stats run outside the jitted step)
 
     rows = scope.ledger_rows(expected)
     print()
@@ -158,6 +160,7 @@ def main(argv=None) -> int:
             failures += 1
             print(f"FAIL {r['plane']}/{r['stage']}: {r['calls']} span(s) "
                   f"recorded < {args.steps} dispatched", file=sys.stderr)
+
 
     # --- 3. traced train-step run on --plane -------------------------------
     if not args.skip_train:
@@ -200,6 +203,26 @@ def main(argv=None) -> int:
             failures += 1
             print(f"FAIL traced run: {n} step spans < {args.steps}",
                   file=sys.stderr)
+    observability.set_evaluate_performance(False)
+
+    # batch-shape distribution series recorded this capture: the
+    # per-table pull stats (traced run, evaluate_performance on) and —
+    # when a serving path ran in-process — the per-variable serving
+    # lookup-size histogram (ISSUE 11: the input the micro-batching
+    # scheduler will be sized from)
+    dist_names = ("pull_rows", "pull_unique_ratio", "pull_key_skew",
+                  "serving_lookup_rows")
+    dist = [(n, lb) for (n, lb) in scope.HISTOGRAMS.series()
+            if n in dist_names]
+    if dist:
+        print("\ndistributions (count / p50 / p95):")
+        for name, labels in dist:
+            lab = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            print(f"  {name}{{{lab}}}: "
+                  f"{scope.HISTOGRAMS.count(name, **labels)} / "
+                  f"{scope.HISTOGRAMS.quantile(name, 0.5, **labels):.4g}"
+                  f" / "
+                  f"{scope.HISTOGRAMS.quantile(name, 0.95, **labels):.4g}")
 
     # --- trace export + validation -----------------------------------------
     scope.export_chrome_trace(args.out)
