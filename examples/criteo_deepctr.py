@@ -48,7 +48,12 @@ def parse_args(argv=None):
                    "exb.py:617-632); needs --no-fused")
     p.add_argument("--plane", default="a2a",
                    choices=["a2a", "psum", "a2a+cache", "a2a+grouped",
-                            "a2a+pipelined", "a2a+grouped+pipelined"],
+                            "a2a+pipelined", "a2a+grouped+pipelined",
+                            # compressed-exchange rungs (precision.py):
+                            # bf16 wire rows / bf16 pull + int8
+                            # error-feedback push
+                            "a2a+bf16", "a2a+int8",
+                            "a2a+grouped+bf16", "a2a+pipelined+bf16"],
                    help="sparse data plane: owner-routed all-to-all "
                    "(default), the psum/all_gather baseline, a2a plus "
                    "the hot-row replica cache (parallel/hot_cache.py), "
@@ -105,7 +110,14 @@ def main(argv=None):
     from openembedding_tpu.utils.envconfig import EnvConfig
     env_cfg = EnvConfig.load(path=args.config or None)
     reporter = env_cfg.apply_report()
+    # exchange sizing + the compressed-exchange precision rungs (the
+    # EnvConfig `exchange` section / OE_EXCHANGE_* env vars). A --plane
+    # precision suffix composes: matching rungs agree, a conflicting
+    # combination raises inside EmbeddingSpec (_resolve_precision)
     a2a_kw = env_cfg.a2a.spec_kwargs()
+    exch_kw = env_cfg.exchange.spec_kwargs()
+    if exch_kw != {"exchange_precision": "f32", "push_precision": "f32"}:
+        a2a_kw = dict(a2a_kw, **exch_kw)
 
     n_dev = len(jax.devices())
     mesh = create_mesh(args.data_parallel, n_dev // args.data_parallel)

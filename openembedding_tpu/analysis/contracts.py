@@ -101,6 +101,55 @@ def collect_collectives(hlo_text: str) -> List[Tuple[str, int, int]]:
     return out
 
 
+EXCHANGE_BYTE_OPS = ("all-to-all", "all-gather")
+# the compressed-exchange promise (ROADMAP item 6): a bf16/int8 plane's
+# exchange collectives move at most this fraction of the f32 plane's
+# bytes — asserted against BOTH compiled programs, not computed from a
+# formula, so partitioner padding/decomposition drift cannot fake it
+COMPRESSED_BYTE_RATIO = 0.55
+
+
+def exchange_collective_bytes(hlo_text: str,
+                              ops: Tuple[str, ...] = EXCHANGE_BYTE_OPS
+                              ) -> int:
+    """Total exchange bytes of one compiled program: the sum over every
+    ``ops`` collective instance of its largest single buffer (the
+    async-safe accounting summarize/largest uses — ``-start`` tuples
+    carry operand AND result). This is the quantity the byte-halving
+    contract compares between a compressed plane and its f32 baseline;
+    scalar all-reduces (residue-loop counts) are excluded by default."""
+    return sum(big for op, _total, big in collect_collectives(hlo_text)
+               if op in ops)
+
+
+def check_byte_halving(compressed_hlo: str, baseline_hlo: str, *,
+                       ratio: float = COMPRESSED_BYTE_RATIO,
+                       label: str = "") -> Tuple[int, int]:
+    """Enforce compressed exchange bytes <= ratio * f32 exchange bytes.
+
+    Both arguments are compiled HLO text of the SAME program shape
+    (same mesh/batch/dim — the callers lower them side by side).
+    Returns (compressed_bytes, baseline_bytes); raises
+    :class:`ContractViolation` when the claimed halving is not in the
+    compiled program — including when the "compressed" program is
+    secretly the f32 one (ratio 1.0), the negative the tests pin.
+    """
+    where = f"{label}: " if label else ""
+    got = exchange_collective_bytes(compressed_hlo)
+    base = exchange_collective_bytes(baseline_hlo)
+    if base <= 0:
+        raise ContractViolation(
+            f"{where}baseline f32 program has no exchange collectives — "
+            "nothing to compare the compressed plane against")
+    if got > ratio * base:
+        raise ContractViolation(
+            f"{where}compressed exchange moves {got} bytes > "
+            f"{ratio:.2f} x f32 baseline {base} bytes "
+            f"(ratio {got / base:.3f}) — the wire is NOT compressed "
+            "(rows crossing the exchange at full precision?)")
+    return got, base
+
+
 def summarize(hlo_text: str, *,
               largest: bool = False) -> Dict[str, Tuple[int, int]]:
     """op -> (count, bytes). Default bytes sum every result buffer;
@@ -629,6 +678,27 @@ def _row_assembly(p: Mapping[str, int]) -> int:
                * ROW_ASSEMBLY_SLACK)
 
 
+def _wire(p: Mapping[str, int]) -> int:
+    # per-element bytes of ROW/GRAD payload on the wire: the compressed
+    # planes' params carry wire_itemsize (2 = bf16, 1 = int8); absent
+    # (uncompressed planes) it equals the storage itemsize
+    return int(p.get("wire_itemsize", p["itemsize"]))
+
+
+def _row_assembly_wire(p: Mapping[str, int]) -> int:
+    # compressed pull: the row-assembly gather moves WIRE-dtype rows
+    return int(p["batch_slice"] * p["dim"] * _wire(p)
+               * ROW_ASSEMBLY_SLACK)
+
+
+def _global_prereduce_wire(p: Mapping[str, int]) -> int:
+    # compressed push overflow fallback: grads gather at wire width,
+    # keys/scales/counts gather as separate int32/pair buffers — the
+    # +8 covers the widest of those per entry
+    return int(p["global_batch"] * (p["dim"] * _wire(p) + 8)
+               * ROW_ASSEMBLY_SLACK)
+
+
 def _global_prereduce(p: Mapping[str, int]) -> int:
     # the push overflow fallback all_gathers every peer's pre-reduced
     # slice: O(global_batch * dim) — paid only when structured key skew
@@ -713,6 +783,11 @@ class ProgramContract:
     no_host_transfers: bool = True
     min_aliased: int = 0              # donation floor (step programs)
     overlap: bool = False             # enforce :func:`check_overlap`
+    # compressed planes: exchange bytes <= byte_ratio x the baseline
+    # plane's compiled program (enforced by check_compressed_program,
+    # which needs BOTH HLO texts; check() alone cannot see the baseline)
+    baseline_plane: Optional[str] = None
+    byte_ratio: Optional[float] = None
 
     def check(self, hlo_text: str,
               params: Mapping[str, int]) -> Dict[str, Tuple[int, int]]:
@@ -855,6 +930,46 @@ _register(ProgramContract(
 _register(ProgramContract(
     plane="a2a+pipelined", program="step",
     min_aliased=1, overlap=True))
+# The compressed-exchange planes (parallel/precision.py): same owner
+# exchange as a2a, but the row/grad payloads cross the wire narrowed —
+# bf16 rows both directions ("a2a+bf16"), or bf16 pull + per-row-scale
+# int8 error-feedback push ("a2a+int8"). Two teeth per program: (1) the
+# inventory bounds below, with the all-gather legs bounded at the WIRE
+# itemsize (an f32 row-assembly gather under a compressed contract
+# busts _row_assembly_wire — the "f32 plane registered as compressed"
+# negative); (2) the byte-halving ratio vs the f32 baseline's compiled
+# program, enforced by check_compressed_program/graftcheck. The ratio
+# binds at the audit shape (dim >= 32): keys/counts stay int32, so
+# total-bytes/f32 asymptotes to 0.5 as dim grows and crosses 0.55 from
+# above near dim 16 — the audit pins dim 64, where pull ≈ 0.51 and
+# int8 push ≈ 0.30.
+_register(ProgramContract(
+    plane="a2a+bf16", program="pull",
+    baseline_plane="a2a", byte_ratio=COMPRESSED_BYTE_RATIO,
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_row_assembly_wire),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
+_register(ProgramContract(
+    plane="a2a+bf16", program="push",
+    baseline_plane="a2a", byte_ratio=COMPRESSED_BYTE_RATIO,
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_global_prereduce_wire),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
+# "a2a+int8" pulls ride the bf16 wire (the token selects exchange bf16
+# + push int8_ef); its push payload is int8 with the f32 scales bitcast
+# into the integer key/count exchange buffer
+_register(ProgramContract(
+    plane="a2a+int8", program="pull",
+    baseline_plane="a2a", byte_ratio=COMPRESSED_BYTE_RATIO,
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_row_assembly_wire),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
+_register(ProgramContract(
+    plane="a2a+int8", program="push",
+    baseline_plane="a2a", byte_ratio=COMPRESSED_BYTE_RATIO,
+    ops={"all-to-all": OpBudget(min_count=1),
+         "all-gather": OpBudget(max_buffer=_global_prereduce_wire),
+         "all-reduce": OpBudget(max_buffer=_scalar)}))
 _register(ProgramContract(
     plane="psum", program="pull",
     forbid=("all-to-all",),
@@ -893,6 +1008,28 @@ def check_program(hlo_text: str, plane: str, program: str,
             "all-gather is O(global_batch * dim)); pass it explicitly "
             "or use analysis.programs.contract_params")
     return REGISTRY[key].check(hlo_text, params)
+
+
+def check_compressed_program(hlo_text: str, baseline_hlo: str, plane: str,
+                             program: str, **params) -> Dict[str, Any]:
+    """Full audit of one COMPRESSED plane program: its registered
+    inventory contract (wire-width byte bounds) PLUS the byte-halving
+    ratio against the f32 baseline's compiled HLO. ``baseline_hlo``
+    must be the registered ``baseline_plane``'s program lowered at the
+    same mesh/batch/dim. Returns a summary dict; raises
+    :class:`ContractViolation` on any breach."""
+    summary = check_program(hlo_text, plane, program, **params)
+    contract = REGISTRY[(plane, program)]
+    if contract.byte_ratio is None or contract.baseline_plane is None:
+        raise KeyError(
+            f"({plane}, {program}) is not a compressed contract — no "
+            "byte_ratio/baseline_plane registered")
+    got, base = check_byte_halving(
+        hlo_text, baseline_hlo, ratio=contract.byte_ratio,
+        label=f"{plane}/{program} vs {contract.baseline_plane}")
+    return {"collectives": summary, "exchange_bytes": got,
+            "baseline_bytes": base, "ratio": got / base,
+            "max_ratio": contract.byte_ratio}
 
 
 # --- the original hlocheck entry point (kept verbatim for callers) -----------

@@ -58,6 +58,19 @@ def _state_nbytes(states) -> int:
     return int(sum(x.nbytes for x in jax.tree.leaves(states)))
 
 
+def _wire_params(plane: str, program: str) -> Dict[str, int]:
+    """Precision-aware contract params for a (possibly compressed)
+    plane token: the wire itemsize of the program's row/grad payload
+    (``parallel/precision.py``). Empty for uncompressed planes, so the
+    f32 bounds stay byte-identical to before."""
+    from ..parallel import precision
+    _base, ep, pp = precision.parse_plane(plane)
+    rung = ep if program == "pull" else pp
+    if rung == "f32":
+        return {}
+    return {"wire_itemsize": precision.wire_itemsize(rung)}
+
+
 def compile_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
                  batch: int = 1024, use_hash: bool = False,
                  out_replicated: bool = False):
@@ -87,9 +100,10 @@ def compile_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
     compiled = jax.jit(
         pull_fn, out_shardings=NamedSharding(mesh, out_spec)
     ).lower(states, idx).compile()
-    return compiled, contract_params(mesh, batch=batch, dim=dim,
-                                     vocab=vocab,
-                                     state_nbytes=_state_nbytes(states))
+    params = contract_params(mesh, batch=batch, dim=dim, vocab=vocab,
+                             state_nbytes=_state_nbytes(states))
+    params.update(_wire_params(plane, "pull"))
+    return compiled, params
 
 
 def lower_pull(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
@@ -120,9 +134,10 @@ def compile_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,
     idx = jax.device_put(jnp.zeros((batch,), jnp.int32), sh)
     grads = jax.device_put(jnp.zeros((batch, dim), jnp.float32), sh)
     compiled = jax.jit(push_fn).lower(states, idx, grads).compile()
-    return compiled, contract_params(mesh, batch=batch, dim=dim,
-                                     vocab=vocab,
-                                     state_nbytes=_state_nbytes(states))
+    params = contract_params(mesh, batch=batch, dim=dim, vocab=vocab,
+                             state_nbytes=_state_nbytes(states))
+    params.update(_wire_params(plane, "push"))
+    return compiled, params
 
 
 def lower_push(mesh, plane: str, *, vocab: int = 1 << 16, dim: int = 16,

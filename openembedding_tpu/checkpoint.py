@@ -357,6 +357,15 @@ def _save_checkpoint_impl(path: str,
     }
     if hash_info:
         meta.extra["hash_variables"] = hash_info
+    # per-field storage dtypes ("tpu-2"): numpy serializes non-native
+    # dtypes (ml_dtypes bfloat16 — the at-rest precision-ladder rung) as
+    # opaque '<V2' descrs; loaders view such chunks back under the TRUE
+    # dtype recorded here, then cast to the target (upcast on load)
+    meta.extra["storage_dtypes"] = {
+        name: _field_dtypes(hot_cache.unwrap(states[name]),
+                            include_optimizer)
+        for name in collection.specs
+    }
     if rank == 0:
         with fs.open_file(fs.join(path, MODEL_META_FILE), "wb") as f:
             f.write(meta.dumps().encode("utf-8"))
@@ -431,6 +440,49 @@ def _save_checkpoint_impl(path: str,
                          include_optimizer=include_optimizer)
     _sync("ckpt_done")
     return nbytes
+
+
+def _field_dtypes(state, include_optimizer: bool) -> Dict[str, str]:
+    """name -> numpy dtype string of every dumped field of one state."""
+    out = {"weights": np.dtype(state.weights.dtype).name}
+    if hasattr(state, "keys"):
+        out["keys"] = np.dtype(state.keys.dtype).name
+    if include_optimizer:
+        for sname, sval in state.slots.items():
+            out[f"slot_{sname}"] = np.dtype(sval.dtype).name
+    return out
+
+
+def _decode_rows(arr, true_dtype: Optional[str], target_dtype,
+                 legacy_dtype: Optional[str] = None):
+    """One stored chunk -> rows castable to ``target_dtype``.
+
+    Opaque void descrs (numpy's serialization of ml_dtypes bfloat16)
+    are viewed back under their TRUE dtype — the "tpu-2" meta records
+    it per field. Absent (a "tpu-1" dump), the target dtype stands in
+    when the itemsize matches (the pre-existing remote-path contract),
+    then ``legacy_dtype`` — the dump's TABLE datatype, because tpu-1
+    slots were stored at the table dtype, so a pre-ladder bf16 dump's
+    slot chunks are bf16 even though today's slot target is f32. The
+    final cast is the transparent up/down-conversion of a dtype
+    migration (f32 dump -> bf16 table and vice versa).
+    """
+    arr = np.asarray(arr)
+    target = np.dtype(target_dtype)
+    if arr.dtype.kind == "V":
+        for cand in (true_dtype, target, legacy_dtype):
+            if cand is not None \
+                    and np.dtype(cand).itemsize == arr.dtype.itemsize:
+                arr = arr.view(np.dtype(cand))
+                break
+        else:
+            raise ValueError(
+                f"stored void chunk of itemsize {arr.dtype.itemsize} "
+                f"matches none of (recorded={true_dtype!r}, "
+                f"target={target}, dump table dtype={legacy_dtype!r}) "
+                "— checkpoint storage_dtypes out of sync with the data "
+                "files")
+    return arr if arr.dtype == target else arr.astype(target)
 
 
 def _array_state_bytes(state, vocab: int, sspec: st.ShardingSpec,
@@ -798,7 +850,9 @@ def _open_var(path: str, vid: int, name: str):
 
 
 def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
-                    shardings, with_opt: bool):
+                    shardings, with_opt: bool,
+                    stored_dtypes: Optional[Dict[str, str]] = None,
+                    legacy_dtype: Optional[str] = None):
     """Assemble one bounded variable shard-by-shard from its dump.
 
     ``readers`` is the part list from ``_open_var``. A single-part dump is
@@ -821,6 +875,8 @@ def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
             parts_phys.append(
                 (ids, shard * sspec.rows_per_shard + local_idx))
 
+    stored_dtypes = stored_dtypes or {}
+
     def build(fname, fill, store_dtype, row_shape, sharding):
         global_shape = (pv,) + row_shape
         locals_ = []
@@ -828,6 +884,7 @@ def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
             sharding.addressable_devices_indices_map(global_shape).items(),
             key=lambda kv: kv[1][0].start or 0)
         sources = [r[fname] if fname in r else None for r in readers]
+        true = stored_dtypes.get(fname)
         for dev, idx in devs:
             start = idx[0].start or 0
             stop = idx[0].stop if idx[0].stop is not None else pv
@@ -839,14 +896,17 @@ def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
                         continue
                     sel = (phys >= start) & (phys < stop) & (ids < vocab)
                     if sel.any():
-                        local[phys[sel] - start] = source[sel]
+                        local[phys[sel] - start] = _decode_rows(
+                            source[sel], true, store_dtype,
+                            legacy_dtype)
             elif sources[0] is not None:
                 stored = min(vocab, sources[0].shape[0])
                 sl, nv = _logical_slice(sspec, stored, start, stop - start)
                 if nv:
                     # basic (strided/contiguous) memmap slice: streams this
                     # shard's rows without touching the rest of the file
-                    local[:nv] = sources[0][sl]
+                    local[:nv] = _decode_rows(sources[0][sl], true,
+                                              store_dtype, legacy_dtype)
             locals_.append(jax.device_put(local, dev))
         return jax.make_array_from_single_device_arrays(
             global_shape, sharding, locals_)
@@ -869,7 +929,9 @@ def _load_array_var(readers, spec, sspec: st.ShardingSpec, optimizer,
 
 def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
                            mesh, with_opt: bool, from_hash: bool = False,
-                           shard_slice: Optional[tuple] = None):
+                           shard_slice: Optional[tuple] = None,
+                           stored_dtypes: Optional[Dict[str, str]] = None,
+                           legacy_dtype: Optional[str] = None):
     """Streamed twin of ``_load_array_var``: blank sharded arrays +
     sequential keyed chunk delivery (``deliver_rows_sharded``), so a
     gs://-scale table loads with bounded host memory and purely sequential
@@ -885,6 +947,7 @@ def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
         raise ValueError("hash->array conversion cannot be combined with a "
                          "serving shard slice (serve hash dumps as hash)")
     vocab = spec.input_dim
+    stored_dtypes = stored_dtypes or {}
     dtype = np.dtype(table_lib.resolve_dtype(spec.meta()))
     dim = spec.output_dim
     weights = st.filled_sharded(mesh, sspec, (dim,), 0.0, dtype)
@@ -950,15 +1013,20 @@ def _load_array_var_stream(readers, spec, sspec: st.ShardingSpec, optimizer,
                 return jnp.asarray(out)
 
             weights = st.deliver_rows_sharded(
-                weights, jphys, pad_rows(fs.view_as(chunk["weights"],
-                                                    dtype)),
+                weights, jphys,
+                pad_rows(_decode_rows(chunk["weights"],
+                                      stored_dtypes.get("weights"),
+                                      dtype, legacy_dtype)),
                 mesh=mesh, spec=sspec)
             for sname in slots:
                 f = f"slot_{sname}"
                 if f in chunk:
                     slots[sname] = st.deliver_rows_sharded(
                         slots[sname], jphys,
-                        pad_rows(fs.view_as(chunk[f], slot_dtypes[sname])),
+                        pad_rows(_decode_rows(chunk[f],
+                                              stored_dtypes.get(f),
+                                              slot_dtypes[sname],
+                                              legacy_dtype)),
                         mesh=mesh, spec=sspec)
     return table_lib.TableState(weights=weights, slots=slots)
 
@@ -972,10 +1040,15 @@ def _check_meta(path: str, collection: EmbeddingCollection,
                 shard_slice: Optional[tuple] = None) -> ModelMeta:
     """Validate the dump's variable metas against the model's.
 
-    dim and dtype must match exactly. The vocabulary may differ when the
-    TABLE CATEGORY differs (array dump -> hash variable, or hash dump ->
-    array variable): the loader converts by streaming rows through the
-    target's delivery path — the reference's ``copy_from`` hot-swap
+    dim must match exactly; the datatype may differ within the
+    {float32, bfloat16} precision family (the at-rest rung of the
+    compressed-exchange ladder, ``parallel/precision.py``) — the
+    loaders cast row-by-row, so an f32 dump loads into a bf16 table
+    (downcast) and a bf16 dump upcasts into f32 transparently. The
+    vocabulary may differ when the TABLE CATEGORY differs (array dump
+    -> hash variable, or hash dump -> array variable): the loader
+    converts by streaming rows through the target's delivery path —
+    the reference's ``copy_from`` hot-swap
     (/root/reference/openembedding/variable/EmbeddingVariable.cpp:29-60),
     which loads any dump into any table/optimizer implementation. A
     bounded->bounded vocabulary mismatch still fails (resizing a bounded
@@ -991,16 +1064,21 @@ def _check_meta(path: str, collection: EmbeddingCollection,
                              f"{v.name!r}")
         g = got_vars[v.name]
         if g.meta != v.meta:
-            same_shape = (
-                g.meta.embedding_dim == v.meta.embedding_dim
-                and g.meta.datatype == v.meta.datatype)
+            dtype_ok = (
+                g.meta.datatype == v.meta.datatype
+                or {g.meta.datatype, v.meta.datatype}
+                <= {"float32", "bfloat16"})   # precision migration
+            same_shape = (g.meta.embedding_dim == v.meta.embedding_dim
+                          and dtype_ok)
+            same_vocab = (g.meta.vocabulary_size == v.meta.vocabulary_size)
             category_swap = _is_hash_meta(g.meta) != _is_hash_meta(v.meta)
             slice_ok = (
                 shard_slice is not None and same_shape
                 and not _is_hash_meta(g.meta) and not _is_hash_meta(v.meta)
                 and v.meta.vocabulary_size == shard_slice_vocab(
                     g.meta.vocabulary_size, *shard_slice))
-            if not ((same_shape and category_swap) or slice_ok):
+            if not ((same_shape and (category_swap or same_vocab))
+                    or slice_ok):
                 raise ValueError(
                     f"variable {v.name!r} meta mismatch: checkpoint "
                     f"{g.meta} vs model {v.meta}")
@@ -1079,6 +1157,7 @@ def _load_checkpoint_impl(path: str,
                           shard_slice: Optional[tuple]):
     meta = _check_meta(path, collection, shard_slice=shard_slice)
     with_opt = bool(meta.extra.get("include_optimizer", True))
+    stored_all = meta.extra.get("storage_dtypes", {})
     dump_meta = {v.name: v.meta for v in meta.variables}
     hash_names = [n for n, s in collection.specs.items() if s.use_hash]
     # only hash variables need fresh (empty) device tables; bounded tables are
@@ -1097,7 +1176,9 @@ def _load_checkpoint_impl(path: str,
             for data_part in data:
                 state, n_part = _insert_hash_rows(
                     state, data_part, collection, sspec, with_opt,
-                    from_array=not dump_hash, shard_slice=shard_slice)
+                    from_array=not dump_hash, shard_slice=shard_slice,
+                    stored_dtypes=stored_all.get(name),
+                    legacy_dtype=dump_meta[name].datatype)
                 total_rows += n_part
             failed = int(jax.device_get(state.insert_failures))
             if failed > 0:
@@ -1111,18 +1192,24 @@ def _load_checkpoint_impl(path: str,
             # hash dump -> bounded variable: copy_from conversion
             out[name] = _load_array_var_stream(
                 data, spec, sspec, optimizer, collection.mesh, with_opt,
-                from_hash=True, shard_slice=shard_slice)
+                from_hash=True, shard_slice=shard_slice,
+                stored_dtypes=stored_all.get(name),
+                legacy_dtype=dump_meta[name].datatype)
         elif fs.is_remote(path) or shard_slice is not None \
                 or any(getattr(r, "streaming", False) for r in data):
             out[name] = _load_array_var_stream(
                 data, spec, sspec, optimizer, collection.mesh, with_opt,
-                shard_slice=shard_slice)
+                shard_slice=shard_slice,
+                stored_dtypes=stored_all.get(name),
+                legacy_dtype=dump_meta[name].datatype)
         else:
             shardings = collection.state_shardings()[name]
             if isinstance(shardings, hot_cache.CachedState):
                 shardings = shardings.table
             out[name] = _load_array_var(
-                data, spec, sspec, optimizer, shardings, with_opt)
+                data, spec, sspec, optimizer, shardings, with_opt,
+                stored_dtypes=stored_all.get(name),
+                legacy_dtype=dump_meta[name].datatype)
     # delta chain replay: committed deltas patched over the base, newest
     # wins; torn final delta discarded whole (checkpoint_delta.py)
     from . import checkpoint_delta as cd
@@ -1144,7 +1231,9 @@ def _load_checkpoint_impl(path: str,
 
 def _insert_hash_rows(state, data, collection, sspec, with_opt,
                       from_array: bool = False,
-                      shard_slice: Optional[tuple] = None):
+                      shard_slice: Optional[tuple] = None,
+                      stored_dtypes: Optional[Dict[str, str]] = None,
+                      legacy_dtype: Optional[str] = None):
     """Stream one reader's (keys, weights, states) rows into the table.
 
     Consumes row-aligned chunks so the same code path serves memmapped
@@ -1238,14 +1327,17 @@ def _insert_hash_rows(state, data, collection, sspec, with_opt,
                 else raw_keys.astype(np.int64)
             ck[:got][(ids64 % G) != k] = empty
         wdtype = np.dtype(state.weights.dtype)
+        stored = stored_dtypes or {}
         cw = np.zeros((size,) + chunk["weights"].shape[1:], wdtype)
-        cw[:got] = fs.view_as(chunk["weights"], wdtype)
+        cw[:got] = _decode_rows(chunk["weights"], stored.get("weights"),
+                                wdtype, legacy_dtype)
         srows = {}
         for fname in (m for m in names if m.startswith("slot_")):
             sname = fname[len("slot_"):]
             sdtype = np.dtype(state.slots[sname].dtype)
             cs = np.zeros((size,) + chunk[fname].shape[1:], sdtype)
-            cs[:got] = fs.view_as(chunk[fname], sdtype)
+            cs[:got] = _decode_rows(chunk[fname], stored.get(fname),
+                                    sdtype, legacy_dtype)
             srows[sname] = jnp.asarray(cs)
         state = sh.insert_rows_sharded(
             state, jnp.asarray(ck), jnp.asarray(cw), srows,

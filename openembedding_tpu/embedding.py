@@ -89,6 +89,13 @@ class EmbeddingSpec:
     a2a_capacity: int = 0            # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0           # auto bucket = slack * mean
     cache_k: int = 0                 # hot-row replica slots; 0 = default
+    exchange_precision: str = "f32"  # pulled rows on the wire: f32 | bf16
+                                     # (parallel/precision.py; a "+bf16"/
+                                     # "+int8" plane suffix is shorthand)
+    push_precision: str = "f32"      # pre-reduced grads on the wire:
+                                     # f32 | bf16 | int8_ef (per-row-scale
+                                     # int8 with an error-feedback
+                                     # residual in the state pytree)
     cache_refresh_every: int = 64    # admission refresh period (steps)
     cache_decay: float = 0.8         # frequency-sketch decay per refresh
     pooling: Optional[str] = None    # sequence combiner: sum | mean | sqrtn;
@@ -102,6 +109,16 @@ class EmbeddingSpec:
             # key space (2^62 ids) — int32 (2^31 ids) is opt-in
             object.__setattr__(self, "key_dtype",
                                "wide" if self.input_dim == -1 else "int32")
+        # a "+bf16"/"+int8" plane suffix is shorthand for the
+        # compressed-exchange rungs: normalize it into the precision
+        # fields so spec.plane always names the BASE data plane
+        # (parallel/precision.py; conflicts and illegal combinations
+        # raise in st._resolve_precision)
+        base, ep, pp = st._resolve_precision(
+            self.plane, self.exchange_precision, self.push_precision)
+        object.__setattr__(self, "plane", base)
+        object.__setattr__(self, "exchange_precision", ep)
+        object.__setattr__(self, "push_precision", pp)
 
     @property
     def use_hash(self) -> bool:
@@ -158,13 +175,17 @@ class EmbeddingCollection:
                     num_shards=spec.num_shards, plane=spec.plane,
                     a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack,
                     key_width=64 if spec.key_dtype == "wide" else 32,
-                    cache_k=spec.cache_k)
+                    cache_k=spec.cache_k,
+                    exchange_precision=spec.exchange_precision,
+                    push_precision=spec.push_precision)
             else:
                 self._shardings[spec.name] = st.make_sharding_spec(
                     spec.meta(), mesh, num_shards=spec.num_shards,
                     layout=spec.layout, plane=spec.plane,
                     a2a_capacity=spec.a2a_capacity, a2a_slack=spec.a2a_slack,
-                    cache_k=spec.cache_k)
+                    cache_k=spec.cache_k,
+                    exchange_precision=spec.exchange_precision,
+                    push_precision=spec.push_precision)
 
     # --- dirty tracking (delta checkpoints, checkpoint.py mode="delta") ----
     def enable_dirty_tracking(self, *, target_chunks: int = 1024) -> None:
@@ -343,13 +364,31 @@ class EmbeddingCollection:
         return states
 
     def wrap_hot_cache(self, name: str, table_state):
-        """Attach an empty (all-pad) hot-row replica to a bare table state
-        when ``name`` is on the ``"a2a+cache"`` plane; pass-through
-        otherwise. The checkpoint loader and serving restore use this too
-        — the replica is derived state, never checkpointed."""
-        from .parallel import hot_cache
-        return hot_cache.attach_empty(table_state, self._shardings[name],
-                                      self.mesh)
+        """Attach derived per-plane state to a bare table state:
+        an empty (all-pad) hot-row replica on the ``"a2a+cache"`` plane,
+        an empty int8_ef push residual (``precision.EFState``) for
+        ``push_precision="int8_ef"`` variables; pass-through otherwise.
+        The checkpoint loader and serving restore use this too — both
+        wrappers are derived state, never checkpointed (a restore
+        forfeits at most one step of error feedback)."""
+        from .parallel import hot_cache, precision
+        sspec = self._shardings[name]
+        # single-shard meshes have no wire: the push runs the exact
+        # masked-local program and returns a bare table, so attaching a
+        # wrapper here would flip the state pytree STRUCTURE after the
+        # first push (a forced retrace under the donated step jit)
+        if getattr(sspec, "is_int8_ef", False) and sspec.num_shards > 1 \
+                and not isinstance(table_state, precision.EFState):
+            spec = self.specs[name]
+            wide = spec.use_hash and spec.key_dtype == "wide"
+            sentinel, key_dtype = precision.ef_key_space(
+                use_hash=spec.use_hash, wide=wide,
+                key_dtype=None if wide or not spec.use_hash
+                else spec.key_dtype)
+            return precision.empty_ef(table_state, dim=spec.output_dim,
+                                      wide=wide, sentinel=sentinel,
+                                      key_dtype=key_dtype)
+        return hot_cache.attach_empty(table_state, sspec, self.mesh)
 
     def state_shardings(self) -> Dict[str, Any]:
         """NamedShardings for every state leaf (for jit in/out_shardings)."""

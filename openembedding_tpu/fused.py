@@ -150,7 +150,9 @@ def make_fused_specs(feature_names: Sequence[str],
                      a2a_slack: float = 2.0,
                      cache_k: int = 0,
                      cache_refresh_every: int = 64,
-                     cache_decay: float = 0.8
+                     cache_decay: float = 0.8,
+                     exchange_precision: str = "f32",
+                     push_precision: str = "f32"
                      ) -> Tuple[Tuple[EmbeddingSpec, ...], FusedMapper]:
     """Specs + mapper for one fused table over ``feature_names``.
 
@@ -181,7 +183,9 @@ def make_fused_specs(feature_names: Sequence[str],
         num_shards=num_shards, plane=plane,
         a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
         cache_k=cache_k, cache_refresh_every=cache_refresh_every,
-        cache_decay=cache_decay)]
+        cache_decay=cache_decay,
+        exchange_precision=exchange_precision,
+        push_precision=push_precision)]
     if need_linear:
         specs.append(EmbeddingSpec(
             name=name + LINEAR_SUFFIX, input_dim=input_dim, output_dim=1,
@@ -191,5 +195,7 @@ def make_fused_specs(feature_names: Sequence[str],
             num_shards=num_shards, plane=plane,
             a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
             cache_k=cache_k, cache_refresh_every=cache_refresh_every,
-            cache_decay=cache_decay))
+            cache_decay=cache_decay,
+            exchange_precision=exchange_precision,
+            push_precision=push_precision))
     return tuple(specs), mapper

@@ -19,7 +19,14 @@ import numpy as np
 # Checkpoint/meta format version. The reference uses "0.2"
 # (/root/reference/openembedding/variable/Meta.h:109-111); we start our own
 # lineage at "tpu-1" to make cross-loading errors explicit.
-META_FORMAT_VERSION = "tpu-1"
+# "tpu-2": per-variable storage dtypes recorded in extra["storage_dtypes"]
+# so at-rest bf16 dumps (numpy-serialized as opaque '<V2' descrs, incl.
+# through the compress.py-framed .npyz streams) decode under their TRUE
+# dtype on load — and upcast transparently into f32 targets. Readers
+# accept every version in META_COMPAT_VERSIONS: an old f32 "tpu-1"
+# checkpoint loads unchanged.
+META_FORMAT_VERSION = "tpu-2"
+META_COMPAT_VERSIONS = ("tpu-1", "tpu-2")
 
 # The reference treats vocabulary_size >= 2**63 as "unbounded key space ->
 # use a hash table" (Meta.h:44-46). We keep the same convention.
@@ -133,10 +140,10 @@ class ModelMeta:
     @classmethod
     def from_json(cls, obj: dict) -> "ModelMeta":
         version = obj.get("version", "")
-        if version != META_FORMAT_VERSION:
+        if version not in META_COMPAT_VERSIONS:
             raise ValueError(
-                f"checkpoint meta version {version!r} does not match "
-                f"{META_FORMAT_VERSION!r}")
+                f"checkpoint meta version {version!r} is not one of "
+                f"{META_COMPAT_VERSIONS} (writer newer than this reader?)")
         return cls(
             model_sign=obj.get("model_sign", ""),
             model_uri=obj.get("model_uri", ""),

@@ -46,7 +46,10 @@ def make_feature_specs(feature_names: Sequence[str],
                        a2a_slack: float = 2.0,
                        cache_k: int = 0,
                        cache_refresh_every: int = 64,
-                       cache_decay: float = 0.8) -> Tuple[EmbeddingSpec, ...]:
+                       cache_decay: float = 0.8,
+                       exchange_precision: str = "f32",
+                       push_precision: str = "f32"
+                       ) -> Tuple[EmbeddingSpec, ...]:
     """Build the spec list for a set of categorical features.
 
     ``vocab_sizes``: int per feature, or a single int, or -1 for the hash
@@ -68,7 +71,9 @@ def make_feature_specs(feature_names: Sequence[str],
             hash_capacity=hash_capacity, num_shards=num_shards, plane=plane,
             a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
             cache_k=cache_k, cache_refresh_every=cache_refresh_every,
-            cache_decay=cache_decay))
+            cache_decay=cache_decay,
+            exchange_precision=exchange_precision,
+            push_precision=push_precision))
         if need_linear:
             specs.append(EmbeddingSpec(
                 name=name + LINEAR_SUFFIX, input_dim=vocab, output_dim=1,
@@ -78,7 +83,9 @@ def make_feature_specs(feature_names: Sequence[str],
                 plane=plane, a2a_capacity=a2a_capacity,
                 a2a_slack=a2a_slack, cache_k=cache_k,
                 cache_refresh_every=cache_refresh_every,
-                cache_decay=cache_decay))
+                cache_decay=cache_decay,
+                exchange_precision=exchange_precision,
+                push_precision=push_precision))
     return tuple(specs)
 
 

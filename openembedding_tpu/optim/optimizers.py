@@ -54,11 +54,14 @@ class SparseOptimizer:
         return 0.0
 
     def slot_dtype(self, name: str, table_dtype):
-        """Storage dtype for a slot. Scalar accumulators (e.g. Adam beta
-        powers) are kept at >= float32 even for bfloat16 tables — repeated
-        multiplication of 0.999 in bf16 (8-bit mantissa) would corrupt the
-        bias correction."""
-        return table_dtype
+        """Storage dtype for a slot: at least float32, regardless of the
+        table dtype. bf16 tables + f32 slots is the at-rest rung of the
+        compressed-exchange precision ladder (``parallel/precision.py``):
+        the weights (the HBM-dominant array at dim >= slots-per-row)
+        halve while the optimizer statistics keep full precision —
+        accumulator drift in bf16 (8-bit mantissa) would compound every
+        step, unlike the weights' one rounding per update."""
+        return jnp.promote_types(table_dtype, jnp.float32)
 
     def init_slots(self, num_rows: int, dim: int, dtype) -> Slots:
         return {
@@ -153,11 +156,6 @@ class Adam(SparseOptimizer):
     def slot_init(self, name):
         return 1.0 if name in ("beta_1_t", "beta_2_t") else 0.0
 
-    def slot_dtype(self, name, table_dtype):
-        if name in ("beta_1_t", "beta_2_t"):
-            return jnp.promote_types(table_dtype, jnp.float32)
-        return table_dtype
-
     def update_rows(self, weights, slots, grads, counts):
         beta_1_t = slots["beta_1_t"] * self.beta_1
         beta_2_t = slots["beta_2_t"] * self.beta_2
@@ -181,11 +179,6 @@ class Adamax(SparseOptimizer):
 
     def slot_init(self, name):
         return 1.0 if name == "beta_1_t" else 0.0
-
-    def slot_dtype(self, name, table_dtype):
-        if name == "beta_1_t":
-            return jnp.promote_types(table_dtype, jnp.float32)
-        return table_dtype
 
     def update_rows(self, weights, slots, grads, counts):
         beta_1_t = slots["beta_1_t"] * self.beta_1

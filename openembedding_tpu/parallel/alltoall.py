@@ -60,6 +60,27 @@ from ..ops import dedup
 from ..utils import observability
 
 
+def pin_wire(x: jnp.ndarray) -> jnp.ndarray:
+    """Reinterpret a 16-bit float wire payload as uint16 bits.
+
+    The compressed planes' promise is a BYTE property of the compiled
+    collectives. A plain ``astype`` pair around the exchange is
+    value-correct but not byte-stable: XLA's algebraic simplifier
+    commutes converts across data-movement ops (and drops
+    optimization_barrier on some backends), happily shipping f32 with a
+    fused bf16 round-trip in front — same numbers, double the bytes,
+    and the byte-halving contract fails. A bitcast is not a convert:
+    the simplifier cannot move it across the collective, so the wire
+    buffer is uint16 in the compiled program on every backend.
+    """
+    return lax.bitcast_convert_type(x, jnp.uint16)
+
+
+def unpin_wire(x: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of :func:`pin_wire` (exact bit reinterpretation)."""
+    return lax.bitcast_convert_type(x, dtype)
+
+
 def record_stat(counter: str, local_value: jnp.ndarray,
                 record: bool) -> None:
     """Gated host accumulation of routed-exchange statistics.
@@ -82,6 +103,28 @@ def record_stat(counter: str, local_value: jnp.ndarray,
         def _cb(d):
             if observability.evaluate_performance():
                 observability.GLOBAL.add(counter, int(d))
+        jax.debug.callback(_cb, local_value)
+
+
+def record_float_stat(counter: str, local_value: jnp.ndarray,
+                      record: bool) -> None:
+    """:func:`record_stat` for float-valued quantization telemetry.
+
+    Used by the int8_ef push path: ``quant_error_max`` (this device's
+    largest absolute residual this step) and ``quant_residual_norm``
+    (this device's residual L2 norm). The callback fires once per
+    device shard, so the host accumulator SUMS locals across devices
+    and steps — a cumulative drift series; the per-sample distribution
+    additionally lands in the graftscope histogram registry, rendered
+    on /metrics as an ``oe_quant_*`` series next to the counters.
+    """
+    if record:
+        def _cb(d):
+            if observability.evaluate_performance():
+                v = float(d)
+                observability.GLOBAL.add(counter, v)
+                from ..analysis import scope
+                scope.HISTOGRAMS.observe(counter, v)
         jax.debug.callback(_cb, local_value)
 
 
@@ -252,7 +295,8 @@ def exchange_pull(flat_idx: jnp.ndarray,
                   split_sizes: Sequence[int],
                   capacity: int = 0,
                   slack: float = 2.0,
-                  record_stats: bool = False) -> jnp.ndarray:
+                  record_stats: bool = False,
+                  wire_dtype=None) -> jnp.ndarray:
     """Owner-routed lookup of ``flat_idx`` [n] -> rows [n, dim]. EXACT.
 
     ``flat_idx`` must be identical on all ``split_axes`` peers (they divide
@@ -271,6 +315,14 @@ def exchange_pull(flat_idx: jnp.ndarray,
     globally psum'd pending count is zero, so no key distribution can drop
     entries — parity with the reference's variable-size exchange
     (EmbeddingPullOperator.cpp:60-112).
+
+    ``wire_dtype`` (``parallel/precision.py``): rows cross the response
+    all-to-all AND the row-assembly all-gather in this dtype (bf16 =
+    half the exchange bytes) and are upcast to the resolver's dtype
+    after the last collective. Exactness caveat: each pulled row then
+    carries ONE round-to-nearest cast (the residue accumulator fills
+    every entry exactly once, so rounds never compound the error).
+    ``None`` leaves the program byte-identical to the uncompressed one.
     """
     my_part = linear_shard_id(split_axes, split_sizes)
     n = flat_idx.shape[0]
@@ -285,14 +337,24 @@ def exchange_pull(flat_idx: jnp.ndarray,
                                                      fill_value=sentinel)
     cap = bucket_capacity(m, num_shards, capacity, slack)
     owners = owner_fn(uniq)
+    out_dtype = jax.eval_shape(resolve_fn, uniq).dtype
+    acc_dtype = out_dtype if wire_dtype is None else jnp.dtype(wire_dtype)
 
     def one_round(pending, acc):
         dest, ok = bucketize(pending, num_shards, cap)
         send = fill_buckets(uniq, dest, num_shards, cap, sentinel)
         req = grid_all_to_all(send, grid_axes, grid_sizes)
         rows = resolve_fn(req.reshape((-1, kw)) if wide else req.ravel())
+        if wire_dtype is not None:
+            # the ONE lossy point of a compressed pull: owner-resolved
+            # rows narrow to the wire dtype before the response leg,
+            # bit-pinned to uint16 so the compiled collective really
+            # carries 2-byte buffers (see pin_wire)
+            rows = pin_wire(rows.astype(acc_dtype))
         resp = grid_all_to_all(rows.reshape((num_shards, cap, dim)),
                                grid_axes, grid_sizes)
+        if wire_dtype is not None:
+            resp = unpin_wire(resp, acc_dtype)
         flat_resp = resp.reshape((num_shards * cap, dim))
         got = jnp.take(flat_resp, jnp.where(ok, dest, 0), axis=0)
         acc = acc + jnp.where(ok[:, None], got, jnp.zeros_like(got))
@@ -302,8 +364,7 @@ def exchange_pull(flat_idx: jnp.ndarray,
         return pending, acc, left
 
     pending0 = owners.astype(jnp.int32)
-    acc0 = jnp.zeros((m, dim),
-                     dtype=jax.eval_shape(resolve_fn, uniq).dtype)
+    acc0 = jnp.zeros((m, dim), dtype=acc_dtype)
     pending, uniq_rows, left = one_round(pending0, acc0)
     # record the per-device residue: the callback fires on every device
     # shard, so the host accumulator sums locals into the global total
@@ -317,6 +378,12 @@ def exchange_pull(flat_idx: jnp.ndarray,
             lambda c: one_round(c[0], c[1]),
             (pending, uniq_rows, left))
     slice_rows = jnp.take(uniq_rows, inverse, axis=0)
+    if wire_dtype is not None:
+        # the row-assembly gather ships the pinned 16-bit wire form too;
+        # the upcast after it is exact (bf16 -> f32 loses nothing)
+        out = lax.all_gather(pin_wire(slice_rows), tuple(split_axes),
+                             tiled=True)
+        return unpin_wire(out[:n], acc_dtype).astype(out_dtype)
     out = lax.all_gather(slice_rows, tuple(split_axes), tiled=True)
     return out[:n]
 
@@ -335,7 +402,9 @@ def exchange_push(flat_idx: jnp.ndarray,
                   split_sizes: Sequence[int],
                   capacity: int = 0,
                   slack: float = 2.0,
-                  record_stats: bool = False):
+                  record_stats: bool = False,
+                  wire_dtype=None,
+                  ef_state=None):
     """Owner-routed push: pre-reduce, route (key, grad sum, count) to owners.
     EXACT for any key distribution.
 
@@ -370,6 +439,22 @@ def exchange_push(flat_idx: jnp.ndarray,
     sizing is branch-independent. Keys and counts share one integer
     exchange buffer ([.., 2] channels) so a routed push costs two
     collectives per mesh axis, not three.
+
+    Compressed wires (``parallel/precision.py``):
+
+    * ``wire_dtype`` (bf16): the pre-reduced gradient rows cross the
+      exchange (or the overflow all_gather) narrowed, upcast before the
+      owner's f32 optimizer math — keys/counts stay int32.
+    * ``ef_state = (prev_keys, prev_resid)``: int8 error-feedback push.
+      Each sender adds the residual it stored for keys it also
+      pre-reduced LAST step, quantizes the total per row (max-abs/127
+      scale, int8 payload; the f32 scale rides the integer key/count
+      buffer bitcast into one extra channel), and keeps the new
+      quantization error for next step. Returns ``(result, (keys,
+      resid))`` instead of ``result`` — both computed before the
+      overflow branch, so feedback is branch-independent. Padding rows'
+      scales are garbage on the routed wire (single-fill buffer);
+      owners zero them by key validity so no NaN can reach an applier.
     """
     dim = grads.shape[-1]
     my_part = linear_shard_id(split_axes, split_sizes)
@@ -389,35 +474,144 @@ def exchange_push(flat_idx: jnp.ndarray,
     dest, ok = bucketize(owners, num_shards, cap)
     kw = flat_idx.shape[1] if wide else 1  # key words per exchange entry
 
+    quant = ef_state is not None
+    new_ef = q8 = scale = None
+    if quant:
+        valid = (uniq[:, -1] != sentinel) if wide else (uniq != sentinel)
+        summed, q8, scale, new_ef = _quantize_ef(
+            uniq, summed, valid, ef_state, record_stats)
+
+    def _key_valid(k):
+        return (k[:, -1] != sentinel) if wide else (k != sentinel)
+
     def routed(st):
         ku = uniq if wide else uniq[:, None]
-        kc = jnp.concatenate(
-            [ku, counts.astype(ku.dtype)[:, None]], axis=1)  # [m, kw+1]
+        cols = [ku, counts.astype(ku.dtype)[:, None]]
+        if quant:
+            # f32 scale bits ride the integer buffer as one extra channel
+            cols.append(lax.bitcast_convert_type(
+                scale, jnp.int32).astype(ku.dtype)[:, None])
+        kc = jnp.concatenate(cols, axis=1)       # [m, kw+1(+1)]
+        payload = q8 if quant else (
+            summed if wire_dtype is None
+            else pin_wire(summed.astype(wire_dtype)))
         send_kc = fill_buckets(kc, dest, num_shards, cap, sentinel)
-        send_g = fill_buckets(summed, dest, num_shards, cap, 0)
+        send_g = fill_buckets(payload, dest, num_shards, cap, 0)
         rkc = grid_all_to_all(send_kc, grid_axes, grid_sizes)
         rg = grid_all_to_all(send_g, grid_axes, grid_sizes)
-        flat_kc = rkc.reshape((-1, kw + 1))
+        flat_kc = rkc.reshape((-1, kc.shape[1]))
         k = flat_kc[:, :kw] if wide else flat_kc[:, 0]
         rc = flat_kc[:, kw].astype(jnp.int32)
-        return apply_fn(st, k, rg.reshape((flat_kc.shape[0], dim)), rc)
+        g = rg.reshape((flat_kc.shape[0], dim))
+        if quant:
+            # padding slots carry the single fill value in the scale
+            # channel — zero them by key validity (a garbage bitcast
+            # could be NaN, and 0 * NaN contaminates)
+            rscale = lax.bitcast_convert_type(
+                flat_kc[:, kw + 1].astype(jnp.int32), jnp.float32)
+            rscale = jnp.where(_key_valid(k), rscale, 0.0)
+            g = g.astype(summed.dtype) * rscale[:, None]
+        elif wire_dtype is not None:
+            g = unpin_wire(g, wire_dtype).astype(summed.dtype)
+        return apply_fn(st, k, g, rc)
 
     def gathered(st):
         ga = tuple(grid_axes)
         k = lax.all_gather(uniq, ga, tiled=True)  # [P*m] or [P*m, 2]
-        g = lax.all_gather(summed, ga, tiled=True)
         c = lax.all_gather(counts, ga, tiled=True)
+        if quant:
+            gq = lax.all_gather(q8, ga, tiled=True)
+            gs = lax.all_gather(scale, ga, tiled=True)
+            g = gq.astype(summed.dtype) * gs[:, None]
+        elif wire_dtype is not None:
+            narrowed = pin_wire(summed.astype(wire_dtype))
+            g = unpin_wire(lax.all_gather(narrowed, ga, tiled=True),
+                           wire_dtype).astype(summed.dtype)
+        else:
+            g = lax.all_gather(summed, ga, tiled=True)
         return apply_fn(st, k, g, c)
 
     if cap >= m:
         # buckets can hold the whole slice: bucketize cannot overflow
-        return routed(state)
+        out = routed(state)
+        return (out, new_ef) if quant else out
     local_spill = jnp.sum((owners < num_shards) & ~ok).astype(jnp.int32)
     spilled = lax.psum(local_spill, tuple(grid_axes))
     # per-device residue: the callback fires on every device shard, so the
     # host accumulator sums locals into the global total
     record_stat("a2a_extra_entries_push", local_spill, record_stats)
-    return lax.cond(spilled == 0, routed, gathered, state)
+    out = lax.cond(spilled == 0, routed, gathered, state)
+    return (out, new_ef) if quant else out
+
+
+def _match_prev_keys(uniq, pk):
+    """(candidate index into pk, exact-equality flag) per current key.
+
+    Narrow keys: sort the previous step's keys once, binary-search each
+    current key, verify exactly. Wide ``[m, 2]`` pair keys: sort by a
+    32-bit multiplicative mix of (lo, hi) and verify BOTH words exactly
+    — a mix collision between two previous keys can hide (never corrupt)
+    one residual. O(m log m) compute, O(m) memory.
+    """
+    wide = uniq.ndim == 2
+
+    def _mix(k):
+        lo = k[:, 0].astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+        hi = k[:, 1].astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+        return (lo ^ hi).astype(jnp.int32)
+
+    cur = _mix(uniq) if wide else uniq
+    prev = _mix(pk) if wide else pk
+    order = jnp.argsort(prev)
+    pos = jnp.searchsorted(prev[order], cur)
+    cand = order[jnp.clip(pos, 0, pk.shape[0] - 1)]
+    hit_rows = jnp.take(pk, cand, axis=0)
+    if wide:
+        eq = jnp.all(hit_rows == uniq, axis=-1)
+    else:
+        eq = hit_rows == uniq
+    return cand, eq
+
+
+def _quantize_ef(uniq, summed, valid, ef_state, record_stats: bool):
+    """int8 error-feedback quantization of one sender's pre-reduced rows.
+
+    ``ef_state = (prev_keys, prev_resid)``: the PREVIOUS step's unique
+    keys and quantization errors of THIS sender (positional — see
+    ``precision.EFState``). Returns ``(summed_ef, q8, scale, (keys,
+    resid))``: the residual-carried totals, their int8 payload, the
+    per-row f32 scales, and the new residual to thread forward. Both
+    wire branches dequantize ``q8 * scale``, so the stored residual is
+    exactly the error the owner will see — recirculated next step.
+    """
+    pk, pr = ef_state
+    total = summed
+    if pk.shape[0]:
+        # sort-based matching, O(m log m): a broadcast m x m0 equality
+        # would cost O(m^2) compare/memory — 1.8e8 bools at the fused
+        # deepfm stream size. Wide (pair) keys match on a 32-bit mix
+        # with exact verification; a prev-side mix collision can at
+        # worst hide one residual for one step (forfeited, not
+        # corrupted — the verify is exact)
+        cand, eq = _match_prev_keys(uniq, pk)
+        # sentinel rows may "match" sentinel padding in pk — harmless
+        # (padding residual is stored as exact zero), but gate on the
+        # current row's validity anyway so padding stays all-zero
+        hit = eq & valid
+        carry = jnp.where(hit[:, None], jnp.take(pr, cand, axis=0), 0.0)
+        total = summed + carry.astype(summed.dtype)
+    absmax = jnp.max(jnp.abs(total.astype(jnp.float32)), axis=1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
+    q8 = jnp.clip(jnp.round(total.astype(jnp.float32) / scale[:, None]),
+                  -127, 127).astype(jnp.int8)
+    deq = q8.astype(jnp.float32) * scale[:, None]
+    resid = jnp.where(valid[:, None],
+                      total.astype(jnp.float32) - deq, 0.0)
+    record_float_stat("quant_error_max", jnp.max(jnp.abs(resid)),
+                      record_stats)
+    record_float_stat("quant_residual_norm",
+                      jnp.sqrt(jnp.sum(resid * resid)), record_stats)
+    return total, q8, scale, (uniq, resid)
 
 
 @host_fn

@@ -160,12 +160,14 @@ def plan_groups(collection, names, *, read_only: bool = False
             key = ("hash", ss.plane, spec.key_dtype,
                    dim_bucket(spec.output_dim),
                    ss.num_shards, ss.data_axis, ss.model_axis,
-                   ss.a2a_capacity, ss.a2a_slack, spec.dtype)
+                   ss.a2a_capacity, ss.a2a_slack, spec.dtype,
+                   ss.exchange_precision, ss.push_precision)
         else:
             key = ("array", ss.plane, dim_bucket(spec.output_dim),
                    ss.num_shards,
                    ss.layout, ss.data_axis, ss.model_axis,
-                   ss.a2a_capacity, ss.a2a_slack, spec.dtype)
+                   ss.a2a_capacity, ss.a2a_slack, spec.dtype,
+                   ss.exchange_precision, ss.push_precision)
         buckets.setdefault(key, []).append(name)
 
     plans = []
@@ -339,7 +341,8 @@ def _array_pull_program(mesh: Mesh, plan: GroupPlan, batch_sharded: bool,
             grid_axes=grid_axes, grid_sizes=grid_sizes,
             split_axes=split_axes, split_sizes=split_sizes,
             capacity=first.a2a_capacity, slack=first.a2a_slack,
-            record_stats=record_stats)
+            record_stats=record_stats,
+            wire_dtype=first.pull_wire_dtype)
         segs = a2a.carve_segments(rows,
                                   [i.ravel().shape[0] for i in idxs])
         return tuple(
@@ -403,7 +406,8 @@ def _array_push_program(mesh: Mesh, plan: GroupPlan, batch_sharded: bool,
             num_shards=first.num_shards, grid_axes=grid_axes,
             grid_sizes=grid_sizes, split_axes=split_axes,
             split_sizes=split_sizes, capacity=first.a2a_capacity,
-            slack=first.a2a_slack, record_stats=record_stats)
+            slack=first.a2a_slack, record_stats=record_stats,
+            wire_dtype=first.push_wire_dtype)
 
     _apply.__name__ = "grouped_push"
     row = first.row_spec()
@@ -516,7 +520,8 @@ def _hash_pull_program(mesh: Mesh, plan: GroupPlan, batch_sharded: bool,
             grid_axes=grid_axes, grid_sizes=grid_sizes,
             split_axes=split_axes, split_sizes=split_sizes,
             capacity=first.a2a_capacity, slack=first.a2a_slack,
-            record_stats=record_stats)
+            record_stats=record_stats,
+            wire_dtype=first.pull_wire_dtype)
         sizes = [(i.reshape(-1, 2) if plan.wide else i.ravel()).shape[0]
                  for i in idxs]
         segs = a2a.carve_segments(rows, sizes)
@@ -598,7 +603,8 @@ def _hash_push_program(mesh: Mesh, plan: GroupPlan, batch_sharded: bool,
             num_shards=first.num_shards, grid_axes=grid_axes,
             grid_sizes=grid_sizes, split_axes=split_axes,
             split_sizes=split_sizes, capacity=first.a2a_capacity,
-            slack=first.a2a_slack, record_stats=record_stats)
+            slack=first.a2a_slack, record_stats=record_stats,
+            wire_dtype=first.push_wire_dtype)
         # per-shard failure deltas -> replicated global totals
         return tuple((k, w, s, lax.psum(f, first.shard_axes))
                      for k, w, s, f in res)
@@ -661,7 +667,7 @@ def pull_grouped(collection, states, idx_map: Dict[str, jnp.ndarray], *,
                     + [states[n].weights for n in names]
                     + [states[n].init_rng for n in names] + idxs)
         res = observability.plane_timed(
-            "pull", plan.members[0].spec.plane, record, fn, *args)
+            "pull", plan.members[0].spec.plane_label, record, fn, *args)
         if host_record:
             _record_group(plan, idxs,
                           states[names[0]].weights.dtype.itemsize)
@@ -688,7 +694,7 @@ def apply_gradients_grouped(collection, states,
         if plan.kind == "array":
             fn = _array_push_program(mesh, plan, batch_sharded, record)
             res = observability.plane_timed(
-                "push", plan.members[0].spec.plane, record, fn,
+                "push", plan.members[0].spec.plane_label, record, fn,
                 *([states[n].weights for n in names]
                   + [states[n].slots for n in names] + idxs + grads))
             for n, (w, s) in zip(names, res):
@@ -696,7 +702,7 @@ def apply_gradients_grouped(collection, states,
         else:
             fn = _hash_push_program(mesh, plan, batch_sharded, record)
             res = observability.plane_timed(
-                "push", plan.members[0].spec.plane, record, fn,
+                "push", plan.members[0].spec.plane_label, record, fn,
                 *([states[n].keys for n in names]
                   + [states[n].weights for n in names]
                   + [states[n].slots for n in names]

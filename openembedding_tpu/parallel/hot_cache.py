@@ -113,8 +113,12 @@ class CachedState:
 
 
 def unwrap(state: Any) -> Any:
-    """The authoritative table of a possibly-cached state (checkpoint and
-    serving paths read through the cache — it is derived state)."""
+    """The authoritative table of a possibly-wrapped state (checkpoint
+    and serving paths read through the wrapper — the hot-row replica and
+    the int8_ef push residual are both derived state)."""
+    from . import precision
+    if isinstance(state, precision.EFState):
+        return state.table
     return state.table if isinstance(state, CachedState) else state
 
 

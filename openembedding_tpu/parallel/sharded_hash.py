@@ -22,6 +22,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -33,6 +34,7 @@ from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import hash_table as hash_lib
 from . import alltoall as a2a
 from . import hot_cache
+from . import precision
 from . import sharded_table as st
 from .mesh import DATA_AXIS, MODEL_AXIS
 
@@ -51,10 +53,32 @@ class HashShardingSpec:
     a2a_slack: float = 2.0
     key_width: int = 32  # 64 = [n, 2] int32 (lo, hi) pairs, x64-off
     cache_k: int = 0     # hot-row replica slots ("a2a+cache" plane)
+    # compressed-exchange rungs (parallel/precision.py)
+    exchange_precision: str = "f32"   # "f32" | "bf16"
+    push_precision: str = "f32"       # "f32" | "bf16" | "int8_ef"
 
     @property
     def is_cached(self) -> bool:
         return self.plane == "a2a+cache"
+
+    @property
+    def plane_label(self) -> str:
+        """Observable plane token incl. the precision suffix."""
+        return precision.plane_label(self.plane, self.exchange_precision,
+                                     self.push_precision)
+
+    @property
+    def pull_wire_dtype(self):
+        return precision.wire_dtype(self.exchange_precision)
+
+    @property
+    def push_wire_dtype(self):
+        return precision.wire_dtype(self.push_precision) \
+            if self.push_precision == "bf16" else None
+
+    @property
+    def is_int8_ef(self) -> bool:
+        return self.push_precision == "int8_ef"
 
     @property
     def is_grouped(self) -> bool:
@@ -103,12 +127,19 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
                             a2a_capacity: int = 0,
                             a2a_slack: float = 2.0,
                             key_width: int = 32,
-                            cache_k: int = 0) -> HashShardingSpec:
+                            cache_k: int = 0,
+                            exchange_precision: str = "f32",
+                            push_precision: str = "f32"
+                            ) -> HashShardingSpec:
     """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum").
 
     ``plane="a2a+cache"``: a2a layout plus a ``cache_k``-row hot-row replica
     on every device (``parallel/hot_cache.py``); 0 picks the default size.
+    A ``+bf16``/``+int8`` plane suffix selects the compressed-exchange
+    rungs (``parallel/precision.py``).
     """
+    plane, exchange_precision, push_precision = st._resolve_precision(
+        plane, exchange_precision, push_precision)
     if plane not in st.PLANES:
         raise ValueError(f"unknown plane {plane!r}")
     if key_width not in (32, 64):
@@ -128,7 +159,9 @@ def make_hash_sharding_spec(mesh: Mesh, total_capacity: int,
     return HashShardingSpec(num_shards=num_shards, capacity_per_shard=cap,
                             max_probes=max_probes, plane=plane,
                             a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
-                            key_width=key_width, cache_k=cache_k)
+                            key_width=key_width, cache_k=cache_k,
+                            exchange_precision=exchange_precision,
+                            push_precision=push_precision)
 
 
 def table_state_specs(optimizer: SparseOptimizer, dim: int,
@@ -357,7 +390,8 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
                 num_shards=spec.num_shards, grid_axes=grid_axes,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
-                slack=spec.a2a_slack, record_stats=record_stats)
+                slack=spec.a2a_slack, record_stats=record_stats,
+                wire_dtype=spec.pull_wire_dtype)
 
         if spec.is_cached:
             def _pull(keys, weights, init_rng, ckeys, crows, idx):
@@ -408,7 +442,7 @@ def _pull_program(mesh: Mesh, spec: HashShardingSpec, initializer: Any,
         in_specs = (row, row, P(), batch_spec)
     # plane-identifiable HLO module name for the contract audits
     # (analysis/contracts.py): failures name the plane that regressed
-    _pull.__name__ = f"hash_pull_{spec.plane.replace('+', '_')}"
+    _pull.__name__ = f"hash_pull_{spec.plane_label.replace('+', '_')}"
     fn = shard_map(_pull, mesh=mesh,
                    in_specs=in_specs,
                    out_specs=batch_spec,
@@ -442,12 +476,14 @@ def pull_sharded(state,
         fn = _pull_program(mesh, spec, initializer, dim, batch_sharded,
                            record)
         return observability.plane_timed(
-            "pull", spec.plane, record, fn, table.keys, table.weights,
-            table.init_rng, state.cache.keys, state.cache.rows, indices)
+            "pull", spec.plane_label, record, fn, table.keys,
+            table.weights, table.init_rng, state.cache.keys,
+            state.cache.rows, indices)
+    state = precision.unwrap(state)
     dim = state.weights.shape[-1]
     fn = _pull_program(mesh, spec, initializer, dim, batch_sharded, record)
     return observability.plane_timed(
-        "pull", spec.plane, record, fn, state.keys, state.weights,
+        "pull", spec.plane_label, record, fn, state.keys, state.weights,
         state.init_rng, indices)
 
 
@@ -463,7 +499,7 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
-        def _push_core(keys, weights, slots, init_rng, flat, g2):
+        def _push_core(keys, weights, slots, init_rng, flat, g2, ef=None):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
             sentinel = hash_lib.empty_key(
                 flat.dtype if not spec.wide else jnp.int32)
@@ -495,7 +531,8 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                 grid_axes=grid_axes, grid_sizes=grid_sizes,
                 split_axes=split_axes, split_sizes=split_sizes,
                 capacity=spec.a2a_capacity, slack=spec.a2a_slack,
-                record_stats=record_stats)
+                record_stats=record_stats,
+                wire_dtype=spec.push_wire_dtype, ef_state=ef)
 
         if spec.is_cached:
             def _apply(keys, weights, slots, init_rng, ckeys, crows,
@@ -540,6 +577,16 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                     mode="drop") for name in tslots}
                 return (tkeys, tweights, tslots, cache.rows, cache.slots,
                         lax.psum(fails, spec.shard_axes))
+        elif spec.is_int8_ef:
+            def _apply(keys, weights, slots, init_rng, ef_keys, ef_resid,
+                       idx, g):
+                flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
+                res, (nek, ner) = _push_core(
+                    keys, weights, slots, init_rng, flat,
+                    g.reshape(-1, dim), ef=(ef_keys, ef_resid))
+                tkeys, tweights, tslots, fails = res
+                return (tkeys, tweights, tslots,
+                        lax.psum(fails, spec.shard_axes), nek, ner)
         else:
             def _apply(keys, weights, slots, init_rng, idx, g):
                 flat = idx.reshape(-1, 2) if spec.wide else idx.ravel()
@@ -569,7 +616,7 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
 
     row = spec.row_spec()
     slot_specs = {name: row for name in slot_names}
-    _apply.__name__ = f"hash_push_{spec.plane.replace('+', '_')}"
+    _apply.__name__ = f"hash_push_{spec.plane_label.replace('+', '_')}"
     if spec.is_cached:
         cache_slot_specs = {name: P() for name in slot_names}
         fn = shard_map(_apply, mesh=mesh,
@@ -577,6 +624,14 @@ def _apply_program(mesh: Mesh, spec: HashShardingSpec,
                                  cache_slot_specs, batch_spec, batch_spec),
                        out_specs=(row, row, slot_specs, P(),
                                   cache_slot_specs, P()),
+                       check_vma=False)
+    elif spec.is_int8_ef and spec.num_shards > 1:
+        ef_spec = P(spec.shard_axes)
+        fn = shard_map(_apply, mesh=mesh,
+                       in_specs=(row, row, slot_specs, P(), ef_spec,
+                                 ef_spec, batch_spec, batch_spec),
+                       out_specs=(row, row, slot_specs, P(), ef_spec,
+                                  ef_spec),
                        check_vma=False)
     else:
         fn = shard_map(_apply, mesh=mesh,
@@ -613,7 +668,7 @@ def apply_gradients_sharded(state,
                             tuple(table.slots), record)
         keys, weights, slots, crows, cslots, failed = \
             observability.plane_timed(
-                "push", spec.plane, record, fn,
+                "push", spec.plane_label, record, fn,
                 table.keys, table.weights, table.slots, table.init_rng,
                 state.cache.keys, state.cache.rows, state.cache.slots,
                 indices, grads)
@@ -625,12 +680,39 @@ def apply_gradients_sharded(state,
             table=new_table,
             cache=hot_cache.HotCacheState(keys=state.cache.keys,
                                           rows=crows, slots=cslots))
+    if spec.is_int8_ef and spec.num_shards > 1:
+        bare = precision.unwrap(state)
+        dim = bare.weights.shape[-1]
+        sentinel, key_dtype = precision.ef_key_space(
+            use_hash=True, wide=spec.wide, key_dtype=bare.keys.dtype)
+        n_flat = int(np.prod(indices.shape))
+        if spec.wide:
+            n_flat //= 2
+        table, ef_keys, ef_resid = precision.ensure_ef(
+            state, dim=dim, wide=spec.wide, sentinel=sentinel,
+            n_flat=n_flat, data=mesh.shape[spec.data_axis],
+            model=mesh.shape[spec.model_axis],
+            batch_sharded=batch_sharded, key_dtype=key_dtype)
+        fn = _apply_program(mesh, spec, optimizer, initializer, dim,
+                            batch_sharded, dedup_capacity,
+                            tuple(table.slots), record)
+        keys, weights, slots, failed, nek, ner = \
+            observability.plane_timed(
+                "push", spec.plane_label, record, fn,
+                table.keys, table.weights, table.slots, table.init_rng,
+                ef_keys, ef_resid, indices, grads)
+        new_table = hash_lib.HashTableState(
+            keys=keys, weights=weights, slots=slots,
+            init_rng=table.init_rng,
+            insert_failures=table.insert_failures + failed)
+        return precision.EFState(table=new_table, keys=nek, resid=ner)
+    state = precision.unwrap(state)
     dim = state.weights.shape[-1]
     fn = _apply_program(mesh, spec, optimizer, initializer, dim,
                         batch_sharded, dedup_capacity, tuple(state.slots),
                         record)
     keys, weights, slots, failed = observability.plane_timed(
-        "push", spec.plane, record, fn,
+        "push", spec.plane_label, record, fn,
         state.keys, state.weights, state.slots, state.init_rng,
         indices, grads)
     return hash_lib.HashTableState(

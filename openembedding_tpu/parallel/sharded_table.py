@@ -78,6 +78,7 @@ from ..optim.optimizers import SparseOptimizer, make_optimizer
 from .. import table as table_lib
 from . import alltoall as a2a
 from . import hot_cache
+from . import precision
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -102,10 +103,37 @@ class ShardingSpec:
     a2a_capacity: int = 0    # per-destination bucket rows; 0 = auto
     a2a_slack: float = 2.0   # auto capacity = slack * mean bucket size
     cache_k: int = 0         # hot-row replica slots ("a2a+cache" plane)
+    # compressed-exchange rungs (parallel/precision.py): pulled rows /
+    # pushed pre-reduced grads on the wire; master weights + optimizer
+    # slots stay at the table's storage dtype in the shard
+    exchange_precision: str = "f32"   # "f32" | "bf16"
+    push_precision: str = "f32"       # "f32" | "bf16" | "int8_ef"
 
     @property
     def is_cached(self) -> bool:
         return self.plane == "a2a+cache"
+
+    @property
+    def plane_label(self) -> str:
+        """Observable plane token incl. the precision suffix — keys the
+        HLO module names, plane_timed spans, contract registry and the
+        graftscope byte ledger (``precision.plane_label``)."""
+        return precision.plane_label(self.plane, self.exchange_precision,
+                                     self.push_precision)
+
+    @property
+    def pull_wire_dtype(self):
+        return precision.wire_dtype(self.exchange_precision)
+
+    @property
+    def push_wire_dtype(self):
+        # int8_ef carries its own int8 payload inside exchange_push
+        return precision.wire_dtype(self.push_precision) \
+            if self.push_precision == "bf16" else None
+
+    @property
+    def is_int8_ef(self) -> bool:
+        return self.push_precision == "int8_ef"
 
     @property
     def is_grouped(self) -> bool:
@@ -149,7 +177,9 @@ def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
                        plane: str = "a2a",
                        a2a_capacity: int = 0,
                        a2a_slack: float = 2.0,
-                       cache_k: int = 0) -> ShardingSpec:
+                       cache_k: int = 0,
+                       exchange_precision: str = "f32",
+                       push_precision: str = "f32") -> ShardingSpec:
     """num_shards=-1 => one shard per device ("a2a") / per model slice ("psum").
 
     The reference's shard-per-server default (WorkerContext.cpp:66-85): on
@@ -159,9 +189,15 @@ def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
     ``plane="a2a+cache"`` is the a2a layout plus a ``cache_k``-row hot-row
     replica on every device (``parallel/hot_cache.py``); 0 picks the
     default size.
+
+    A ``+bf16``/``+int8`` plane suffix (``parallel/precision.py``) is
+    shorthand for the compressed-exchange rungs: it is parsed off the
+    base plane into ``exchange_precision``/``push_precision``.
     """
     if layout not in ("mod", "div"):
         raise ValueError(f"unknown layout {layout!r}")
+    plane, exchange_precision, push_precision = _resolve_precision(
+        plane, exchange_precision, push_precision)
     if plane not in PLANES:
         raise ValueError(f"unknown plane {plane!r}")
     want = mesh.shape[MODEL_AXIS] if plane == "psum" else mesh.size
@@ -180,7 +216,29 @@ def make_sharding_spec(meta: EmbeddingVariableMeta, mesh: Mesh,
     return ShardingSpec(num_shards=num_shards, rows_per_shard=rows_per_shard,
                         layout=layout, plane=plane,
                         a2a_capacity=a2a_capacity, a2a_slack=a2a_slack,
-                        cache_k=cache_k)
+                        cache_k=cache_k,
+                        exchange_precision=exchange_precision,
+                        push_precision=push_precision)
+
+
+def _resolve_precision(plane: str, exchange_precision: str,
+                       push_precision: str):
+    """Fold a ``+bf16``/``+int8`` plane suffix into the precision fields
+    and validate the combination (shared by array and hash spec
+    builders)."""
+    base, sep, spp = precision.parse_plane(plane)
+    if (sep, spp) != ("f32", "f32"):
+        for given, suffixed, knob in (
+                (exchange_precision, sep, "exchange_precision"),
+                (push_precision, spp, "push_precision")):
+            if given not in ("f32", suffixed):
+                raise ValueError(
+                    f"plane {plane!r} implies {knob}={suffixed!r} but "
+                    f"{given!r} was passed explicitly")
+        exchange_precision, push_precision = sep, spp
+    precision.check_spec_precision(base, exchange_precision,
+                                   push_precision)
+    return base, exchange_precision, push_precision
 
 
 def create_sharded_table(meta: EmbeddingVariableMeta,
@@ -357,7 +415,8 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
                 num_shards=spec.num_shards, grid_axes=grid_axes,
                 grid_sizes=grid_sizes, split_axes=split_axes,
                 split_sizes=split_sizes, capacity=spec.a2a_capacity,
-                slack=spec.a2a_slack, record_stats=record_stats)
+                slack=spec.a2a_slack, record_stats=record_stats,
+                wire_dtype=spec.pull_wire_dtype)
             return rows.reshape(idx.shape + (dim,))
 
         if spec.is_cached:
@@ -398,8 +457,9 @@ def _pull_program(mesh: Mesh, spec: ShardingSpec, dim: int,
         in_specs = (spec.row_spec(), batch_spec)
     # plane-identifiable HLO module name (jit names the module after the
     # callable): a contract-audit failure then says WHICH plane's
-    # program regressed (analysis/contracts.py)
-    _pull.__name__ = f"pull_{spec.plane.replace('+', '_')}"
+    # program regressed (analysis/contracts.py); compressed planes carry
+    # their precision suffix (pull_a2a_bf16, ...)
+    _pull.__name__ = f"pull_{spec.plane_label.replace('+', '_')}"
     fn = shard_map(_pull, mesh=mesh,
                    in_specs=in_specs,
                    out_specs=batch_spec,
@@ -428,11 +488,14 @@ def pull_sharded(state,
         dim = state.table.weights.shape[-1]
         fn = _pull_program(mesh, spec, dim, batch_sharded, record)
         return observability.plane_timed(
-            "pull", spec.plane, record, fn, state.table.weights,
+            "pull", spec.plane_label, record, fn, state.table.weights,
             state.cache.keys, state.cache.rows, indices)
+    # int8_ef states wrap the table with the push residual; pulls read
+    # through the wrapper (serving restores may hand a bare table)
+    state = precision.unwrap(state)
     dim = state.weights.shape[-1]
     fn = _pull_program(mesh, spec, dim, batch_sharded, record)
-    return observability.plane_timed("pull", spec.plane, record, fn,
+    return observability.plane_timed("pull", spec.plane_label, record, fn,
                                      state.weights, indices)
 
 
@@ -448,7 +511,7 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
         grid_axes, grid_sizes, split_axes, split_sizes = a2a.grid_info(
             mesh, spec.shard_axes, spec.model_axis, batch_sharded)
 
-        def _push_core(weights, slots, flat, g2):
+        def _push_core(weights, slots, flat, g2, ef=None):
             me = a2a.linear_shard_id(grid_axes, grid_sizes)
 
             def owner(keys):
@@ -475,7 +538,8 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                 grid_axes=grid_axes, grid_sizes=grid_sizes,
                 split_axes=split_axes, split_sizes=split_sizes,
                 capacity=spec.a2a_capacity, slack=spec.a2a_slack,
-                record_stats=record_stats)
+                record_stats=record_stats,
+                wire_dtype=spec.push_wire_dtype, ef_state=ef)
 
         if spec.is_cached:
             def _apply(weights, slots, ckeys, crows, cslots, idx, g):
@@ -513,6 +577,12 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                     cache.slots[name].astype(slots[name].dtype),
                     mode="drop") for name in slots}
                 return weights, slots, cache.rows, cache.slots
+        elif spec.is_int8_ef:
+            def _apply(weights, slots, ef_keys, ef_resid, idx, g):
+                (weights, slots), (nek, ner) = _push_core(
+                    weights, slots, idx.ravel(), g.reshape(-1, dim),
+                    ef=(ef_keys, ef_resid))
+                return weights, slots, nek, ner
         else:
             def _apply(weights, slots, idx, g):
                 return _push_core(weights, slots, idx.ravel(),
@@ -536,7 +606,7 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
             return new_state.weights, new_state.slots
 
     slot_specs = {name: spec.row_spec() for name in slot_names}
-    _apply.__name__ = f"push_{spec.plane.replace('+', '_')}"
+    _apply.__name__ = f"push_{spec.plane_label.replace('+', '_')}"
     if spec.is_cached:
         cache_slot_specs = {name: P() for name in slot_names}
         fn = shard_map(_apply, mesh=mesh,
@@ -544,6 +614,16 @@ def _apply_program(mesh: Mesh, spec: ShardingSpec,
                                  cache_slot_specs, batch_spec, batch_spec),
                        out_specs=(spec.row_spec(), slot_specs, P(),
                                   cache_slot_specs),
+                       check_vma=False)
+    elif spec.is_int8_ef and spec.num_shards > 1:
+        # the EF residual buffers shard over the exchange grid: each
+        # device owns exactly its sender slice's block
+        ef_spec = P(spec.shard_axes)
+        fn = shard_map(_apply, mesh=mesh,
+                       in_specs=(spec.row_spec(), slot_specs, ef_spec,
+                                 ef_spec, batch_spec, batch_spec),
+                       out_specs=(spec.row_spec(), slot_specs, ef_spec,
+                                  ef_spec),
                        check_vma=False)
     else:
         fn = shard_map(_apply, mesh=mesh,
@@ -581,17 +661,35 @@ def apply_gradients_sharded(state,
         fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
                             dedup_capacity, tuple(table.slots), record)
         weights, slots, crows, cslots = observability.plane_timed(
-            "push", spec.plane, record, fn,
+            "push", spec.plane_label, record, fn,
             table.weights, table.slots, state.cache.keys, state.cache.rows,
             state.cache.slots, indices, grads)
         return hot_cache.CachedState(
             table=table_lib.TableState(weights=weights, slots=slots),
             cache=hot_cache.HotCacheState(keys=state.cache.keys,
                                           rows=crows, slots=cslots))
+    if spec.is_int8_ef and spec.num_shards > 1:
+        dim = precision.unwrap(state).weights.shape[-1]
+        sentinel, key_dtype = precision.ef_key_space(use_hash=False)
+        table, ef_keys, ef_resid = precision.ensure_ef(
+            state, dim=dim, wide=False, sentinel=sentinel,
+            n_flat=int(np.prod(indices.shape)),
+            data=mesh.shape[spec.data_axis],
+            model=mesh.shape[spec.model_axis],
+            batch_sharded=batch_sharded, key_dtype=key_dtype)
+        fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
+                            dedup_capacity, tuple(table.slots), record)
+        weights, slots, nek, ner = observability.plane_timed(
+            "push", spec.plane_label, record, fn,
+            table.weights, table.slots, ef_keys, ef_resid, indices, grads)
+        return precision.EFState(
+            table=table_lib.TableState(weights=weights, slots=slots),
+            keys=nek, resid=ner)
+    state = precision.unwrap(state)
     dim = state.weights.shape[-1]
     fn = _apply_program(mesh, spec, optimizer, dim, batch_sharded,
                         dedup_capacity, tuple(state.slots), record)
     weights, slots = observability.plane_timed(
-        "push", spec.plane, record, fn,
+        "push", spec.plane_label, record, fn,
         state.weights, state.slots, indices, grads)
     return table_lib.TableState(weights=weights, slots=slots)

@@ -46,7 +46,13 @@ class TrainState:
     step: jnp.ndarray            # int32 global step (the reference batch_id)
     params: Any                  # flax dense params, replicated
     opt_state: Any               # optax state for the dense params
-    emb: Dict[str, Any]          # embedding states (sharded over model axis)
+    emb: Dict[str, Any]          # embedding states (sharded over model
+                                 # axis). push_precision="int8_ef"
+                                 # variables carry their quantization
+                                 # residual here as precision.EFState —
+                                 # the error-feedback state rides the
+                                 # TrainState and is donated with it
+                                 # (derived: never checkpointed)
     # pipelined-plane prefetched row buffer (parallel/pipelined.py);
     # None outside the pipelined schedule. Derived state: checkpoints
     # never carry it, a restore re-primes from the tables

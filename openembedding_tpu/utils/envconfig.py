@@ -75,6 +75,44 @@ _check(A2AConfig, "slack", lambda v: v > 0, "must be > 0")
 
 
 @dataclasses.dataclass(frozen=True)
+class ExchangeConfig:
+    """Compressed-exchange precision ladder (parallel/precision.py) —
+    the TPU-native analogue of the reference's RPC codec knob
+    (server.message_compress, EnvConfig.cpp:27-34): precision on the
+    wire instead of byte codecs. Applies to every spec built through
+    ``spec_kwargs()``; per-variable EmbeddingSpec fields override."""
+
+    precision: str = "f32"        # pulled rows on the wire: f32 | bf16
+    push_precision: str = "f32"   # pushed pre-reduced grads: f32 | bf16
+                                  # | int8_ef (per-row scale int8 +
+                                  # error-feedback residual)
+
+    def __post_init__(self):
+        _validate(self)
+
+    def spec_kwargs(self) -> Dict[str, Any]:
+        """kwargs for EmbeddingSpec / make_*_specs."""
+        return {"exchange_precision": self.precision,
+                "push_precision": self.push_precision}
+
+
+def _exchange_precision_ok(v) -> bool:
+    from ..parallel import precision as precision_lib
+    return v in precision_lib.EXCHANGE_PRECISIONS
+
+
+def _push_precision_ok(v) -> bool:
+    from ..parallel import precision as precision_lib
+    return v in precision_lib.PUSH_PRECISIONS
+
+
+_check(ExchangeConfig, "precision", _exchange_precision_ok,
+       "must be 'f32' or 'bf16' (pulled rows on the exchange wire)")
+_check(ExchangeConfig, "push_precision", _push_precision_ok,
+       "must be 'f32', 'bf16' or 'int8_ef' (pre-reduced gradient push)")
+
+
+@dataclasses.dataclass(frozen=True)
 class OffloadConfig:
     """Host-offload tier budgets (offload.py; reference server.cache_size
     MB=1024 + PMem pool knobs, EnvConfig.h:54-63)."""
@@ -161,8 +199,9 @@ class ReportConfig:
 
 _check(ReportConfig, "report_interval", lambda v: v >= 0, "must be >= 0")
 
-_SECTIONS = {"a2a": A2AConfig, "offload": OffloadConfig,
-             "serving": ServingConfig, "report": ReportConfig}
+_SECTIONS = {"a2a": A2AConfig, "exchange": ExchangeConfig,
+             "offload": OffloadConfig, "serving": ServingConfig,
+             "report": ReportConfig}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,6 +209,8 @@ class EnvConfig:
     """The full tree. Sections are frozen dataclasses; see module docs."""
 
     a2a: A2AConfig = dataclasses.field(default_factory=A2AConfig)
+    exchange: ExchangeConfig = dataclasses.field(
+        default_factory=ExchangeConfig)
     offload: OffloadConfig = dataclasses.field(default_factory=OffloadConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     report: ReportConfig = dataclasses.field(default_factory=ReportConfig)

@@ -81,6 +81,48 @@ def main(argv=None) -> int:
                                                    **params)
                 audit(f"{plane}/{prog} ({kind})", run)
 
+    # compressed-exchange planes (parallel/precision.py): inventory
+    # bounds at the WIRE itemsize plus the byte-halving ratio vs the
+    # f32 a2a plane's compiled program — exchange collective bytes must
+    # be <= 0.55x, measured on BOTH compiled HLOs, pull and push
+    # separately. Audited at dim 64 where the ratio binds (keys/counts
+    # stay int32, so the ratio asymptotes to 0.5 from above as dim
+    # grows; at the default dim 16 the int32 legs alone push bf16 past
+    # 0.55 — the contract pins the audit shape, see contracts.py).
+    COMPRESSED_DIM = 64
+    for use_hash in (False, True):
+        kind = "hash" if use_hash else "array"
+        baselines = {}
+        for prog, lower in (("pull", programs.lower_pull),
+                            ("push", programs.lower_push)):
+            try:
+                baselines[prog], _ = lower(mesh, "a2a", batch=args.batch,
+                                           dim=COMPRESSED_DIM,
+                                           use_hash=use_hash)
+            except Exception as e:  # noqa: BLE001 — keep auditing
+                failures += 1
+                print(f"FAIL a2a baseline {prog} ({kind}, dim "
+                      f"{COMPRESSED_DIM}): {type(e).__name__}: {e}",
+                      file=sys.stderr)
+        for plane in ("a2a+bf16", "a2a+int8"):
+            for prog, lower in (("pull", programs.lower_pull),
+                                ("push", programs.lower_push)):
+                if prog not in baselines:
+                    continue
+
+                def run(plane=plane, prog=prog, lower=lower,
+                        use_hash=use_hash):
+                    txt, params = lower(mesh, plane, batch=args.batch,
+                                        dim=COMPRESSED_DIM,
+                                        use_hash=use_hash)
+                    res = contracts.check_compressed_program(
+                        txt, baselines[prog], plane, prog, **params)
+                    return (f"exchange {res['exchange_bytes']}B = "
+                            f"{res['ratio']:.3f}x f32 "
+                            f"(<= {res['max_ratio']:.2f})")
+                audit(f"{plane}/{prog} ({kind}, byte-halving vs a2a)",
+                      run)
+
     # grouped plane: collection-level lowering over 3 heterogeneous
     # same-dim tables (one exchange group) — the contract caps the
     # all-to-all launch count at num_groups * per-exchange ops, which a
