@@ -27,6 +27,12 @@ Three enforcement layers, all mechanical (ISSUE 3):
   bytes via the jaxcompat shim) with the peak-temp-bytes contract, and
   the substrate under the ``tools/graftwatch.py`` bench-trajectory
   regression gate.
+* :mod:`.protomodel` — graftproto (ISSUE 13): explicit-state BFS model
+  checker + faithful models of the four shipped host protocols (delta
+  chain, serving hot-swap, DirtyTracker claims, HA registry), each
+  action bridged to real ``sync_point`` names so counterexample
+  schedules replay against the implementation. CLI:
+  ``python -m tools.graftproto``.
 
 Import discipline: ``contracts``, ``lint``, ``concurrency``, and
 ``scope`` are stdlib-only at import time and imported eagerly, so every
@@ -38,7 +44,7 @@ already imported it). ``retrace`` (imports jax) and ``programs``
 public surface is unchanged.
 """
 
-from . import concurrency, contracts, lint, scope
+from . import concurrency, contracts, lint, protomodel, scope
 from .concurrency import (TraceViolation, TracedLock, TracedRLock,
                           make_lock, make_rlock, sync_point,
                           trace_paths, trace_source)
@@ -68,7 +74,7 @@ def __getattr__(name):  # PEP 562: defer the jax-importing submodules
 
 __all__ = [
     "concurrency", "contracts", "lint", "retrace", "programs", "scope",
-    "memwatch",
+    "memwatch", "protomodel",
     "HISTOGRAMS", "HistogramRegistry", "Span", "export_chrome_trace",
     "span", "step_span",
     "ContractViolation", "ProgramContract", "OpBudget", "REGISTRY",

@@ -1,0 +1,1283 @@
+"""graftproto: exhaustive protocol model checking for the host protocols.
+
+The durability and HA protocols rebuilt from the reference — the delta-
+checkpoint chain with its background compactor (``checkpoint_delta.py``),
+strict-seq serving hot-swap (``serving/registry.py apply_delta``), the
+``DirtyTracker`` claim discipline (``dirty.py``), and the HA registry's
+CREATING window under replica kills (``serving/ha.py``) — are concurrent
+state machines whose bug class (torn tails, seq gaps, lost dirty marks,
+mixed-version reads) hides in interleavings no example-based test
+enumerates. This module is the fourth static-analysis leg beside
+graftcheck/graftlint/graftrace: a small EXPLICIT-STATE model checker plus
+faithful models of the four shipped protocols, explored exhaustively.
+
+Checker (stdlib-only, like :mod:`.concurrency`, so ``tools/graftproto.py``
+loads it standalone):
+
+* states are FLAT dicts of hashable values (ints, strs, tuples,
+  frozensets) — frozen to sorted item-tuples for dedup;
+* :class:`Action` = one named guarded atomic step of one process role;
+  ``apply`` receives a fresh copy and returns one successor (mutate in
+  place / return a dict) or several (return a list — nondeterministic
+  outcomes like a write that may fail);
+* :func:`check` runs BFS from the initial state with full state dedup, so
+  the FIRST violation found has a minimal-length action trace;
+* every invariant is checked at every reachable state; a state with no
+  enabled action that ``is_done`` does not accept is a DEADLOCK;
+* counterexamples pretty-print as an action trace with per-step state
+  diffs (:func:`format_result`).
+
+Model fidelity is the whole game, so the models are BRIDGED to the code
+two ways: (1) every action carries the ``sync_point`` names
+(``analysis/concurrency.py``) the real implementation emits at that
+protocol step — :func:`missing_sync_points` greps the package source and
+fails if a model references a point the code no longer has; (2)
+:func:`trace_schedule` exports any explored trace (including every seeded
+mutation's counterexample) as the ordered sync-point list a
+``SerialSchedule``/``PointGate`` replay drives against the real
+implementation (``tests/test_graftproto_replay.py``,
+``tools/graftproto.py --emit-schedules``).
+
+Scope and honesty — what is NOT modeled:
+
+* whole-process trainer crash + reload (the writer-THREAD crash mid-save
+  is; a restarted trainer re-deriving its content version from a load is
+  the multi-host/elastic design ROADMAP item 3 must model first);
+* unarmed (manifest-less) checkpoint directories — plain full dumps have
+  no chain protocol to check;
+* byte-level payload corruption beyond one torn tail per run (the
+  ``tear`` budget), and chain/seq counts past the per-model bounds
+  stated in each builder's docstring. Bounds are exhaustive WITHIN the
+  budget, which is exactly the regime the hand-written interleaving
+  tests sample one schedule of.
+
+Two true positives surfaced while writing these models (both fixed in
+the same PR, regression-tested in ``tests/test_delta_checkpoint.py``):
+a full save over an armed chain re-armed with ``last_seq=0``, REUSING
+burned seqs (serving replicas then ack the next real delta as stale and
+silently stop updating — the :func:`delta_chain` ``full_save_resets_seq``
+mutation is the pre-fix behavior), and ``applied_seq`` returned 0 after a
+compaction emptied the chain (no content-version field in the manifest),
+so freshly loaded serving models refused every subsequent delta as a gap
+(the ``compact_zero_version`` mutation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+from collections import deque
+from typing import (Any, Callable, Dict, List, Optional,
+                    Sequence, Tuple)
+
+State = Dict[str, Any]
+_CORRUPT = -99          # content marker: rows overwritten out of order
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    """One named guarded atomic step of one process role.
+
+    ``guard(state) -> bool`` reads a thawed state; ``apply(state)`` gets
+    a FRESH copy it may mutate in place (return ``None``), replace
+    (return a dict), or branch (return a list of dicts — each successor
+    is labeled ``name#i``). ``syncs`` are the ``sync_point`` names the
+    real implementation emits at this step (the model<->code bridge).
+    """
+
+    name: str
+    role: str
+    guard: Callable[[State], bool]
+    apply: Callable[[State], Any]
+    syncs: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    name: str
+    init: Tuple[Tuple[str, Any], ...]
+    actions: Tuple[Action, ...]
+    invariants: Tuple[Tuple[str, Callable[[State], bool]], ...]
+    # accepting predicate for quiescent states: a state with NO enabled
+    # action is a deadlock unless is_done(state)
+    is_done: Callable[[State], bool]
+    notes: str = ""
+
+    def action(self, name: str) -> Action:
+        for a in self.actions:
+            if a.name == name:
+                return a
+        raise KeyError(name)
+
+
+def make_model(name, init: State, actions, invariants, is_done,
+               notes: str = "") -> Model:
+    return Model(name=name, init=_freeze(init), actions=tuple(actions),
+                 invariants=tuple(invariants), is_done=is_done,
+                 notes=notes)
+
+
+@dataclasses.dataclass
+class Counterexample:
+    kind: str                      # "invariant" | "deadlock" | "error"
+    invariant: str                 # violated invariant name (or "")
+    trace: List[Tuple[str, State]]  # [("<init>", s0), (action, s1), ...]
+
+
+@dataclasses.dataclass
+class Result:
+    model: str
+    ok: bool
+    complete: bool                 # frontier exhausted under max_states
+    explored: int
+    transitions: int
+    elapsed_s: float
+    counterexample: Optional[Counterexample] = None
+
+
+def _freeze(state: State) -> Tuple[Tuple[str, Any], ...]:
+    """Flat dict of hashable values -> canonical hashable form. Raises
+    on unhashable values — models must use ints/strs/tuples/frozensets,
+    never lists/sets/dicts as values."""
+    items = tuple(sorted(state.items()))
+    hash(items)                    # fail fast on an unhashable value
+    return items
+
+
+def _violated(model: Model, state: State) -> Optional[str]:
+    for name, pred in model.invariants:
+        if not pred(state):
+            return name
+    return None
+
+
+def _trace_of(parents, frozen) -> List[Tuple[str, State]]:
+    steps = []
+    cur = frozen
+    while cur is not None:
+        parent, label = parents[cur]
+        steps.append((label or "<init>", dict(cur)))
+        cur = parent
+    steps.reverse()
+    return steps
+
+
+def _successors(model: Model, state: State):
+    """Expand one thawed state: ``(enabled, [(label, successor), ...])``.
+
+    The single home of the Action.apply return contract (None = mutated
+    in place, dict = replacement, list = nondeterministic branches
+    labeled ``name#i``) — check() and sample_traces() both walk through
+    here so exported schedules can never diverge from what was checked.
+    """
+    enabled = False
+    out = []
+    for action in model.actions:
+        if not action.guard(state):
+            continue
+        enabled = True
+        succ = dict(state)
+        ret = action.apply(succ)
+        if ret is None:
+            branches = [succ]
+        elif isinstance(ret, dict):
+            branches = [ret]
+        else:
+            branches = list(ret)
+        for i, b in enumerate(branches):
+            label = action.name if len(branches) == 1 \
+                else f"{action.name}#{i}"
+            out.append((label, b))
+    return enabled, out
+
+
+def check(model: Model, max_states: int = 500_000) -> Result:
+    """Exhaustive BFS over the model's reachable states.
+
+    Returns the first (minimal-trace) invariant violation or deadlock;
+    ``complete=False`` means the ``max_states`` budget cut exploration
+    short (the CLI treats that as a failure for shipped models — an
+    unexplored protocol is an unchecked one)."""
+    t0 = time.perf_counter()
+    f0 = model.init
+    parents: Dict[Any, Tuple[Any, Optional[str]]] = {f0: (None, None)}
+    bad = _violated(model, dict(f0))
+    if bad is not None:
+        return Result(model.name, False, True, 1, 0,
+                      time.perf_counter() - t0,
+                      Counterexample("invariant", bad, _trace_of(parents, f0)))
+    queue = deque([f0])
+    explored = 0
+    transitions = 0
+    while queue:
+        fs = queue.popleft()
+        explored += 1
+        state = dict(fs)
+        enabled, succs = _successors(model, state)
+        for label, succ in succs:
+            fsucc = _freeze(succ)
+            transitions += 1
+            if fsucc in parents:
+                continue
+            parents[fsucc] = (fs, label)
+            bad = _violated(model, succ)
+            if bad is not None:
+                return Result(model.name, False, True,
+                              explored, transitions,
+                              time.perf_counter() - t0,
+                              Counterexample("invariant", bad,
+                                             _trace_of(parents, fsucc)))
+            if len(parents) >= max_states:
+                return Result(model.name, True, False,
+                              explored, transitions,
+                              time.perf_counter() - t0)
+            queue.append(fsucc)
+        if not enabled and not model.is_done(state):
+            return Result(model.name, False, True, explored, transitions,
+                          time.perf_counter() - t0,
+                          Counterexample("deadlock", "",
+                                         _trace_of(parents, fs)))
+    return Result(model.name, True, True, explored, transitions,
+                  time.perf_counter() - t0)
+
+
+def format_result(res: Result, model: Optional[Model] = None) -> str:
+    """Human-readable verdict; counterexamples print the minimal action
+    trace with per-step state diffs (and each action's sync points, so
+    the trace reads as a replayable schedule)."""
+    head = (f"[{res.model}] explored {res.explored} states / "
+            f"{res.transitions} transitions in {res.elapsed_s:.2f}s")
+    if res.ok and res.complete:
+        return head + " — all invariants hold, no deadlock"
+    if res.ok:
+        return head + f" — INCOMPLETE (state budget hit)"
+    cex = res.counterexample
+    what = ("DEADLOCK (no enabled action, not an accepting state)"
+            if cex.kind == "deadlock"
+            else f"INVARIANT VIOLATED: {cex.invariant}")
+    lines = [head + f" — {what}", "  counterexample "
+             f"({len(cex.trace) - 1} steps):"]
+    prev: State = {}
+    for label, state in cex.trace:
+        if label == "<init>":
+            lines.append("    <init>")
+            prev = state
+            continue
+        diff = [f"{k}: {prev.get(k)!r}->{v!r}"
+                for k, v in sorted(state.items()) if prev.get(k) != v]
+        syncs = ""
+        if model is not None:
+            base = label.split("#", 1)[0]
+            try:
+                pts = model.action(base).syncs
+            except KeyError:
+                pts = ()
+            if pts:
+                syncs = f"  [sync: {', '.join(pts)}]"
+        lines.append(f"    {label}{syncs}  {{{'; '.join(diff)}}}")
+        prev = state
+    return "\n".join(lines)
+
+
+def trace_schedule(model: Model,
+                   trace: Sequence[Tuple[str, State]]) -> List[str]:
+    """Flatten one action trace into the ordered ``sync_point`` list a
+    SerialSchedule/PointGate replay drives against the real code."""
+    out: List[str] = []
+    for label, _state in trace:
+        if label == "<init>":
+            continue
+        base = label.split("#", 1)[0]
+        try:
+            out.extend(model.action(base).syncs)
+        except KeyError:
+            pass
+    return out
+
+
+def model_sync_points(model: Model) -> List[str]:
+    out = sorted({p for a in model.actions for p in a.syncs})
+    return out
+
+
+def missing_sync_points(model: Model,
+                        package_root: Optional[str] = None) -> List[str]:
+    """Sync points a model references that the package source does not
+    emit — the fidelity tripwire: a refactor that renames or drops a
+    ``sync_point`` invalidates the model, and this makes that loud."""
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    have = set()
+    for root, _dirs, names in os.walk(package_root):
+        if "__pycache__" in root:
+            continue
+        for n in names:
+            if not n.endswith(".py"):
+                continue
+            with open(os.path.join(root, n), "r", encoding="utf-8") as fh:
+                have.update(re.findall(r'sync_point\(\s*[fr]?"([^"]+)"',
+                                       fh.read()))
+    return [p for p in model_sync_points(model) if p not in have]
+
+
+# ---------------------------------------------------------------------------
+# Model 1: serving hot-swap (registry.apply_delta vs snapshotting readers)
+# ---------------------------------------------------------------------------
+
+def hot_swap(*, seq_gate: bool = True, atomic_publish: bool = True,
+             max_seq: int = 3, readers: int = 2) -> Model:
+    """``ModelRegistry.apply_delta`` strict seq gating against concurrent
+    snapshotting lookups (``ServingModel.lookup``).
+
+    Two variables (vA, vB) stand for the per-variable rows one delta
+    patches; the published model is the triple (vA, vB, version) and
+    ``applied`` is the set of delta seqs whose rows the served states
+    contain. Deltas 1..max_seq are all in flight at once (a retrying
+    publisher can present any of them in any order, stale and gapped
+    included). Readers snapshot the published pair then read it — the
+    one-reference-grab discipline of ``ServingModel.lookup``.
+
+    Invariants: readers never observe a mixed version; ``applied_seq``
+    is monotone; a model at version v serves exactly the deltas
+    ``{1..v}`` (a dropped gate silently loses the skipped delta's rows).
+
+    Mutations: ``seq_gate=False`` removes the gap refusal (the seeded
+    ``drop_seq_gate``); ``atomic_publish=False`` patches the two
+    variables in place in two steps instead of building functionally and
+    publishing one reference under the lock.
+    """
+    init: State = {"version": 0, "vA": 0, "vB": 0,
+                   "applied": frozenset(), "pending":
+                   frozenset(range(1, max_seq + 1)),
+                   "build": 0, "monotone_ok": True,
+                   "redeliver_left": 1}
+    for i in range(readers):
+        init[f"r{i}_pc"] = "idle"
+        init[f"r{i}_snap"] = (0, 0)
+
+    actions: List[Action] = []
+
+    def redeliver(seq):
+        # a retrying publisher re-presents an ALREADY-applied delta
+        # (network retry / replica catch-up overlap) — this is what
+        # makes the stale-ack branch reachable at all
+        def guard(s):
+            return s["redeliver_left"] > 0 and seq <= s["version"] \
+                and seq not in s["pending"]
+
+        def apply(s):
+            s["redeliver_left"] -= 1
+            s["pending"] = s["pending"] | {seq}
+        return Action(f"redeliver({seq})", "publisher", guard, apply)
+
+    def ack_stale(seq):
+        def guard(s):
+            return seq in s["pending"] and seq <= s["version"] \
+                and s["build"] == 0
+
+        def apply(s):
+            s["pending"] = s["pending"] - {seq}
+        # the real stale path returns BEFORE any swap sync point: only
+        # find_model's registry.find fires (registry.py apply_delta)
+        return Action(f"ack_stale({seq})", "applier", guard, apply,
+                      syncs=("registry.find",))
+
+    def publish(s, seq):
+        if seq < s["version"]:
+            s["monotone_ok"] = False
+        s["vA"] = s["vB"] = s["version"] = seq
+        s["applied"] = s["applied"] | {seq}
+        s["pending"] = s["pending"] - {seq}
+
+    def apply_next(seq):
+        def guard(s):
+            return seq in s["pending"] and seq == s["version"] + 1 \
+                and s["build"] == 0
+
+        if atomic_publish:
+            def apply(s):
+                publish(s, seq)
+            return Action(f"apply({seq})", "applier", guard, apply,
+                          syncs=("registry.find",
+                                 "registry.swap.build",
+                                 "registry.swap.commit"))
+
+        def apply_start(s):
+            s["build"] = seq
+            s["vA"] = seq              # first variable patched IN PLACE
+        start = Action(f"apply_start({seq})", "applier", guard,
+                       apply_start, syncs=("registry.find",
+                                           "registry.swap.build"))
+
+        def fin_guard(s):
+            return s["build"] == seq
+
+        def apply_finish(s):
+            s["build"] = 0
+            publish(s, seq)
+        finish = Action(f"apply_finish({seq})", "applier", fin_guard,
+                        apply_finish, syncs=("registry.swap.commit",))
+        return [start, finish]
+
+    def apply_gapped(seq):
+        # the dropped gate: any pending newer seq applies directly
+        def guard(s):
+            return seq in s["pending"] and seq > s["version"] + 1 \
+                and s["build"] == 0
+
+        def apply(s):
+            publish(s, seq)
+        return Action(f"apply_gapped({seq})", "applier", guard, apply,
+                      syncs=("registry.find",
+                             "registry.swap.build",
+                             "registry.swap.commit"))
+
+    for seq in range(1, max_seq + 1):
+        actions.append(redeliver(seq))
+        actions.append(ack_stale(seq))
+        nxt = apply_next(seq)
+        actions.extend(nxt if isinstance(nxt, list) else [nxt])
+        if not seq_gate:
+            actions.append(apply_gapped(seq))
+
+    for i in range(readers):
+        def snap_guard(s, i=i):
+            return s[f"r{i}_pc"] == "idle"
+
+        def snap_apply(s, i=i):
+            s[f"r{i}_pc"] = "reading"
+            s[f"r{i}_snap"] = (s["vA"], s["vB"])
+        actions.append(Action(f"r{i}_snapshot", f"reader{i}", snap_guard,
+                              snap_apply,
+                              syncs=("serving.lookup.snapshot",)))
+
+        def read_guard(s, i=i):
+            return s[f"r{i}_pc"] == "reading"
+
+        def read_apply(s, i=i):
+            s[f"r{i}_pc"] = "idle"
+            s[f"r{i}_snap"] = (0, 0)
+        actions.append(Action(f"r{i}_read", f"reader{i}", read_guard,
+                              read_apply, syncs=("registry.find",)))
+
+    def inv_consistent(s):
+        return all(s[f"r{i}_snap"][0] == s[f"r{i}_snap"][1]
+                   for i in range(readers))
+
+    def inv_no_lost(s):
+        return s["applied"] == frozenset(range(1, s["version"] + 1))
+
+    def inv_monotone(s):
+        return s["monotone_ok"]
+
+    def is_done(s):
+        return not s["pending"] and s["build"] == 0 \
+            and all(s[f"r{i}_pc"] == "idle" for i in range(readers))
+
+    return make_model(
+        "hot_swap", init, actions,
+        [("reader_sees_one_version", inv_consistent),
+         ("version_covers_exactly_applied_deltas", inv_no_lost),
+         ("applied_seq_monotone", inv_monotone)],
+        is_done,
+        notes="registry.apply_delta seq gate + one-reference-swap vs "
+              "snapshotting ServingModel.lookup readers")
+
+
+# ---------------------------------------------------------------------------
+# Model 2: DirtyTracker claim discipline (dirty.py + save_delta's writer)
+# ---------------------------------------------------------------------------
+
+def dirty_tracker(*, restore_on_failure: bool = True, chunks: int = 2,
+                  marks: int = 3) -> Model:
+    """``DirtyTracker.snapshot_clear``/``restore`` claims under
+    concurrent ``mark_dirty`` and a failing writer (``save_delta``'s
+    claim/commit/restore protocol around ``ckpt.delta.commit``).
+
+    Per chunk, ``pend`` counts change epochs (a mark bumps it), ``cov``
+    the highest epoch a COMMITTED save chain covers. The saver claims
+    the dirty set atomically (``snapshot_clear``), writes (which may
+    fail), then commits or restores the claim.
+
+    Invariant (the one that matters for durability): no dirty chunk is
+    ever lost to a completed save chain — at every state, a chunk with
+    uncovered changes is either still marked dirty or claimed by the
+    in-flight writer whose claim covers those changes.
+
+    Mutation: ``restore_on_failure=False`` drops the claim restore on a
+    failed write (the seeded ``skip_claim_restore``) — the chunk's
+    changes vanish from both the bitmap and the chain.
+    """
+    init: State = {
+        "pend": (0,) * chunks, "cov": (0,) * chunks,
+        "dirty": (False,) * chunks,
+        "claim": None,            # tuple per chunk: claimed epoch | None
+        "saver": "idle",          # idle | claimed | written | failed
+        "marks_left": marks,
+    }
+
+    def _set(t, i, v):
+        return t[:i] + (v,) + t[i + 1:]
+
+    actions: List[Action] = []
+
+    def mark(c):
+        def guard(s):
+            return s["marks_left"] > 0 and s["pend"][c] < 2
+
+        def apply(s):
+            s["pend"] = _set(s["pend"], c, s["pend"][c] + 1)
+            s["dirty"] = _set(s["dirty"], c, True)
+            s["marks_left"] -= 1
+        return Action(f"mark({c})", "trainer", guard, apply,
+                      syncs=("dirty.mark",))
+
+    for c in range(chunks):
+        actions.append(mark(c))
+
+    def snap_guard(s):
+        return s["saver"] == "idle" and any(s["dirty"])
+
+    def snap_apply(s):
+        s["claim"] = tuple(s["pend"][c] if s["dirty"][c] else None
+                           for c in range(chunks))
+        s["dirty"] = (False,) * chunks
+        s["saver"] = "claimed"
+    actions.append(Action("snapshot_clear", "saver", snap_guard,
+                          snap_apply, syncs=("dirty.snapshot",)))
+
+    def write_guard(s):
+        return s["saver"] == "claimed"
+
+    def write_apply(s):
+        ok = dict(s, saver="written")
+        fail = dict(s, saver="failed")
+        return [ok, fail]
+    actions.append(Action("write", "saver", write_guard, write_apply,
+                          syncs=("ckpt.delta.write",)))
+
+    def commit_guard(s):
+        return s["saver"] == "written"
+
+    def commit_apply(s):
+        s["cov"] = tuple(max(s["cov"][c], s["claim"][c] or 0)
+                         for c in range(chunks))
+        s["claim"] = None
+        s["saver"] = "idle"
+    actions.append(Action("commit", "saver", commit_guard, commit_apply,
+                          syncs=("ckpt.delta.commit",)))
+
+    def fail_guard(s):
+        return s["saver"] == "failed"
+
+    def restore_apply(s):
+        if restore_on_failure:
+            s["dirty"] = tuple(s["dirty"][c] or s["claim"][c] is not None
+                               for c in range(chunks))
+        s["claim"] = None
+        s["saver"] = "idle"
+    actions.append(Action("restore", "saver", fail_guard, restore_apply,
+                          syncs=("dirty.restore",)))
+
+    def inv_no_lost(s):
+        for c in range(len(s["pend"])):
+            bound = s["cov"][c]
+            if s["claim"] is not None and s["claim"][c] is not None:
+                bound = max(bound, s["claim"][c])
+            if s["pend"][c] > bound and not s["dirty"][c]:
+                return False
+        return True
+
+    def is_done(s):
+        return s["saver"] == "idle" and s["claim"] is None
+
+    return make_model(
+        "dirty_tracker", init, actions,
+        [("no_dirty_chunk_lost_to_completed_chain", inv_no_lost)],
+        is_done,
+        notes="DirtyTracker snapshot_clear/restore claims vs concurrent "
+              "mark_dirty and a failing delta writer")
+
+
+# ---------------------------------------------------------------------------
+# Model 3: HA registry load / CREATING window with replica kill
+# ---------------------------------------------------------------------------
+
+def ha_registry(*, atomic_commit: bool = True, kills: int = 1,
+                serves: int = 2) -> Model:
+    """The serving registry's async-load CREATING window (``create_model``
+    -> loader thread -> one-lock commit), a failover routing client, and
+    a killer SIGKILLing replicas (``serving/ha.py``).
+
+    Two replicas serve one model sign. r0 boots with the model NORMAL
+    (the ``--load`` path); r1 restores from a living peer's catalog
+    (``restore_from_peers``: only NORMAL entries restore — a CREATING
+    peer is polled, modeled as the guard). A killed replica loses
+    everything and respawns through restore-from-peer, or from the dump
+    when no peer serves (the ``--load``/URI fallback), so the system
+    always recovers. The client rotates over replicas like
+    ``RoutingClient._rotate``.
+
+    Invariants: NORMAL status implies the model object is installed
+    (status and install commit under ONE lock hold — the reader-visible
+    pair can never be half-published); a lookup is served only from an
+    installed NORMAL model (no CREATING/partial model ever serves rows).
+
+    Mutation: ``atomic_commit=False`` publishes status=NORMAL one step
+    before installing the model object — ``find_model`` then hands a
+    lookup a missing/partial model inside the window.
+    """
+    R = ("r0", "r1")
+    init: State = {"kill_left": kills, "serves_left": serves,
+                   "cl": "idle", "cl_tried": frozenset(),
+                   "served_uninstalled": False}
+    init.update({"r0_alive": True, "r0_status": "normal",
+                 "r0_inst": True, "r0_boot": 0,
+                 "r1_alive": True, "r1_status": "absent",
+                 "r1_inst": False, "r1_boot": 1})
+
+    actions: List[Action] = []
+
+    def peer_of(r):
+        return "r1" if r == "r0" else "r0"
+
+    def restore_start(r):
+        # restore_from_peers: a living peer serves NORMAL -> re-create
+        def guard(s):
+            p = peer_of(r)
+            return s[f"{r}_alive"] and s[f"{r}_status"] == "absent" \
+                and s[f"{p}_alive"] and s[f"{p}_status"] == "normal"
+
+        def apply(s):
+            s[f"{r}_status"] = "creating"
+        return Action(f"{r}_restore_start", r, guard, apply,
+                      syncs=("ha.restore.model", "registry.load.start"))
+
+    def boot_load(r):
+        # the dump-URI path: available even with no living peer
+        def guard(s):
+            p = peer_of(r)
+            no_peer = not (s[f"{p}_alive"]
+                           and s[f"{p}_status"] == "normal")
+            return s[f"{r}_alive"] and s[f"{r}_status"] == "absent" \
+                and s[f"{r}_boot"] > 0 and no_peer
+
+        def apply(s):
+            s[f"{r}_boot"] -= 1
+            s[f"{r}_status"] = "creating"
+        return Action(f"{r}_boot_load", r, guard, apply,
+                      syncs=("registry.load.start",))
+
+    def load_commit(r):
+        def guard(s):
+            return s[f"{r}_alive"] and s[f"{r}_status"] == "creating"
+
+        if atomic_commit:
+            def apply(s):
+                s[f"{r}_inst"] = True
+                s[f"{r}_status"] = "normal"
+            return [Action(f"{r}_load_commit", r, guard, apply,
+                           syncs=("registry.load.commit",))]
+
+        def apply_status(s):
+            s[f"{r}_status"] = "normal"    # published BEFORE the install
+        first = Action(f"{r}_commit_status", r, guard, apply_status,
+                       syncs=("registry.load.commit",))
+
+        def inst_guard(s):
+            return s[f"{r}_alive"] and s[f"{r}_status"] == "normal" \
+                and not s[f"{r}_inst"]
+
+        def apply_inst(s):
+            s[f"{r}_inst"] = True
+        second = Action(f"{r}_install", r, inst_guard, apply_inst)
+        return [first, second]
+
+    def kill(r):
+        def guard(s):
+            # any alive replica may die; liveness is preserved not by a
+            # guard here but by respawn() plus each replica's dump-URI
+            # boot budget — a respawned replica with no NORMAL peer
+            # boot-loads, so the state space has no stranded deadlock
+            return s["kill_left"] > 0 and s[f"{r}_alive"]
+
+        def apply(s):
+            s["kill_left"] -= 1
+            s[f"{r}_alive"] = False
+            s[f"{r}_status"] = "absent"
+            s[f"{r}_inst"] = False
+        return Action(f"kill({r})", "chaos", guard, apply)
+
+    def respawn(r):
+        def guard(s):
+            return not s[f"{r}_alive"]
+
+        def apply(s):
+            s[f"{r}_alive"] = True
+        return Action(f"respawn({r})", "chaos", guard, apply,
+                      syncs=("ha.restore.catalog",))
+
+    for r in R:
+        actions.append(restore_start(r))
+        actions.append(boot_load(r))
+        actions.extend(load_commit(r))
+        actions.append(kill(r))
+        actions.append(respawn(r))
+
+    # client: rotate over untried replicas; serve from a NORMAL one
+    def try_replica(r):
+        def guard(s):
+            return s["serves_left"] > 0 and s["cl"] == "idle" \
+                and r not in s["cl_tried"]
+
+        def apply(s):
+            if s[f"{r}_alive"] and s[f"{r}_status"] == "normal":
+                # served: record AT THE SERVE INSTANT whether find_model
+                # handed out an uninstalled model (the lookup keeps its
+                # reference afterwards — a later kill cannot corrupt it,
+                # so this is a point check, not a lingering predicate)
+                s["cl"] = f"served:{r}"
+                if not s[f"{r}_inst"]:
+                    s["served_uninstalled"] = True
+            else:
+                s["cl_tried"] = s["cl_tried"] | {r}
+        return Action(f"cl_try({r})", "client", guard, apply,
+                      syncs=("routing.attempt", "registry.find"))
+
+    def served_done(r):
+        def guard(s):
+            return s["cl"] == f"served:{r}"
+
+        def apply(s):
+            s["cl"] = "idle"
+            s["cl_tried"] = frozenset()
+            s["serves_left"] -= 1
+        return Action(f"cl_done({r})", "client", guard, apply,
+                      syncs=("serving.lookup.snapshot",))
+
+    def all_failed_guard(s):
+        return s["cl"] == "idle" and s["cl_tried"] == frozenset(R)
+
+    def all_failed_apply(s):
+        # every replica bounced: the caller sees the error and retries
+        s["cl_tried"] = frozenset()
+    for r in R:
+        actions.append(try_replica(r))
+        actions.append(served_done(r))
+    actions.append(Action("cl_all_failed", "client", all_failed_guard,
+                          all_failed_apply))
+
+    def inv_normal_installed(s):
+        return all(not (s[f"{r}_alive"] and s[f"{r}_status"] == "normal")
+                   or s[f"{r}_inst"] for r in R)
+
+    def inv_served_installed(s):
+        return not s["served_uninstalled"]
+
+    def is_done(s):
+        return s["serves_left"] == 0
+
+    return make_model(
+        "ha_registry", init, actions,
+        [("normal_status_implies_model_installed", inv_normal_installed),
+         ("lookup_served_only_from_installed_model", inv_served_installed)],
+        is_done,
+        notes="create_model CREATING window + restore_from_peers + "
+              "RoutingClient rotation under replica SIGKILL")
+
+
+# ---------------------------------------------------------------------------
+# Model 4: delta-checkpoint chain (writer, manifest commit, compactor,
+# crash-at-any-step, torn tails, loads racing everything)
+# ---------------------------------------------------------------------------
+
+def delta_chain(*, commit_order: str = "payload_first",
+                carry_seq_on_full: bool = True,
+                compact_content_seq: bool = True,
+                max_seq: int = 3, fulls: int = 1, crashes: int = 1,
+                tears: int = 1, loads: int = 1) -> Model:
+    """The ``checkpoint_delta.py`` chain protocol end to end.
+
+    One variable whose base is TWO field files (weights + a slot — the
+    granularity at which the compactor folds and a crash interleaves).
+    Content versions count as "reflects committed deltas <= v";
+    applying a delta whose seq is neither idempotent (<= v) nor the
+    successor (v+1) poisons the field (``_CORRUPT`` — rows from the
+    wrong epoch overwrote newer rows), which is exactly what replaying
+    a stale chain over a half-new base does.
+
+    Protocol steps modeled 1:1 with the code: delta save = write the
+    payload file, then commit the manifest (``ckpt.delta.commit``, the
+    one atomic rename); full save = reset_chain FIRST, write the two
+    base fields, then re-arm (``ckpt.full.reset``/``ckpt.full.arm``),
+    carrying ``last_seq`` so burned seqs are never reused; the
+    background compactor (never concurrent with the saver —
+    ``join_compactor``) folds verified entries field-by-field, commits
+    a fresh manifest (new base_id, ``last_seq`` preserved,
+    ``content_seq`` = folded content), then GCs the chain; a crash
+    budget kills the writer/compactor thread between any two steps; a
+    tear budget corrupts the FINAL committed payload (the dying-disk
+    case); the loader snapshots the manifest, reads fields and chain
+    files in any interleaving, drops a bad FINAL entry, errors on a bad
+    middle, and retries once when ``base_id`` moved under it — the
+    ``load_checkpoint`` retry loop.
+
+    Invariants (checked at every reachable state):
+
+    * ``load_is_committed_consistent`` — a PUBLISHED load is never
+      mixed/corrupt and equals a content version that was actually
+      committed ("a load never observes a mid-chain tear as success";
+      "torn FINAL recovers to the last complete delta");
+    * ``no_silent_commit_loss`` — a load only ever drops a committed
+      entry whose payload a TEAR destroyed, never one whose payload
+      simply was not written yet;
+    * ``seqs_never_reused`` — burned seqs never reappear;
+    * ``load_version_matches_content`` — the version a load reports
+      (``applied_seq``) equals the content it loaded (the serving
+      hot-swap gate depends on this).
+
+    Mutations: ``commit_order="manifest_first"`` commits the manifest
+    before the payload (seeded ``manifest_before_payload``);
+    ``carry_seq_on_full=False`` re-arms full saves at ``last_seq=0``
+    (seq reuse; pre-fix shipped behavior); ``compact_content_seq=False``
+    drops the compacted manifest's content version (``applied_seq``
+    reports 0; also pre-fix shipped behavior).
+
+    Bounds: ``max_seq`` deltas, one full save, one crash, one tear, one
+    load (with one retry), compaction past 2 chain entries — exhaustive
+    within the budgets (~50k states at the defaults).
+    """
+    init: State = {
+        # manifest: None | (gen, last_seq, content_seq, chain tuple)
+        "mf": (0, 0, 0, ()),
+        "gen_next": 1,
+        "files": (),          # ((seq, "ok"|"torn"), ...) committed+orphans
+        "f0": 0, "f1": 0,     # base field content versions
+        "saver": ("idle",),
+        "comp": ("off",),
+        "loader": ("off",),
+        "burned": frozenset(), "reused": False,
+        "truths": frozenset([0]),
+        "crash_left": crashes, "tear_left": tears,
+        "full_left": fulls, "load_left": loads, "retry_left": 1,
+    }
+
+    def files_get(s, seq):
+        for q, st in s["files"]:
+            if q == seq:
+                return st
+        return None
+
+    def files_set(s, seq, st):
+        rest = tuple((q, x) for q, x in s["files"] if q != seq)
+        s["files"] = tuple(sorted(rest + ((seq, st),)))
+
+    def apply_seq(content, seq):
+        """Newest-wins row overwrite of one delta over one field."""
+        if content == _CORRUPT:
+            return _CORRUPT
+        if seq <= content:
+            return content             # idempotent re-apply
+        if seq == content + 1:
+            return seq
+        return _CORRUPT                # gap: rows from the wrong epoch
+
+    def live(s):
+        # the trainer's in-memory content = every committed delta
+        return max(s["burned"], default=0)
+
+    actions: List[Action] = []
+
+    # -- delta save ---------------------------------------------------------
+    def dw_guard(s):
+        return s["mf"] is not None and s["saver"] == ("idle",) \
+            and s["comp"] == ("off",) and s["mf"][1] < max_seq
+
+    def commit_seq(s, seq):
+        gen, _last, cseq, chain = s["mf"]
+        if seq in s["burned"]:
+            s["reused"] = True
+        s["burned"] = s["burned"] | {seq}
+        s["mf"] = (gen, seq, cseq, chain + (seq,))
+        s["truths"] = s["truths"] | {seq}
+
+    def write_branches(s, seq):
+        """A payload lands whole, or — tear budget — torn: fs.open_atomic
+        fsyncs file and directory, so a file ever observed whole can
+        never tear LATER; the torn-from-birth branch models the
+        dying-disk partial rename the PR-8 recovery lane exists for
+        (the writer computed its crc from memory and never re-reads,
+        so the commit can still follow a torn payload)."""
+        ok = dict(s)
+        files_set(ok, seq, "ok")
+        ok["saver"] = ("dw", seq)
+        out = [ok]
+        if s["tear_left"] > 0:
+            torn = dict(s)
+            files_set(torn, seq, "torn")
+            torn["tear_left"] -= 1
+            torn["saver"] = ("dw", seq)
+            out.append(torn)
+        return out
+
+    if commit_order == "payload_first":
+        def dw_apply(s):
+            return write_branches(s, s["mf"][1] + 1)
+        actions.append(Action("delta_write", "saver", dw_guard, dw_apply,
+                              syncs=("ckpt.delta.write",)))
+
+        def dc_guard(s):
+            return s["saver"][0] == "dw"
+
+        def dc_apply(s):
+            commit_seq(s, s["saver"][1])
+            s["saver"] = ("idle",)
+        actions.append(Action("delta_commit", "saver", dc_guard,
+                              dc_apply, syncs=("ckpt.delta.commit",)))
+    else:                              # mutated: manifest before payload
+        def dce_apply(s):
+            seq = s["mf"][1] + 1
+            commit_seq(s, seq)
+            s["saver"] = ("dw", seq)
+        actions.append(Action("delta_commit_early", "saver", dw_guard,
+                              dce_apply, syncs=("ckpt.delta.commit",)))
+
+        def dwl_guard(s):
+            return s["saver"][0] == "dw"
+
+        def dwl_apply(s):
+            out = write_branches(s, s["saver"][1])
+            for b in out:
+                b["saver"] = ("idle",)
+            return out
+        actions.append(Action("delta_write_late", "saver", dwl_guard,
+                              dwl_apply, syncs=("ckpt.delta.write",)))
+
+    def crash_saver_guard(s):
+        return s["saver"] != ("idle",) and s["crash_left"] > 0
+
+    def crash_saver_apply(s):
+        # the writer thread dies between steps: an uncommitted payload
+        # stays an orphan (GC'd later, never read); a committed-but-
+        # unwritten one stays MISSING — the mutated order's poison
+        s["saver"] = ("idle",)
+        s["crash_left"] -= 1
+    actions.append(Action("crash_saver", "chaos", crash_saver_guard,
+                          crash_saver_apply))
+
+    # -- full save ----------------------------------------------------------
+    def fs_guard(s):
+        return s["saver"] == ("idle",) and s["comp"] == ("off",) \
+            and s["full_left"] > 0 and s["mf"] is not None
+
+    def fs_reset_apply(s):
+        carried = s["mf"][1] if carry_seq_on_full else 0
+        s["mf"] = None
+        s["files"] = ()            # reset_chain GCs every delta file
+        s["full_left"] -= 1
+        s["saver"] = ("fr", carried)
+    actions.append(Action("full_reset_chain", "saver", fs_guard,
+                          fs_reset_apply, syncs=("ckpt.full.reset",)))
+
+    def fw0_guard(s):
+        return s["saver"][0] == "fr"
+
+    def fw0_apply(s):
+        s["f0"] = live(s)
+        s["saver"] = ("f0", s["saver"][1])
+    actions.append(Action("full_write_f0", "saver", fw0_guard, fw0_apply,
+                          syncs=("ckpt.writer.run",)))
+
+    def fw1_guard(s):
+        return s["saver"][0] == "f0"
+
+    def fw1_apply(s):
+        s["f1"] = live(s)
+        s["saver"] = ("f1", s["saver"][1])
+    actions.append(Action("full_write_f1", "saver", fw1_guard, fw1_apply,
+                          syncs=("ckpt.writer.run",)))
+
+    def fa_guard(s):
+        return s["saver"][0] == "f1"
+
+    def fa_apply(s):
+        carried = s["saver"][1]
+        s["mf"] = (s["gen_next"], carried, carried, ())
+        s["gen_next"] += 1
+        s["saver"] = ("idle",)
+    actions.append(Action("full_arm", "saver", fa_guard, fa_apply,
+                          syncs=("ckpt.full.arm",)))
+
+    # -- background compactor ----------------------------------------------
+    def verified_tail(s):
+        """Last verified chain seq (bad FINAL dropped), or None when a
+        bad MIDDLE makes the chain unfoldable/unloadable."""
+        chain = s["mf"][3]
+        tail = None
+        for i, seq in enumerate(chain):
+            if files_get(s, seq) == "ok":
+                tail = seq
+            elif i == len(chain) - 1:
+                return tail            # bad final: fold/load the prefix
+            else:
+                return None            # bad middle
+        return tail
+
+    def comp_start_guard(s):
+        # the compactor REFUSES a chain that does not fully verify
+        # (true positive found by this model: folding around a torn
+        # committed entry and GC'ing it converts the documented loud
+        # mid-chain refusal into silent permanent data loss — the torn
+        # delta's chunks were already claim-cleared, nothing re-covers
+        # them; checkpoint_delta._compact_impl now aborts instead)
+        chain = s["mf"][3] if s["mf"] is not None else ()
+        return s["comp"] == ("off",) and s["saver"] == ("idle",) \
+            and len(chain) >= 2 and verified_tail(s) == chain[-1]
+
+    def comp_start_apply(s):
+        s["comp"] = ("run", verified_tail(s))
+    actions.append(Action("compact_start", "compactor", comp_start_guard,
+                          comp_start_apply, syncs=("ckpt.compact.run",)))
+
+    def fold_field(s, field, upto):
+        v = s[field]
+        for seq in s["mf"][3]:
+            if seq > upto:
+                break
+            if files_get(s, seq) == "ok":
+                v = apply_seq(v, seq)
+        s[field] = v
+
+    def comp_fold0_guard(s):
+        return s["comp"][0] == "run"
+
+    def comp_fold0_apply(s):
+        fold_field(s, "f0", s["comp"][1])
+        s["comp"] = ("c0", s["comp"][1])
+    actions.append(Action("compact_fold_f0", "compactor",
+                          comp_fold0_guard, comp_fold0_apply))
+
+    def comp_fold1_guard(s):
+        return s["comp"][0] == "c0"
+
+    def comp_fold1_apply(s):
+        fold_field(s, "f1", s["comp"][1])
+        s["comp"] = ("c1", s["comp"][1])
+    actions.append(Action("compact_fold_f1", "compactor",
+                          comp_fold1_guard, comp_fold1_apply))
+
+    def comp_commit_guard(s):
+        return s["comp"][0] == "c1"
+
+    def comp_commit_apply(s):
+        folded = s["comp"][1]
+        cseq = folded if compact_content_seq else 0
+        s["mf"] = (s["gen_next"], s["mf"][1], cseq, ())
+        s["gen_next"] += 1
+        s["comp"] = ("gc",)
+    actions.append(Action("compact_commit", "compactor",
+                          comp_commit_guard, comp_commit_apply,
+                          syncs=("ckpt.compact.commit",)))
+
+    def comp_gc_guard(s):
+        return s["comp"] == ("gc",)
+
+    def comp_gc_apply(s):
+        s["files"] = ()
+        s["comp"] = ("off",)
+    actions.append(Action("compact_gc", "compactor", comp_gc_guard,
+                          comp_gc_apply))
+
+    def crash_comp_guard(s):
+        return s["comp"] != ("off",) and s["crash_left"] > 0
+
+    def crash_comp_apply(s):
+        # fields may be partially folded under the OLD manifest — replay
+        # idempotence must make any later load correct anyway
+        s["comp"] = ("off",)
+        s["crash_left"] -= 1
+    actions.append(Action("crash_compactor", "chaos", crash_comp_guard,
+                          crash_comp_apply))
+
+    # -- loader -------------------------------------------------------------
+    def lm_guard(s):
+        return s["loader"] == ("off",) and s["load_left"] > 0 \
+            and s["mf"] is not None
+
+    def lm_apply(s):
+        gen, _last, cseq, chain = s["mf"]
+        s["load_left"] -= 1
+        s["loader"] = ("mf", gen, cseq, chain)
+    actions.append(Action("load_read_manifest", "loader", lm_guard,
+                          lm_apply, syncs=("registry.load.start",)))
+
+    def lf0_guard(s):
+        return s["loader"][0] == "mf"
+
+    def lf0_apply(s):
+        s["loader"] = ("lf0",) + s["loader"][1:] + (s["f0"],)
+    actions.append(Action("load_read_f0", "loader", lf0_guard, lf0_apply))
+
+    def lf1_guard(s):
+        return s["loader"][0] == "lf0"
+
+    def lf1_apply(s):
+        s["loader"] = ("lf1",) + s["loader"][1:] + (s["f1"],)
+    actions.append(Action("load_read_f1", "loader", lf1_guard, lf1_apply))
+
+    def lc_guard(s):
+        return s["loader"][0] == "lf1"
+
+    def lc_apply(s):
+        # the replay re-reads the manifest AFTER the base fields
+        # (load_checkpoint line order: fields stream first, then
+        # read_manifest -> replay_chain) — together with newest-wins
+        # idempotence this is what makes loads racing a mid-fold
+        # compactor converge instead of publishing a mixed base; the
+        # version is computed from the SAME verify pass the replay
+        # performs (the registry version-coherence fix this PR)
+        _pc, gen0, _cseq0, _chain0, v0, v1 = s["loader"]
+        if s["mf"] is None:
+            # manifest vanished (racing full-save reset): no replay;
+            # the base_id check at finish forces the retry
+            s["loader"] = ("fin", gen0, 0, v0, v1, False)
+            return
+        chain = s["mf"][3]
+        cseq = s["mf"][2]
+        tail = None
+        missing_drop = False
+        bad_middle = False
+        for i, seq in enumerate(chain):
+            st = files_get(s, seq)
+            if st == "ok":
+                v0 = apply_seq(v0, seq)
+                v1 = apply_seq(v1, seq)
+                tail = seq
+            elif i == len(chain) - 1:
+                # verify_chain: bad FINAL entry discarded whole
+                missing_drop = st is None
+            else:
+                bad_middle = True       # refuse: later deltas build on it
+                break
+        if bad_middle:
+            s["loader"] = ("cerr", gen0)
+        else:
+            version = tail if tail is not None else cseq
+            s["loader"] = ("fin", gen0, version, v0, v1, missing_drop)
+    actions.append(Action("load_read_chain", "loader", lc_guard,
+                          lc_apply))
+
+    def _retry(s, gen0):
+        cur_gen = s["mf"][0] if s["mf"] is not None else -1
+        if cur_gen != gen0 and s["retry_left"] > 0:
+            s["retry_left"] -= 1
+            s["load_left"] += 1
+            s["loader"] = ("off",)
+            return True
+        return False
+
+    def lfin_guard(s):
+        return s["loader"][0] == "fin"
+
+    def lfin_apply(s):
+        _pc, gen0, version, v0, v1, miss = s["loader"]
+        cur_gen = s["mf"][0] if s["mf"] is not None else -1
+        if cur_gen != gen0:
+            if not _retry(s, gen0):
+                s["loader"] = ("err",)
+            return
+        s["loader"] = ("done", version, v0, v1, miss)
+    actions.append(Action("load_finish", "loader", lfin_guard,
+                          lfin_apply, syncs=("registry.load.commit",)))
+
+    def lerr_guard(s):
+        return s["loader"][0] == "cerr"
+
+    def lerr_apply(s):
+        # mid-chain damage: load_checkpoint raises unless base_id moved
+        if not _retry(s, s["loader"][1]):
+            s["loader"] = ("err",)
+    actions.append(Action("load_chain_error", "loader", lerr_guard,
+                          lerr_apply))
+
+    # -- invariants ---------------------------------------------------------
+    def inv_consistent(s):
+        if s["loader"][0] != "done":
+            return True
+        _pc, _version, v0, v1, _miss = s["loader"]
+        return v0 == v1 and v0 != _CORRUPT and v0 in s["truths"]
+
+    def inv_no_silent_loss(s):
+        return s["loader"][0] != "done" or not s["loader"][4]
+
+    def inv_no_reuse(s):
+        return not s["reused"]
+
+    def inv_version(s):
+        if s["loader"][0] != "done":
+            return True
+        _pc, version, v0, _v1, _miss = s["loader"]
+        return version == v0
+
+    def is_done(s):
+        return s["saver"] == ("idle",) and s["comp"] == ("off",) \
+            and s["loader"][0] in ("off", "done", "err")
+
+    return make_model(
+        "delta_chain", init, actions,
+        [("load_is_committed_consistent", inv_consistent),
+         ("no_silent_commit_loss", inv_no_silent_loss),
+         ("seqs_never_reused", inv_no_reuse),
+         ("load_version_matches_content", inv_version)],
+        is_done,
+        notes="delta save -> atomic manifest commit, full-save chain "
+              "reset, background compaction, crash/tear budgets, loads "
+              "racing everything (checkpoint_delta.py + "
+              "checkpoint.load_checkpoint retry)")
+
+
+# ---------------------------------------------------------------------------
+# shipped registry + schedule export
+# ---------------------------------------------------------------------------
+
+def shipped_models() -> List[Model]:
+    """The four shipped-protocol models the CLI checks exhaustively."""
+    return [delta_chain(), hot_swap(), dirty_tracker(), ha_registry()]
+
+
+def sample_traces(model: Model, k: int = 2
+                  ) -> List[List[Tuple[str, State]]]:
+    """Up to ``k`` representative full traces of a CLEAN model (the
+    shortest accepted quiescent run and the deepest state's run) — the
+    sampled schedules ``--emit-schedules`` exports for replay."""
+    parents: Dict[Any, Tuple[Any, Optional[str]]] = {
+        model.init: (None, None)}
+    queue = deque([model.init])
+    done_states: List[Any] = []
+    last = model.init
+    while queue:
+        fs = queue.popleft()
+        last = fs
+        state = dict(fs)
+        if model.is_done(state) and len(done_states) < 1:
+            done_states.append(fs)
+        _enabled, succs = _successors(model, state)
+        for label, b in succs:
+            fb = _freeze(b)
+            if fb not in parents:
+                parents[fb] = (fs, label)
+                queue.append(fb)
+    picks = done_states + [last]
+    traces = []
+    seen = set()
+    for fs in picks:
+        if fs in seen:
+            continue
+        seen.add(fs)
+        traces.append(_trace_of(parents, fs))
+        if len(traces) >= k:
+            break
+    return traces

@@ -334,8 +334,29 @@ def _save_checkpoint_impl(path: str,
     # a full save RESETS any existing delta chain FIRST (manifest removed
     # before base files change): a crash mid-save must leave either the
     # old chain intact-and-referenced or no chain at all — never a stale
-    # chain replayed over a half-new base (checkpoint_delta.reset_chain)
+    # chain replayed over a half-new base (checkpoint_delta.reset_chain).
+    # The old chain's last_seq is captured BEFORE the reset and carried
+    # into the re-arm below: seqs are burned, never reused — re-arming
+    # at 0 would hand the next delta a seq every serving replica has
+    # already applied, so replicas would ack it as stale and silently
+    # stop updating (graftproto delta_chain `full_save_resets_seq`)
+    carried_seq = 0
     if rank == 0:
+        if not remote:
+            try:
+                prev_manifest = cd.read_manifest(path)
+            except ValueError:
+                prev_manifest = None  # unknown-format manifest: reset anyway
+            if prev_manifest is not None:
+                carried_seq = int(prev_manifest.get("last_seq", 0))
+            else:
+                # no manifest, but the dir may still carry a burn
+                # counter in its meta: a NON-arming full save (part/
+                # compressed/remote layouts, or tracker-less) records
+                # it there below, so the seq line survives a format
+                # roundtrip instead of silently restarting at 0
+                carried_seq = _prev_meta_last_seq(path)
+        sync_point("ckpt.full.reset")
         cd.reset_chain(path)
     # trackers snapshot at the START: marks landing during the save refer
     # to pushes on NEWER state objects than the pytree being dumped, and
@@ -361,6 +382,11 @@ def _save_checkpoint_impl(path: str,
     # dtypes (ml_dtypes bfloat16 — the at-rest precision-ladder rung) as
     # opaque '<V2' descrs; loaders view such chunks back under the TRUE
     # dtype recorded here, then cast to the target (upcast on load)
+    # hot-swap burn counter, persisted OUTSIDE the manifest too: layouts
+    # that cannot arm a chain (part/compressed/remote, or no trackers)
+    # would otherwise drop it at reset_chain, and the next arming save
+    # would restart seqs at 0 — replicas then ack real deltas as stale
+    meta.extra["delta_last_seq"] = int(carried_seq)
     meta.extra["storage_dtypes"] = {
         name: _field_dtypes(hot_cache.unwrap(states[name]),
                             include_optimizer)
@@ -436,10 +462,27 @@ def _save_checkpoint_impl(path: str,
         # compactor to fold, so a chain over them could never rebase;
         # a delta save into such a dir stays forced-full (and rewrites
         # the base raw)
+        sync_point("ckpt.full.arm")
         cd.init_manifest(path, step=step,
-                         include_optimizer=include_optimizer)
+                         include_optimizer=include_optimizer,
+                         last_seq=carried_seq)
     _sync("ckpt_done")
     return nbytes
+
+
+def _prev_meta_last_seq(path: str) -> int:
+    """Burn counter recorded by a previous save's meta (0 when the dir
+    is fresh, pre-counter, or unreadable — matching the chain-less
+    default)."""
+    mpath = fs.join(path, MODEL_META_FILE)
+    try:
+        if not fs.exists(mpath):
+            return 0
+        with fs.open_file(mpath, "rb") as f:
+            meta = ModelMeta.loads(f.read().decode("utf-8"))
+        return int(meta.extra.get("delta_last_seq", 0))
+    except Exception:  # noqa: BLE001 — a corrupt old meta never blocks
+        return 0       # a full save; the save rewrites it wholesale
 
 
 def _field_dtypes(state, include_optimizer: bool) -> Dict[str, str]:
@@ -1096,7 +1139,8 @@ def load_checkpoint(path: str,
                     *,
                     dense_state_template: Any = None,
                     rng: Optional[jax.Array] = None,
-                    shard_slice: Optional[tuple] = None):
+                    shard_slice: Optional[tuple] = None,
+                    info: Optional[Dict[str, Any]] = None):
     """Rebuild all embedding states from ``path`` (any source mesh shape).
 
     Returns ``states`` or ``(states, dense_state)`` when a template pytree is
@@ -1116,6 +1160,17 @@ def load_checkpoint(path: str,
     checksum-verified and applied in order; a torn FINAL delta (a killed
     writer) is discarded whole — the load recovers to the last complete
     delta, never a half-applied one.
+
+    ``info`` (a caller-supplied dict) receives ``applied_seq``: the
+    chain version THIS load's states actually reflect, from the same
+    verify pass the replay used. Version-sensitive callers (the serving
+    registry's hot-swap gate) must use it instead of a separate
+    ``checkpoint_delta.applied_seq`` read — against a directory a
+    trainer is actively saving into, a second read can see a newer
+    chain than the load replayed, and a model versioned ahead of its
+    rows acks the next delta as stale and silently loses it
+    (graftproto-found divergence, pinned by
+    tests/test_graftproto_replay.py).
     """
     with scope.span("checkpoint.load"):
         from . import checkpoint_delta as cd
@@ -1132,7 +1187,7 @@ def load_checkpoint(path: str,
                 out = _load_checkpoint_impl(
                     path, collection,
                     dense_state_template=dense_state_template,
-                    rng=rng, shard_slice=shard_slice)
+                    rng=rng, shard_slice=shard_slice, info=info)
             except RuntimeError as e:
                 m1 = cd.read_manifest(path)
                 if (m1["base_id"] if m1 else None) != id0:
@@ -1154,7 +1209,8 @@ def _load_checkpoint_impl(path: str,
                           *,
                           dense_state_template: Any,
                           rng: Optional[jax.Array],
-                          shard_slice: Optional[tuple]):
+                          shard_slice: Optional[tuple],
+                          info: Optional[Dict[str, Any]] = None):
     meta = _check_meta(path, collection, shard_slice=shard_slice)
     with_opt = bool(meta.extra.get("include_optimizer", True))
     stored_all = meta.extra.get("storage_dtypes", {})
@@ -1217,7 +1273,11 @@ def _load_checkpoint_impl(path: str,
     if manifest and manifest.get("chain"):
         out = cd.replay_chain(path, collection, out, manifest=manifest,
                               with_opt=with_opt, shard_slice=shard_slice,
-                              dump_meta=dump_meta)
+                              dump_meta=dump_meta, info=info)
+    elif info is not None:
+        # chainless: the base bytes reflect content_seq (0 for plain
+        # full dumps and pre-content_seq manifests)
+        info["applied_seq"] = cd.verified_seq(manifest, [])
     for name in out:
         # cached-plane variables come back with a fresh all-pad replica;
         # the first HotCacheManager refresh re-admits the hot set

@@ -94,16 +94,26 @@ def _write_manifest(path: str, manifest: Dict[str, Any]) -> None:
 
 
 def init_manifest(path: str, *, step: int, include_optimizer: bool,
-                  last_seq: int = 0) -> Dict[str, Any]:
+                  last_seq: int = 0,
+                  content_seq: Optional[int] = None) -> Dict[str, Any]:
     """Arm a fresh chain over a just-written full base. ``last_seq``
-    carries the version counter across a compaction (seqs are burned,
-    never reused — the serving hot-swap version protocol needs
-    monotonicity)."""
+    carries the version counter across a compaction AND across a full
+    save over an armed dir (seqs are burned, never reused — the serving
+    hot-swap version protocol needs monotonicity; a re-arm at 0 would
+    make replicas ack the next real delta as stale and silently stop
+    updating — graftproto ``full_save_resets_seq``).
+
+    ``content_seq`` records the chain seq the BASE BYTES already
+    reflect, so ``applied_seq`` of a chainless manifest reports the true
+    version instead of 0 (a full save dumps the live state = everything
+    through ``last_seq``, hence the default)."""
     manifest = {"format": DELTA_FORMAT,
                 "base_id": uuid.uuid4().hex,
                 "base_step": int(step),
                 "include_optimizer": bool(include_optimizer),
                 "last_seq": int(last_seq),
+                "content_seq": int(last_seq if content_seq is None
+                                   else content_seq),
                 "chain": []}
     _write_manifest(path, manifest)
     return manifest
@@ -126,10 +136,11 @@ def chain_state(path: str) -> Dict[str, Any]:
     manifest = read_manifest(path)
     if manifest is None:
         return {"base_id": "", "base_step": 0, "last_seq": 0,
-                "chain_len": 0, "chain_bytes": 0}
+                "content_seq": 0, "chain_len": 0, "chain_bytes": 0}
     return {"base_id": manifest["base_id"],
             "base_step": manifest["base_step"],
             "last_seq": manifest["last_seq"],
+            "content_seq": int(manifest.get("content_seq", 0)),
             "chain_len": len(manifest["chain"]),
             "chain_bytes": sum(int(e.get("bytes", 0))
                                for e in manifest["chain"])}
@@ -412,6 +423,7 @@ def save_delta(path: str, collection: EmbeddingCollection,
     tasks = []
 
     def _write_var(name: str) -> None:
+        sync_point("ckpt.delta.write")
         spec = collection.specs[name]
         tracker = trackers[name]
         state = hot_cache.unwrap(states[name])
@@ -578,15 +590,20 @@ def _entry_payload(path: str, entry: Dict[str, Any],
 def replay_chain(path: str, collection: EmbeddingCollection,
                  states: Dict[str, Any], *, manifest: Dict[str, Any],
                  with_opt: bool, shard_slice: Optional[tuple],
-                 dump_meta: Optional[Dict[str, Any]] = None
+                 dump_meta: Optional[Dict[str, Any]] = None,
+                 info: Optional[Dict[str, Any]] = None
                  ) -> Dict[str, Any]:
     """Apply the committed chain over freshly-loaded base states, in
     order (newest wins by construction). Called by ``load_checkpoint``;
     states are UNWRAPPED table states (hot-cache wrap happens after).
     Payloads stream one ENTRY at a time (host memory bounded by one
     delta, never the whole chain — which the compaction budget allows
-    to reach a large fraction of the base)."""
+    to reach a large fraction of the base). ``info`` (when given) gets
+    ``applied_seq`` from the SAME verify pass the replay uses — the
+    version the loaded states actually reflect."""
     verified, _dropped = verify_chain(path, manifest, keep_payloads=False)
+    if info is not None:
+        info["applied_seq"] = verified_seq(manifest, verified)
     for entry, _ in verified:
         payloads = {name: _entry_payload(path, entry, name)
                     for name in entry["vars"]}
@@ -597,6 +614,22 @@ def replay_chain(path: str, collection: EmbeddingCollection,
     return states
 
 
+def verified_seq(manifest: Optional[Dict[str, Any]],
+                 verified) -> int:
+    """Version of an ALREADY-verified chain view: the last verified
+    entry's seq, else the manifest's ``content_seq`` (what the base
+    bytes reflect — after a compaction the chain is empty but the base
+    carries every folded delta; pre-``content_seq`` manifests read 0,
+    their pre-fix behavior). The loaders use THIS over the same verify
+    pass their replay performs, so the version a model starts serving at
+    can never race ahead of the rows it actually holds."""
+    if manifest is None:
+        return 0
+    if verified:
+        return int(verified[-1][0]["seq"])
+    return int(manifest.get("content_seq", 0))
+
+
 def applied_seq(path: str) -> int:
     """Chain seq a load of ``path`` replays up to (torn tail excluded) —
     the hot-swap version a freshly loaded serving model starts at.
@@ -604,14 +637,18 @@ def applied_seq(path: str) -> int:
     Deliberately re-verifies the chain (one extra checksum pass per
     MODEL LOAD — rare and bounded): the version must reflect exactly
     what a load applies, including a dropped torn tail, and the
-    manifest's ``last_seq`` alone cannot say that."""
+    manifest's ``last_seq`` alone cannot say that. NOTE: against a
+    directory a trainer is actively saving into, prefer the version the
+    load itself reports (``load_checkpoint(..., info=...)``) — this
+    standalone read can see a NEWER chain than a just-finished load
+    replayed, and a model versioned ahead of its rows acks the next
+    delta as stale and loses it (graftproto found this divergence in
+    the serving registry; fixed there)."""
     manifest = read_manifest(path)
     if manifest is None:
         return 0
     verified, _ = verify_chain(path, manifest, keep_payloads=False)
-    if verified:
-        return int(verified[-1][0]["seq"])
-    return 0
+    return verified_seq(manifest, verified)
 
 
 def apply_delta_to_states(collection: EmbeddingCollection,
@@ -944,9 +981,38 @@ def _compact_impl(path: str, *,
     manifest = read_manifest(path)
     if manifest is None or not manifest["chain"]:
         return {"compacted": False}
-    # bounded-memory verification: payloads re-read one at a time below
-    verified, _dropped = verify_chain(path, manifest, keep_payloads=False)
+    # bounded-memory verification: payloads re-read one at a time below.
+    # A MID-chain tear raises out of verify_chain: refuse to compact
+    # (graceful — compaction is an optimization; the damage keeps
+    # surfacing loudly at every load until a full save), never fail the
+    # delta save that happened to trigger the fold
+    try:
+        verified, dropped = verify_chain(path, manifest,
+                                         keep_payloads=False)
+    except RuntimeError as e:
+        warnings.warn(
+            f"delta chain at {path!r}: refusing to compact a chain that "
+            f"does not verify ({e}); re-save full to restore durability",
+            RuntimeWarning)
+        return {"compacted": False, "error": str(e)}
     entries = [e for e, _p in verified]
+    if dropped:
+        # graftproto true positive: a torn COMMITTED entry must not be
+        # compacted away. Folding the verified prefix and GC'ing the
+        # torn file would let later deltas commit over the hole with
+        # the torn delta's chunks permanently lost (they were claim-
+        # cleared at its save; nothing re-covers them) — and loads
+        # would "succeed" on the folded base instead of hitting the
+        # documented loud mid-chain refusal. Abort untouched: loads
+        # keep their drop-the-tail recovery, and once a later delta
+        # lands the tear is mid-chain and every load fails loudly until
+        # a full save rebuilds the base from the live state.
+        torn = manifest["chain"][len(entries)]["seq"]
+        warnings.warn(
+            f"delta chain at {path!r}: refusing to compact across torn "
+            f"entry seq={torn}; re-save full to restore durability",
+            RuntimeWarning)
+        return {"compacted": False, "torn_seq": int(torn)}
     with fs.open_file(fs.join(path, ckpt.MODEL_META_FILE), "rb") as f:
         meta = ModelMeta.loads(f.read().decode("utf-8"))
     by_name = {v.name: v for v in meta.variables}
@@ -973,6 +1039,13 @@ def _compact_impl(path: str, *,
                     "include_optimizer":
                         bool(manifest.get("include_optimizer", True)),
                     "last_seq": int(manifest["last_seq"]),
+                    # the folded base now REFLECTS the whole verified
+                    # chain: record it so applied_seq of the chainless
+                    # manifest reports the true version, not 0 (which
+                    # wedged hot-swap behind gap refusals after every
+                    # compaction — graftproto compact_zero_version)
+                    "content_seq": int(entries[-1]["seq"]) if entries
+                    else int(manifest.get("content_seq", 0)),
                     "chain": []}
     sync_point("ckpt.compact.commit")
     _write_manifest(path, new_manifest)

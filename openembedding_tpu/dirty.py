@@ -41,7 +41,7 @@ from typing import Optional
 
 import numpy as np
 
-from .analysis.concurrency import make_lock
+from .analysis.concurrency import make_lock, sync_point
 
 
 class DirtyTracker:
@@ -106,6 +106,10 @@ class DirtyTracker:
         chunks = chunks[(chunks >= 0) & (chunks < self.num_chunks)]
         if not chunks.size:
             return
+        # interleaving marker OUTSIDE the lock: a gated test parks the
+        # marking thread here without wedging the bitmap for others
+        # (graftproto dirty_tracker model action `mark`)
+        sync_point("dirty.mark")
         with self._lock:
             fresh = chunks[~self._bits[chunks]]
             if fresh.size:
@@ -151,11 +155,13 @@ class DirtyTracker:
             chunks = np.nonzero(self._bits)[0]
             self._bits[:] = False
             self._count = 0
-            return chunks
+        sync_point("dirty.snapshot")
+        return chunks
 
     def restore(self, chunks) -> None:
         """Re-mark a failed writer's snapshot (over-marking chunks that
         were re-dirtied meanwhile is harmless)."""
+        sync_point("dirty.restore")
         self.mark_chunks(chunks)
 
     def mask_chunks(self, chunks) -> np.ndarray:

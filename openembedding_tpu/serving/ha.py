@@ -187,9 +187,15 @@ def restore_from_peers(registry, peers: Sequence[str],
         if not creating or time.time() >= deadline:
             break
         time.sleep(0.5)
+    # interleaving marker: the catalog is settled; every restore below
+    # re-creates a model a LIVING peer served as NORMAL (the graftproto
+    # ha_registry model's restore_start guard — CREATING entries never
+    # restore, they were polled away above)
+    sync_point("ha.restore.catalog")
     n = 0
     for sign, (uri, ep) in catalog.items():
         try:
+            sync_point("ha.restore.model")
             registry.create_model(uri, model_sign=sign, block=True)
             n += 1
         except ValueError:

@@ -310,15 +310,22 @@ class ModelRegistry:
                                              self.default_hash_capacity,
                                              num_shards, shard_slice)
                     coll = EmbeddingCollection(specs, self.mesh)
+                    # hot-swap version = the delta-chain seq THIS load
+                    # replayed (0 for plain full checkpoints), reported
+                    # by the load itself. A separate applied_seq() read
+                    # here could see a delta committed AFTER the replay
+                    # — the model would then claim a version whose rows
+                    # it does not hold and ack that delta's push as
+                    # stale, silently losing it (graftproto-found
+                    # divergence, pinned in test_graftproto_replay.py)
+                    load_info: Dict[str, Any] = {}
                     states = ckpt_lib.load_checkpoint(
-                        model_uri, coll, shard_slice=shard_slice)
-                    # hot-swap version = the delta-chain seq the load
-                    # replayed up to (0 for plain full checkpoints)
-                    from .. import checkpoint_delta as cd
-                    version = cd.applied_seq(model_uri)
-                    model = ServingModel(sign, coll, states, meta,
-                                         shard_slice=shard_slice,
-                                         version=version)
+                        model_uri, coll, shard_slice=shard_slice,
+                        info=load_info)
+                    model = ServingModel(
+                        sign, coll, states, meta,
+                        shard_slice=shard_slice,
+                        version=int(load_info.get("applied_seq", 0)))
                 sync_point("registry.load.commit")
                 with self._lock:
                     self._models[sign] = model

@@ -1,0 +1,68 @@
+"""Seeded graftproto mutation models: every one must model-check to
+exactly one (minimal) counterexample, with the expected invariant named.
+
+Mirror of the graftlint/graftrace seeded-violation fixtures, one level
+up: where those plant violating *source*, this plants violating
+*protocols* — each mutation is a shipped protocol minus one load-bearing
+line (the seq gate, the payload-before-manifest order, the claim
+restore, the one-lock commit), built by passing the matching flag to the
+shipped model builder in ``openembedding_tpu/analysis/protomodel.py``.
+``tests/test_graftproto.py`` asserts each fires its expected invariant
+and that every UNMUTATED shipped model checks clean;
+``tests/test_graftproto_replay.py`` replays the exported counterexample
+schedules against the real implementation.
+
+Entries are pure data so ``tools/graftproto.py --mutations`` can load
+this file standalone (no package / jax import):
+
+    (name, builder, kwargs, expected_invariant, what the mutation drops)
+
+``full_save_resets_seq`` and ``compact_zero_version`` are the PRE-FIX
+shipped behaviors this PR's modeling uncovered and fixed — kept as
+mutations so the checker guards the fixes forever.
+"""
+
+MUTATIONS = [
+    ("drop_seq_gate", "hot_swap", {"seq_gate": False},
+     "version_covers_exactly_applied_deltas",
+     "apply_delta without the gap refusal: a reordered delta applies "
+     "over a hole and the skipped delta's rows are silently lost"),
+    ("inplace_publish", "hot_swap", {"atomic_publish": False},
+     "reader_sees_one_version",
+     "patching the served states in place instead of building "
+     "functionally and publishing one reference: a concurrent lookup "
+     "snapshots a half-patched model"),
+    ("skip_claim_restore", "dirty_tracker", {"restore_on_failure": False},
+     "no_dirty_chunk_lost_to_completed_chain",
+     "a failed delta writer that drops its claim instead of restoring "
+     "it: the claimed chunks' changes vanish from bitmap and chain"),
+    ("manifest_before_payload", "delta_chain",
+     {"commit_order": "manifest_first"},
+     "no_silent_commit_loss",
+     "committing the manifest before the payload file: a crash in "
+     "between leaves a committed entry with no bytes, which a load "
+     "silently drops as if it were a torn tail"),
+    ("full_save_resets_seq", "delta_chain", {"carry_seq_on_full": False},
+     "seqs_never_reused",
+     "re-arming a full save at last_seq=0: the next delta reuses a "
+     "burned seq, serving replicas ack it as stale and stop updating "
+     "(pre-fix shipped behavior)"),
+    ("compact_zero_version", "delta_chain",
+     {"compact_content_seq": False},
+     "load_version_matches_content",
+     "compacting without recording the folded content version: "
+     "applied_seq reports 0, every later delta push is refused as a "
+     "gap (pre-fix shipped behavior)"),
+    ("normal_before_install", "ha_registry", {"atomic_commit": False},
+     "normal_status_implies_model_installed",
+     "publishing status=NORMAL before installing the model object: "
+     "find_model hands a lookup a missing model inside the window"),
+]
+
+
+def build(protomodel, name):
+    """Construct one mutated model by fixture name."""
+    for n, builder, kwargs, _inv, _why in MUTATIONS:
+        if n == name:
+            return getattr(protomodel, builder)(**kwargs)
+    raise KeyError(name)

@@ -542,3 +542,151 @@ def test_swap_during_lookup_schedule(devices8, tmp_path):
     # a post-swap lookup is ENTIRELY the new version
     np.testing.assert_array_equal(np.asarray(model.lookup("arr", allv)),
                                   new)
+
+
+# --- graftproto-found divergences, pinned (ISSUE 13) -------------------------
+
+def test_full_save_carries_burned_seqs(devices8, tmp_path):
+    """graftproto `full_save_resets_seq` (pre-fix shipped behavior): a
+    full save over an armed chain must carry ``last_seq`` — re-arming at
+    0 hands the next delta a seq every replica already applied, which
+    they ack as a stale no-op and silently stop updating."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "m")
+    ckpt.save_checkpoint(path, coll, states, mode="delta", step=0)
+    for seed in (1, 2):
+        states, _ = train(coll, states, seed)
+        cd.save_delta(path, coll, states, step=seed,
+                      compact_bytes_ratio=1e9, background_compact=False)
+    states, _ = train(coll, states, 3)
+    ckpt.save_checkpoint(path, coll, states, mode="full", step=3)
+    st = cd.chain_state(path)
+    assert st["last_seq"] == 2 and st["content_seq"] == 2
+    # the fresh base REFLECTS everything through seq 2: a loaded serving
+    # model starts at version 2, so the next published delta (seq 3)
+    # applies instead of being acked away as stale
+    assert cd.applied_seq(path) == 2
+    states, idx = train(coll, states, 4)
+    info = cd.save_delta(path, coll, states, step=4,
+                         background_compact=False, return_payload=True)
+    assert info["seq"] == 3                   # burned seqs never reused
+    from openembedding_tpu.serving.registry import ModelRegistry
+    reg = ModelRegistry(mesh, default_hash_capacity=2048)
+    sign = reg.create_model(path, block=True)
+    model = reg.find_model(sign)
+    assert model.version == 3
+
+
+def test_applied_seq_survives_compaction(devices8, tmp_path):
+    """graftproto `compact_zero_version` (pre-fix shipped behavior): a
+    compaction folds the chain into the base; ``applied_seq`` must then
+    report the folded content version, not 0 — a 0-versioned model
+    refuses every later delta as a gap (hot-swap wedged until the next
+    full save)."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "m")
+    ckpt.save_checkpoint(path, coll, states, mode="delta", step=0)
+    for seed in (1, 2):
+        states, _ = train(coll, states, seed)
+        cd.save_delta(path, coll, states, step=seed,
+                      compact_chain_len=2, compact_bytes_ratio=1e9,
+                      background_compact=False)
+    manifest = cd.read_manifest(path)
+    assert manifest["chain"] == [] and manifest["content_seq"] == 2
+    assert cd.applied_seq(path) == 2
+    from openembedding_tpu.serving.registry import ModelRegistry
+    reg = ModelRegistry(mesh, default_hash_capacity=2048)
+    sign = reg.create_model(path, block=True)
+    model = reg.find_model(sign)
+    assert model.version == 2
+    # the next published delta continues seamlessly across the rebase
+    states, idx = train(coll, states, 3)
+    info = cd.save_delta(path, coll, states, step=3,
+                         background_compact=False, return_payload=True)
+    out = reg.apply_delta(sign, info["delta"])
+    assert out["applied"] and model.version == 3
+    want = np.asarray(coll.pull(states, {"arr": idx["arr"]},
+                                batch_sharded=False,
+                                read_only=True)["arr"])
+    np.testing.assert_array_equal(
+        want, np.asarray(model.lookup("arr", np.asarray(idx["arr"]))))
+
+
+def test_compactor_refuses_torn_entry(devices8, tmp_path):
+    """graftproto true positive: the compactor must NOT fold across a
+    torn committed entry — compacting the verified prefix and GC'ing
+    the torn file would turn the documented loud mid-chain refusal into
+    silent permanent data loss (the torn delta's chunks were claim-
+    cleared at its save; nothing re-covers them)."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "m")
+    ckpt.save_checkpoint(path, coll, states, mode="delta", step=0)
+    after = {}
+    for seed in (1, 2):
+        states, _ = train(coll, states, seed,
+                          arr_ids=np.arange(seed * 16, seed * 16 + 8))
+        cd.save_delta(path, coll, states, step=seed,
+                      compact_bytes_ratio=1e9, background_compact=False)
+        after[seed] = states
+    manifest = cd.read_manifest(path)
+    last = manifest["chain"][-1]["vars"]["arr"]["file"]
+    fp = os.path.join(path, last)
+    raw = bytearray(open(fp, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(fp, "wb").write(bytes(raw))
+    with pytest.warns(RuntimeWarning, match="refusing to compact"):
+        out = cd.compact(path)
+    assert out == {"compacted": False, "torn_seq": 2}
+    # directory untouched: chain intact, loads keep the documented
+    # drop-the-tail recovery to seq 1
+    manifest = cd.read_manifest(path)
+    assert [e["seq"] for e in manifest["chain"]] == [1, 2]
+    with pytest.warns(RuntimeWarning, match="torn"):
+        loaded = ckpt.load_checkpoint(path, coll)
+    assert_states_equal(coll, after[1], loaded)
+    # once a later delta lands the tear is MID-chain: loads fail loudly
+    # (never a silent fold-around) until a full save rebuilds the base
+    states, _ = train(coll, states, 3, arr_ids=np.arange(96, 104))
+    cd.save_delta(path, coll, states, step=3, background_compact=False)
+    with pytest.raises(RuntimeError, match="mid-chain"):
+        ckpt.load_checkpoint(path, coll)
+    ckpt.save_checkpoint(path, coll, states, mode="full", step=4)
+    loaded = ckpt.load_checkpoint(path, coll)
+    assert_states_equal(coll, states, loaded)
+
+
+def test_seq_line_survives_non_arming_full_save(devices8, tmp_path):
+    """Review-found hole in the seq-carry fix: a full save whose layout
+    cannot arm a chain (compressed/part format) resets the manifest and
+    would drop the burn counter with it — the meta now records
+    ``delta_last_seq`` so the NEXT arming save restores the line instead
+    of restarting at 0 (which replicas would stale-ack)."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "m")
+    ckpt.save_checkpoint(path, coll, states, mode="delta", step=0)
+    for seed in (1, 2):
+        states, _ = train(coll, states, seed)
+        cd.save_delta(path, coll, states, step=seed,
+                      compact_bytes_ratio=1e9, background_compact=False)
+    # compressed full save: resets the chain, CANNOT re-arm (framed
+    # streams have no memmap base for the compactor) — manifest gone
+    ckpt.save_checkpoint(path, coll, states, mode="full", step=3,
+                         compress="zlib")
+    assert cd.read_manifest(path) is None
+    # plain full save over the same dir: arms again, and must resume
+    # the burned-seq line recorded in the meta, not restart at 0
+    ckpt.save_checkpoint(path, coll, states, mode="full", step=4)
+    st = cd.chain_state(path)
+    assert st["last_seq"] == 2 and st["content_seq"] == 2, st
+    states, _ = train(coll, states, 5)
+    info = cd.save_delta(path, coll, states, step=5,
+                         background_compact=False)
+    assert info["seq"] == 3

@@ -1,0 +1,210 @@
+"""graftproto static plane: checker semantics, the four shipped models
+exhaustively clean, every seeded mutation model counterexamples with the
+expected invariant, the CLI exit codes, and the model<->code sync-point
+bridge.
+
+The executable half of the bridge — counterexample schedules replayed
+against the real implementation — lives in
+``tests/test_graftproto_replay.py``.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from openembedding_tpu.analysis import protomodel as pm
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+FIXTURE = os.path.join(HERE, "fixtures", "graftproto_violations.py")
+
+
+def _load_fixture():
+    spec = importlib.util.spec_from_file_location("graftproto_fixture",
+                                                  FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- checker semantics on tiny synthetic models ------------------------------
+
+def _counter_model(*, bound=3, bad_at=None, stuck_at=None):
+    """A one-counter model: inc to ``bound``; optionally a long detour
+    path exists so BFS minimality is observable."""
+    def inc_guard(s):
+        return s["n"] < bound
+
+    def inc_apply(s):
+        s["n"] += 1
+
+    def detour_guard(s):
+        return s["d"] < 10
+
+    def detour_apply(s):
+        s["d"] += 1
+
+    inv = [("n_below_bad", lambda s: bad_at is None or s["n"] < bad_at)]
+    done = (lambda s: stuck_at is None) if stuck_at is None else \
+        (lambda s: False)
+    actions = [pm.Action("inc", "p", inc_guard, inc_apply,
+                         syncs=("point.inc",)),
+               pm.Action("detour", "q", detour_guard, detour_apply)]
+    if stuck_at is not None:
+        # replace: inc stops early and nothing else is enabled
+        actions = [pm.Action("inc", "p",
+                             lambda s: s["n"] < stuck_at, inc_apply)]
+    return pm.make_model("counter", {"n": 0, "d": 0}, actions, inv, done)
+
+
+def test_bfs_counterexample_is_minimal_length():
+    # bad at n==2: reachable in exactly 2 inc steps; detour steps pad
+    # every other path — BFS must return the 2-step trace
+    res = pm.check(_counter_model(bad_at=2))
+    assert not res.ok and res.counterexample.kind == "invariant"
+    assert res.counterexample.invariant == "n_below_bad"
+    labels = [l for l, _s in res.counterexample.trace]
+    assert labels == ["<init>", "inc", "inc"]
+
+
+def test_invariant_checked_at_init():
+    res = pm.check(_counter_model(bad_at=0))
+    assert not res.ok and len(res.counterexample.trace) == 1
+
+
+def test_deadlock_detected_and_accepting_states_are_not():
+    stuck = pm.check(_counter_model(stuck_at=2))
+    assert not stuck.ok and stuck.counterexample.kind == "deadlock"
+    clean = pm.check(_counter_model())
+    assert clean.ok and clean.complete
+
+
+def test_state_dedup_and_exhaustive_count():
+    # product space is exactly 4 x 11 states
+    res = pm.check(_counter_model())
+    assert res.ok and res.explored == 44
+
+
+def test_nondet_branches_and_state_budget():
+    def fork(s):
+        return [dict(s, n=s["n"] + 1), dict(s, n=s["n"] + 2)]
+
+    m = pm.make_model(
+        "fork", {"n": 0},
+        [pm.Action("fork", "p", lambda s: s["n"] < 6, fork)],
+        [("no_neg", lambda s: s["n"] >= 0)], lambda s: True)
+    res = pm.check(m)
+    assert res.ok and res.explored == 8    # n in 0..7
+    cut = pm.check(m, max_states=3)
+    assert cut.ok and not cut.complete
+
+
+def test_format_and_schedule_export():
+    res = pm.check(_counter_model(bad_at=1))
+    m = _counter_model(bad_at=1)
+    text = pm.format_result(res, m)
+    assert "INVARIANT VIOLATED: n_below_bad" in text
+    assert "point.inc" in text             # sync names printed in traces
+    sched = pm.trace_schedule(m, res.counterexample.trace)
+    assert sched == ["point.inc"]
+
+
+def test_freeze_rejects_unhashable_state_values():
+    with pytest.raises(TypeError):
+        pm.make_model("bad", {"x": [1, 2]}, [], [], lambda s: True)
+
+
+# --- shipped models ----------------------------------------------------------
+
+SHIPPED_MIN_STATES = {"delta_chain": 10_000, "hot_swap": 40,
+                      "dirty_tracker": 100, "ha_registry": 200}
+
+
+@pytest.mark.parametrize("model", pm.shipped_models(),
+                         ids=lambda m: m.name)
+def test_shipped_model_checks_clean_and_exhaustively(model):
+    res = pm.check(model)
+    assert res.ok and res.complete, pm.format_result(res, model)
+    # the exploration must stay EXHAUSTIVE: a refactor that silently
+    # guards away most of the space would "pass" while checking nothing
+    assert res.explored >= SHIPPED_MIN_STATES[model.name], res.explored
+
+
+@pytest.mark.parametrize("model", pm.shipped_models(),
+                         ids=lambda m: m.name)
+def test_model_sync_points_exist_in_package_source(model):
+    """The fidelity tripwire: every sync point a model action claims to
+    correspond to must still be emitted by the package source."""
+    assert pm.missing_sync_points(model) == []
+    assert pm.model_sync_points(model)     # and the bridge is non-empty
+
+
+def test_sample_traces_are_replayable_schedules():
+    for model in (pm.hot_swap(), pm.dirty_tracker()):
+        traces = pm.sample_traces(model)
+        assert traces
+        for t in traces:
+            assert t[0][0] == "<init>"
+            # a sampled trace maps onto at least one real sync point
+            assert isinstance(pm.trace_schedule(model, t), list)
+
+
+# --- seeded mutations --------------------------------------------------------
+
+def test_every_seeded_mutation_fires_its_invariant():
+    fixture = _load_fixture()
+    names = [m[0] for m in fixture.MUTATIONS]
+    assert len(names) == len(set(names))
+    # every shipped protocol has at least one seeded mutation
+    assert {m[1] for m in fixture.MUTATIONS} == \
+        {m.name for m in pm.shipped_models()}
+    for name, builder, kwargs, expect_inv, _why in fixture.MUTATIONS:
+        model = getattr(pm, builder)(**kwargs)
+        res = pm.check(model)
+        cex = res.counterexample
+        assert cex is not None and cex.kind == "invariant", \
+            f"mutation {name} produced no counterexample"
+        assert cex.invariant == expect_inv, \
+            f"mutation {name}: fired {cex.invariant!r}"
+        # minimal-length trace exists and is replayable
+        assert len(cex.trace) >= 2
+        assert isinstance(pm.trace_schedule(model, cex.trace), list)
+
+
+def test_mutation_builder_helper():
+    fixture = _load_fixture()
+    m = fixture.build(pm, "drop_seq_gate")
+    assert m.name == "hot_swap"
+    with pytest.raises(KeyError):
+        fixture.build(pm, "nope")
+
+
+# --- CLI ---------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path):
+    from tools.graftproto import main
+    assert main([]) == 0
+    assert main(["--model", "delta_chain"]) == 0
+    assert main(["--model", "nope"]) == 2
+    assert main(["--mutations"]) == 1      # seeded bugs MUST fire
+    # a budget too small to finish a shipped model fails the gate
+    assert main(["--model", "delta_chain", "--max-states", "100"]) == 1
+
+
+def test_cli_emit_schedules(tmp_path, capsys):
+    from tools.graftproto import main
+    out = tmp_path / "sched.json"
+    assert main(["--emit-schedules", str(out)]) == 0
+    capsys.readouterr()
+    data = json.loads(out.read_text())
+    assert set(data["models"]) == {m.name for m in pm.shipped_models()}
+    for entry in data["models"].values():
+        assert entry["explored"] > 0 and entry["schedules"]
+    fixture = _load_fixture()
+    assert set(data["mutations"]) == {m[0] for m in fixture.MUTATIONS}
+    for name, _b, _k, expect_inv, _why in fixture.MUTATIONS:
+        mut = data["mutations"][name]
+        assert mut["invariant"] == expect_inv
+        assert mut["actions"]              # the replayable trace

@@ -1,0 +1,154 @@
+"""graftproto CLI: exhaustive protocol model checking gate.
+
+    python -m tools.graftproto                     # check shipped models
+    python -m tools.graftproto --model delta_chain
+    python -m tools.graftproto --mutations         # seeded mutations must
+                                                   # ALL counterexample
+    python -m tools.graftproto --emit-schedules out.json
+
+Fourth leg of the static-analysis gate (graftlint / graftrace /
+graftcheck / graftproto): checks the four shipped host-protocol models —
+the delta-checkpoint chain (+compactor, crash/tear budgets, racing
+loads), serving hot-swap seq gating, the DirtyTracker claim discipline,
+and the HA registry CREATING window under replica kills — EXHAUSTIVELY
+by BFS, printing per-model explored-state counts. Exit 0 only when every
+model's frontier is exhausted with all invariants green and no deadlock.
+
+``--mutations`` runs the seeded mutation models
+(``tests/fixtures/graftproto_violations.py``) and prints each minimal
+counterexample — exit 1 when any fire (they all must; the pytest lane
+asserts the exact invariant names). ``--emit-schedules`` writes every
+model's sampled sync-point schedules plus every mutation's
+counterexample schedule as JSON — the SerialSchedule/PointGate replays
+``tests/test_graftproto_replay.py`` executes against the real
+implementation, pinning the models to the code they describe.
+
+Models and semantics live in ``openembedding_tpu/analysis/protomodel.py``
+(stdlib-only; loaded standalone here so the gate never pays a jax
+import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, _ROOT)
+
+_FIXTURE = os.path.join(_ROOT, "tests", "fixtures",
+                        "graftproto_violations.py")
+
+
+def _load_standalone(name: str, path: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod   # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+protomodel = _load_standalone(
+    "_graftproto_impl",
+    os.path.join(_ROOT, "openembedding_tpu", "analysis", "protomodel.py"))
+
+
+def _schedule_entry(model, trace):
+    return {"actions": [label for label, _s in trace if label != "<init>"],
+            "syncs": protomodel.trace_schedule(model, trace)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="exhaustive protocol model checking "
+                    "(delta chain / hot-swap / dirty tracker / HA registry)")
+    ap.add_argument("--model", default="",
+                    help="check one shipped model by name (default: all)")
+    ap.add_argument("--max-states", type=int, default=500_000,
+                    help="exploration budget; hitting it FAILS a shipped "
+                         "model (an unexplored protocol is unchecked)")
+    ap.add_argument("--mutations", nargs="?", const=_FIXTURE, default=None,
+                    metavar="FIXTURE",
+                    help="run the seeded mutation models instead; every "
+                         "one must produce a counterexample (exit 1 when "
+                         "any fire — mirrors the graftlint fixture runs)")
+    ap.add_argument("--emit-schedules", default="", metavar="OUT",
+                    help="also write sampled + counterexample sync-point "
+                         "schedules as JSON for the real-code replays")
+    args = ap.parse_args(argv)
+
+    models = protomodel.shipped_models()
+    if args.model:
+        models = [m for m in models if m.name == args.model]
+        if not models:
+            print(f"graftproto: unknown model {args.model!r} (have: "
+                  f"{[m.name for m in protomodel.shipped_models()]})",
+                  file=sys.stderr)
+            return 2
+
+    out = {"models": {}, "mutations": {}}
+    failed = 0
+
+    if args.mutations is None or args.emit_schedules:
+        for model in models:
+            res = protomodel.check(model, max_states=args.max_states)
+            print(protomodel.format_result(res, model))
+            if not (res.ok and res.complete):
+                failed += 1
+                continue
+            if args.emit_schedules:
+                out["models"][model.name] = {
+                    "explored": res.explored,
+                    "transitions": res.transitions,
+                    "invariants": [n for n, _p in model.invariants],
+                    "schedules": [
+                        _schedule_entry(model, t)
+                        for t in protomodel.sample_traces(model)],
+                }
+
+    if args.mutations is not None or args.emit_schedules:
+        fixture = _load_standalone("_graftproto_fixture",
+                                   args.mutations or _FIXTURE)
+        for name, builder, kwargs, expect_inv, why in fixture.MUTATIONS:
+            model = getattr(protomodel, builder)(**kwargs)
+            res = protomodel.check(model, max_states=args.max_states)
+            cex = res.counterexample
+            if cex is None:
+                print(f"[mutation {name}] NO counterexample — the "
+                      f"checker missed a seeded bug ({why})")
+                failed += 1
+                continue
+            print(f"[mutation {name}] counterexample "
+                  f"({len(cex.trace) - 1} steps, invariant "
+                  f"{cex.invariant!r}, expected {expect_inv!r})")
+            if args.mutations is not None:
+                print(protomodel.format_result(res, model))
+                failed += 1          # mutations firing IS the exit-1 path
+            if cex.invariant != expect_inv:
+                print(f"[mutation {name}] WRONG invariant fired",
+                      file=sys.stderr)
+                failed += 1
+            if args.emit_schedules:
+                out["mutations"][name] = {
+                    "model": model.name,
+                    "invariant": cex.invariant,
+                    "why": why,
+                    **_schedule_entry(model, cex.trace),
+                }
+
+    if args.emit_schedules:
+        with open(args.emit_schedules, "w", encoding="utf-8") as fh:
+            json.dump(out, fh, indent=1, sort_keys=True)
+        print(f"graftproto: schedules -> {args.emit_schedules}")
+
+    if failed:
+        print(f"graftproto: {failed} failing check(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
