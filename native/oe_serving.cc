@@ -14,6 +14,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cctype>
 #include <cstdio>
 #include <cstring>
@@ -158,9 +159,11 @@ bool read_file(const std::string& path, std::string* out) {
 
 // ---------------------------------------------------------------------------
 // Memory-mapped .npy array (v1.0/2.0 headers, C-order little-endian).
+// The same parser reads standalone .npy files (mmap'd whole) and npz
+// MEMBERS (views into a mapped delta payload owned by the model).
 // ---------------------------------------------------------------------------
 struct NpyArray {
-  void* map = nullptr;
+  void* map = nullptr;          // owned mapping (null for npz views)
   size_t map_size = 0;
   const char* data = nullptr;   // first element
   std::string dtype;            // e.g. "<f4", "<i8"
@@ -178,31 +181,13 @@ struct NpyArray {
   }
 };
 
-std::unique_ptr<NpyArray> open_npy(const std::string& path) {
-  int fd = ::open(path.c_str(), O_RDONLY);
-  if (fd < 0) {
-    set_error("cannot open " + path);
-    return nullptr;
-  }
-  struct stat st;
-  if (::fstat(fd, &st) != 0 || st.st_size < 10) {
-    ::close(fd);
-    set_error("cannot stat " + path);
-    return nullptr;
-  }
-  auto arr = std::make_unique<NpyArray>();
-  arr->map_size = static_cast<size_t>(st.st_size);
-  arr->map = ::mmap(nullptr, arr->map_size, PROT_READ, MAP_SHARED, fd, 0);
-  ::close(fd);
-  if (arr->map == MAP_FAILED) {
-    arr->map = nullptr;
-    set_error("mmap failed for " + path);
-    return nullptr;
-  }
-  const unsigned char* b = static_cast<const unsigned char*>(arr->map);
-  if (std::memcmp(b, "\x93NUMPY", 6) != 0) {
-    set_error("not a .npy file: " + path);
-    return nullptr;
+// Parse one .npy image at [b, b+size) into arr (data points INTO the
+// buffer; arr does not own it). False + set_error on damage.
+bool parse_npy(const unsigned char* b, size_t size, NpyArray* arr,
+               const std::string& what) {
+  if (size < 10 || std::memcmp(b, "\x93NUMPY", 6) != 0) {
+    set_error("not a .npy image: " + what);
+    return false;
   }
   int major = b[6];
   size_t header_len, header_off;
@@ -210,13 +195,17 @@ std::unique_ptr<NpyArray> open_npy(const std::string& path) {
     header_len = b[8] | (b[9] << 8);
     header_off = 10;
   } else {
+    if (size < 12) {
+      set_error("corrupt .npy header in " + what);
+      return false;
+    }
     header_len = b[8] | (b[9] << 8) | (b[10] << 16)
         | (static_cast<size_t>(b[11]) << 24);
     header_off = 12;
   }
-  if (header_off + header_len > arr->map_size) {
-    set_error("corrupt .npy header in " + path);
-    return nullptr;
+  if (header_off + header_len > size) {
+    set_error("corrupt .npy header in " + what);
+    return false;
   }
   std::string header(reinterpret_cast<const char*>(b + header_off),
                      header_len);
@@ -242,9 +231,10 @@ std::unique_ptr<NpyArray> open_npy(const std::string& path) {
   };
   arr->dtype = find_val("descr");
   if (find_val("fortran_order").find("True") != std::string::npos) {
-    set_error("fortran-order arrays unsupported: " + path);
-    return nullptr;
+    set_error("fortran-order arrays unsupported: " + what);
+    return false;
   }
+  arr->shape.clear();
   std::string shape = find_val("shape");
   const char* sp = shape.c_str();
   while (*sp) {
@@ -255,8 +245,8 @@ std::unique_ptr<NpyArray> open_npy(const std::string& path) {
     }
   }
   if (arr->dtype.size() < 3) {
-    set_error("bad dtype in " + path);
-    return nullptr;
+    set_error("bad dtype in " + what);
+    return false;
   }
   arr->itemsize = std::strtoul(arr->dtype.c_str() + 2, nullptr, 10);
   arr->data = reinterpret_cast<const char*>(b + header_off + header_len);
@@ -268,13 +258,41 @@ std::unique_ptr<NpyArray> open_npy(const std::string& path) {
   for (int64_t d : arr->shape) {
     if (d < 0 ||
         __builtin_mul_overflow(need, static_cast<size_t>(d), &need) ||
-        need > arr->map_size) {
-      set_error("corrupt .npy shape in " + path);
-      return nullptr;
+        need > size) {
+      set_error("corrupt .npy shape in " + what);
+      return false;
     }
   }
-  if (header_off + header_len + need > arr->map_size) {
-    set_error("truncated .npy data in " + path);
+  if (header_off + header_len + need > size) {
+    set_error("truncated .npy data in " + what);
+    return false;
+  }
+  return true;
+}
+
+std::unique_ptr<NpyArray> open_npy(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    set_error("cannot open " + path);
+    return nullptr;
+  }
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < 10) {
+    ::close(fd);
+    set_error("cannot stat " + path);
+    return nullptr;
+  }
+  auto arr = std::make_unique<NpyArray>();
+  arr->map_size = static_cast<size_t>(st.st_size);
+  arr->map = ::mmap(nullptr, arr->map_size, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (arr->map == MAP_FAILED) {
+    arr->map = nullptr;
+    set_error("mmap failed for " + path);
+    return nullptr;
+  }
+  if (!parse_npy(static_cast<const unsigned char*>(arr->map),
+                 arr->map_size, arr.get(), path)) {
     return nullptr;
   }
   return arr;
@@ -341,6 +359,167 @@ int64_t load_key_as_i64(const NpyArray& a, int64_t idx) {
   return v;
 }
 
+// ---------------------------------------------------------------------------
+// crc32 (zlib polynomial) — the delta manifest's whole-file checksums
+// are verified before any byte of a delta payload is trusted, matching
+// checkpoint_delta.verify_chain.
+// ---------------------------------------------------------------------------
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t crc32_of(const unsigned char* buf, size_t len) {
+  // magic static: C++11 guarantees thread-safe one-time construction
+  // (two threads loading delta dirs concurrently must never read a
+  // half-built table — a wrong crc would misclassify a valid delta
+  // as torn)
+  static const Crc32Table table;
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; ++i)
+    c = table.t[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// A whole file mmap'd read-only; delta payloads stay mapped for the
+// model's lifetime (their rows serve directly from the mapping).
+struct MappedFile {
+  void* map = nullptr;
+  size_t size = 0;
+
+  ~MappedFile() {
+    if (map) ::munmap(map, size);
+  }
+  const unsigned char* bytes() const {
+    return static_cast<const unsigned char*>(map);
+  }
+};
+
+std::unique_ptr<MappedFile> map_file(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    set_error("cannot open " + path);
+    return nullptr;
+  }
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    set_error("cannot stat " + path);
+    return nullptr;
+  }
+  auto mf = std::make_unique<MappedFile>();
+  mf->size = static_cast<size_t>(st.st_size);
+  mf->map = ::mmap(nullptr, mf->size ? mf->size : 1, PROT_READ,
+                   MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mf->map == MAP_FAILED) {
+    mf->map = nullptr;
+    set_error("mmap failed for " + path);
+    return nullptr;
+  }
+  return mf;
+}
+
+// ---------------------------------------------------------------------------
+// npz (zip) member table — delta payloads are np.savez archives of
+// STORED .npy members (save_delta's default; compressed-at-rest delta
+// chains are refused with a clear message — the native reader trades
+// codec support for zero dependencies). Offsets are resolved through
+// the central directory, whose sizes are authoritative.
+// ---------------------------------------------------------------------------
+uint32_t rd32(const unsigned char* p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16)
+      | (static_cast<uint32_t>(p[3]) << 24);
+}
+uint16_t rd16(const unsigned char* p) { return p[0] | (p[1] << 8); }
+
+struct ZipMember {
+  size_t offset = 0;   // first data byte
+  size_t size = 0;     // uncompressed == stored size
+};
+
+bool parse_npz(const unsigned char* b, size_t n, const std::string& what,
+               std::map<std::string, ZipMember>* out) {
+  // find the end-of-central-directory record in the trailing 64 KiB
+  if (n < 22) {
+    set_error("truncated npz: " + what);
+    return false;
+  }
+  size_t scan_from = n >= (1 << 16) + 22 ? n - ((1 << 16) + 22) : 0;
+  size_t eocd = std::string::npos;
+  for (size_t i = n - 22 + 1; i-- > scan_from;) {
+    if (b[i] == 0x50 && b[i + 1] == 0x4b && b[i + 2] == 0x05
+        && b[i + 3] == 0x06) {
+      eocd = i;
+      break;
+    }
+  }
+  if (eocd == std::string::npos) {
+    set_error("npz central directory not found: " + what);
+    return false;
+  }
+  uint16_t entries = rd16(b + eocd + 10);
+  uint32_t cd_off = rd32(b + eocd + 16);
+  size_t p = cd_off;
+  for (uint16_t e = 0; e < entries; ++e) {
+    if (p + 46 > n || rd32(b + p) != 0x02014b50) {
+      set_error("corrupt npz central directory: " + what);
+      return false;
+    }
+    uint16_t method = rd16(b + p + 10);
+    uint32_t csize = rd32(b + p + 20);
+    uint32_t usize = rd32(b + p + 24);
+    uint16_t name_len = rd16(b + p + 28);
+    uint16_t extra_len = rd16(b + p + 30);
+    uint16_t comment_len = rd16(b + p + 32);
+    uint32_t lho = rd32(b + p + 42);
+    // bound the variable-length tail BEFORE reading the name: a
+    // corrupt name_len near the end of the mapping must error, not
+    // walk past it
+    if (p + 46u + name_len + extra_len + comment_len > n) {
+      set_error("corrupt npz central directory: " + what);
+      return false;
+    }
+    std::string name(reinterpret_cast<const char*>(b + p + 46), name_len);
+    if (csize == 0xFFFFFFFFu || lho == 0xFFFFFFFFu) {
+      set_error("zip64 npz member unsupported: " + what + ":" + name);
+      return false;
+    }
+    if (method != 0) {
+      set_error("deflated npz member " + name + " in " + what
+                + " — the native reader serves uncompressed delta "
+                  "payloads (save deltas with compress='' or compact "
+                  "the chain)");
+      return false;
+    }
+    // size_t BEFORE the add: a near-max uint32 offset must fail the
+    // bound, not wrap past it into an out-of-bounds read
+    if (static_cast<size_t>(lho) + 30 > n || rd32(b + lho) != 0x04034b50) {
+      set_error("corrupt npz local header: " + what + ":" + name);
+      return false;
+    }
+    // the LOCAL header's name/extra lengths position the data (the
+    // central copy may record different extra bytes)
+    uint16_t lnl = rd16(b + lho + 26);
+    uint16_t lxl = rd16(b + lho + 28);
+    size_t data = static_cast<size_t>(lho) + 30 + lnl + lxl;
+    if (data + usize > n) {
+      set_error("truncated npz member " + name + " in " + what);
+      return false;
+    }
+    (*out)[name] = ZipMember{data, usize};
+    p += 46u + name_len + extra_len + comment_len;
+  }
+  return true;
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -352,12 +531,17 @@ struct oe_variable {
   int dim = 0;
   int64_t vocab = 0;      // -1 => hash
   // one entry per dump part (single-host dumps have one); multi-host
-  // bounded parts carry keyed (ids, rows) files like hash parts
+  // bounded parts carry keyed (ids, rows) files like hash parts —
+  // delta payloads append further parts (views into mapped npz files)
   std::vector<std::unique_ptr<NpyArray>> weights;
   std::vector<std::unique_ptr<NpyArray>> keys;  // hash keys / bounded ids
   bool direct = false;  // single dense part: row == id, no index
   // key/id -> (part << 40 | row); parts < 2^24, rows < 2^40
   std::unordered_map<int64_t, int64_t> index;
+  // delta redirects for DIRECT variables (id -> part|row): checked
+  // before the base row so newest-wins replay needs no base rewrite;
+  // indexed variables take delta rows straight into `index`
+  std::unordered_map<int64_t, int64_t> overlay;
   int64_t total_rows = 0;
 };
 
@@ -366,7 +550,253 @@ struct oe_model {
   std::vector<std::unique_ptr<oe_variable>> variables;
   std::unordered_map<std::string, oe_variable*> by_name;
   std::unordered_map<int, oe_variable*> by_id;
+  // delta-chain seq the load replayed up to (applied_seq semantics)
+  int64_t version = 0;
+  // mapped delta payload files: their member arrays serve rows for the
+  // model's whole lifetime
+  std::vector<std::unique_ptr<MappedFile>> payloads;
 };
+
+namespace {
+
+// resolve one 64-bit key to (part, row) or row -1 (zero row)
+inline int64_t resolve_row(const oe_variable* var, int64_t key,
+                           int64_t* part) {
+  constexpr int64_t kRowMask = (int64_t(1) << 40) - 1;
+  if (var->direct) {
+    if (key < 0 || key >= var->vocab) return -1;
+    if (!var->overlay.empty()) {
+      auto it = var->overlay.find(key);
+      if (it != var->overlay.end()) {
+        *part = it->second >> 40;
+        return it->second & kRowMask;
+      }
+    }
+    *part = 0;
+    return key;
+  }
+  if (var->vocab >= 0 && (key < 0 || key >= var->vocab)) return -1;
+  auto it = var->index.find(key);
+  if (it == var->index.end()) return -1;
+  *part = it->second >> 40;
+  return it->second & kRowMask;
+}
+
+inline void copy_row(const oe_variable* var, int64_t part, int64_t row,
+                     float* dst) {
+  const int dim = var->dim;
+  if (row < 0) {
+    std::memset(dst, 0, sizeof(float) * dim);
+    return;
+  }
+  const NpyArray& w = *var->weights[part];
+  if (w.dtype[1] == 'f' && w.itemsize == 4) {
+    std::memcpy(dst, w.data + row * dim * 4, sizeof(float) * dim);
+  } else {
+    for (int d = 0; d < dim; ++d) {
+      dst[d] = load_elem_as_float(w, row * dim + d);
+    }
+  }
+}
+
+bool npy_scalar_i64(const NpyArray& a, int64_t* out) {
+  if (!a.shape.empty() || a.itemsize != 8 || a.dtype[1] != 'i')
+    return false;
+  std::memcpy(out, a.data, 8);
+  return true;
+}
+
+// One verified delta payload for one variable, parsed into npy views
+// over the mapped npz bytes.
+struct DeltaPayload {
+  std::string name;
+  std::map<std::string, ZipMember> members;
+  const unsigned char* base = nullptr;
+
+  bool view(const std::string& member, NpyArray* out,
+            const std::string& what) const {
+    auto it = members.find(member + ".npy");
+    if (it == members.end()) {
+      set_error("delta payload missing member " + member + ": " + what);
+      return false;
+    }
+    return parse_npy(base + it->second.offset, it->second.size, out,
+                     what + ":" + member);
+  }
+};
+
+// Apply one variable's verified payload newest-wins: its weights become
+// a new part; overlay/index entries redirect the touched keys to it.
+bool apply_delta_payload(oe_variable* var, const DeltaPayload& pl,
+                         const std::string& what) {
+  auto w = std::make_unique<NpyArray>();
+  if (!pl.view("weights", w.get(), what)) return false;
+  if (w->row_elems() != var->dim) {
+    set_error("delta weights dim mismatch for " + var->name + ": "
+              + what);
+    return false;
+  }
+  if (!weights_dtype_supported(*w)) {
+    set_error("unsupported delta weights dtype " + w->dtype + ": "
+              + what);
+    return false;
+  }
+  const int64_t part = static_cast<int64_t>(var->weights.size());
+  const int64_t wrows = w->rows();
+  if (pl.members.count("keys.npy")) {           // hash payload
+    NpyArray keys;
+    if (!pl.view("keys", &keys, what)) return false;
+    if (keys.rows() != wrows) {
+      set_error("delta key/row count mismatch for " + var->name + ": "
+                + what);
+      return false;
+    }
+    if (var->direct) {
+      set_error("hash delta payload for bounded variable " + var->name
+                + ": " + what);
+      return false;
+    }
+    for (int64_t j = 0; j < wrows; ++j) {
+      int64_t k64 = load_key_as_i64(keys, j);
+      auto ins = var->index.insert({k64, (part << 40) | j});
+      if (ins.second) {
+        ++var->total_rows;                       // brand-new key
+      } else {
+        ins.first->second = (part << 40) | j;    // newest wins
+      }
+    }
+  } else {                                       // array (chunked) payload
+    NpyArray chunks, rpc, vocab;
+    int64_t R = 0, V = 0;
+    if (!pl.view("chunks", &chunks, what)
+        || !pl.view("rows_per_chunk", &rpc, what)
+        || !pl.view("vocab", &vocab, what)) {
+      return false;
+    }
+    if (!npy_scalar_i64(rpc, &R) || !npy_scalar_i64(vocab, &V)
+        || R <= 0) {
+      set_error("corrupt array delta header for " + var->name + ": "
+                + what);
+      return false;
+    }
+    auto& target = var->direct ? var->overlay : var->index;
+    int64_t j = 0;
+    for (int64_t c = 0; c < chunks.rows(); ++c) {
+      int64_t chunk = load_key_as_i64(chunks, c);
+      int64_t l1 = std::min((chunk + 1) * R, V);
+      for (int64_t g = chunk * R; g < l1; ++g, ++j) {
+        if (j >= wrows) {
+          set_error("array delta rows short for " + var->name + ": "
+                    + what);
+          return false;
+        }
+        target[g] = (part << 40) | j;
+      }
+    }
+    if (j != wrows) {
+      set_error("array delta rows mismatch for " + var->name + ": "
+                + what);
+      return false;
+    }
+  }
+  var->weights.push_back(std::move(w));
+  return true;
+}
+
+// Resolve the delta_manifest chain over a freshly loaded base —
+// checkpoint_delta.verify_chain + replay_chain semantics: every
+// committed entry crc-verified whole, replayed in order; a torn/missing
+// FINAL entry is discarded (recover to the last complete delta), torn
+// MIDDLE fails the load. Returns false only on a load-fatal condition.
+bool replay_delta_chain(oe_model* model, const std::string& root) {
+  struct stat st;
+  std::string mpath = root + "/delta_manifest";
+  if (::stat(mpath.c_str(), &st) != 0) return true;  // plain full dump
+  std::string text;
+  if (!read_file(mpath, &text)) {
+    set_error("cannot read " + mpath);
+    return false;
+  }
+  JsonParser jp{text.c_str(), text.c_str() + text.size()};
+  Json manifest = jp.parse();
+  if (!jp.ok || manifest.kind != Json::kObj) {
+    set_error("delta_manifest is not valid JSON: " + mpath);
+    return false;
+  }
+  const Json* fmt = manifest.get("format");
+  if (!fmt || static_cast<int>(fmt->num) != 1) {
+    set_error("unknown delta manifest format at " + root);
+    return false;
+  }
+  if (const Json* cs = manifest.get("content_seq"))
+    model->version = static_cast<int64_t>(cs->num);
+  const Json* chain = manifest.get("chain");
+  if (!chain || chain->kind != Json::kArr) return true;
+  for (size_t i = 0; i < chain->arr.size(); ++i) {
+    const Json& entry = chain->arr[i];
+    const Json* vars = entry.get("vars");
+    const Json* seq = entry.get("seq");
+    if (!vars || vars->kind != Json::kObj || !seq) {
+      set_error("corrupt delta chain entry at " + root);
+      return false;
+    }
+    // verify the WHOLE entry before applying any of it (a bad file
+    // discards/refuses the entry as a unit, like verify_chain)
+    std::vector<std::unique_ptr<MappedFile>> maps;
+    std::vector<DeltaPayload> payloads;
+    bool bad = false;
+    for (const auto& kv : vars->obj) {
+      const Json* file = kv.second.get("file");
+      const Json* crc = kv.second.get("crc32");
+      if (!file || !crc) {
+        bad = true;
+        break;
+      }
+      auto mf = map_file(root + "/" + file->str);
+      if (!mf
+          || crc32_of(mf->bytes(), mf->size)
+              != static_cast<uint32_t>(
+                  static_cast<int64_t>(crc->num))) {
+        bad = true;                      // missing or corrupt bytes
+        break;
+      }
+      DeltaPayload pl;
+      pl.name = kv.first;
+      pl.base = mf->bytes();
+      if (!parse_npz(pl.base, mf->size, file->str, &pl.members)) {
+        // crc MATCHED, so these are exactly the committed bytes — a
+        // parse failure is an unsupported feature (deflate/zip64), not
+        // a tear: fail loudly instead of "recovering" past real data
+        return false;
+      }
+      maps.push_back(std::move(mf));
+      payloads.push_back(std::move(pl));
+    }
+    if (bad) {
+      if (i + 1 == chain->arr.size()) return true;  // torn FINAL: drop
+      set_error("delta chain torn mid-chain at seq "
+                + std::to_string(static_cast<int64_t>(seq->num))
+                + " under " + root
+                + " — restore the file or load an older full dump");
+      return false;
+    }
+    for (const DeltaPayload& pl : payloads) {
+      auto it = model->by_name.find(pl.name);
+      if (it == model->by_name.end()) continue;   // unknown var: skip
+      if (!apply_delta_payload(it->second, pl,
+                               root + " seq "
+                               + std::to_string(
+                                   static_cast<int64_t>(seq->num)))) {
+        return false;
+      }
+    }
+    for (auto& mf : maps) model->payloads.push_back(std::move(mf));
+    model->version = static_cast<int64_t>(seq->num);
+  }
+  return true;
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -488,6 +918,9 @@ oe_model* oe_model_load(const char* path) {
     model->by_id[var->variable_id] = var.get();
     model->variables.push_back(std::move(var));
   }
+  // delta-compacted dirs load directly: crc-verified chain replay over
+  // the mapped base (torn-final recovery matching load_checkpoint)
+  if (!replay_delta_chain(model.get(), root)) return nullptr;
   return model.release();
 }
 
@@ -534,31 +967,38 @@ int oe_pull_weights(const oe_variable* var, const int64_t* keys, int64_t n,
   g_error.clear();
   const int dim = var->dim;
   for (int64_t i = 0; i < n; ++i) {
-    int64_t part = 0, row = -1;
-    if (var->direct) {
-      if (keys[i] >= 0 && keys[i] < var->vocab) row = keys[i];
-    } else if (var->vocab < 0 || (keys[i] >= 0 && keys[i] < var->vocab)) {
-      auto it = var->index.find(keys[i]);
-      if (it != var->index.end()) {
-        part = it->second >> 40;
-        row = it->second & ((int64_t(1) << 40) - 1);
-      }
-    }
-    float* dst = out + i * dim;
-    if (row < 0) {
-      std::memset(dst, 0, sizeof(float) * dim);
-      continue;
-    }
-    const NpyArray& w = *var->weights[part];
-    if (w.dtype[1] == 'f' && w.itemsize == 4) {
-      std::memcpy(dst, w.data + row * dim * 4, sizeof(float) * dim);
-    } else {
-      for (int d = 0; d < dim; ++d) {
-        dst[d] = load_elem_as_float(w, row * dim + d);
-      }
-    }
+    int64_t part = 0;
+    int64_t row = resolve_row(var, keys[i], &part);
+    copy_row(var, part, row, out + i * dim);
   }
   return 0;
 }
+
+int oe_pull_weights_gather(const oe_variable* var,
+                           const int64_t* unique_keys, int64_t n_unique,
+                           const int64_t* gather, int64_t n_out,
+                           float* out) {
+  // the micro-batcher's native data plane: every UNIQUE key probes the
+  // index exactly once, then the scatter is pure row memcpy — a storm
+  // of overlapping lookups pays one probe per distinct key per flush
+  g_error.clear();
+  const int dim = var->dim;
+  std::vector<int64_t> parts(static_cast<size_t>(n_unique));
+  std::vector<int64_t> rows(static_cast<size_t>(n_unique));
+  for (int64_t u = 0; u < n_unique; ++u) {
+    rows[u] = resolve_row(var, unique_keys[u], &parts[u]);
+  }
+  for (int64_t i = 0; i < n_out; ++i) {
+    int64_t g = gather[i];
+    if (g < 0 || g >= n_unique) {
+      std::memset(out + i * dim, 0, sizeof(float) * dim);
+      continue;
+    }
+    copy_row(var, parts[g], rows[g], out + i * dim);
+  }
+  return 0;
+}
+
+int64_t oe_model_version(const oe_model* model) { return model->version; }
 
 }  // extern "C"

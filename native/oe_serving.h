@@ -18,6 +18,16 @@
  * rows directly (out-of-range -> zero rows); hash variables resolve through
  * an in-memory key index rebuilt from keys.npy at load (unknown keys ->
  * zero rows). Thread-safe for concurrent lookups after load.
+ *
+ * Delta-compacted checkpoint dirs (checkpoint_delta.py) load DIRECTLY:
+ * oe_model_load resolves the delta_manifest chain at open — every
+ * committed delta file is crc32-verified against the manifest, parsed
+ * (stored-entry .npz), and replayed newest-wins over the mmap'd base
+ * (row redirects into the mapped delta payloads; base bytes stay
+ * untouched on disk). A torn/missing FINAL entry is discarded whole
+ * (recover to the last complete delta, matching load_checkpoint); a
+ * torn MIDDLE entry fails the load. The zero-JAX latency floor thus no
+ * longer requires a full save first.
  */
 #ifndef OE_SERVING_H_
 #define OE_SERVING_H_
@@ -41,6 +51,12 @@ void oe_model_free(oe_model* model);
 /* Model signature recorded in model_meta (may be empty). */
 const char* oe_model_sign(const oe_model* model);
 
+/* Delta-chain seq this load replayed up to (0 for plain full dumps) —
+ * the hot-swap version the same dir would serve at through the Python
+ * registry (checkpoint_delta.applied_seq semantics, torn tail
+ * excluded). */
+int64_t oe_model_version(const oe_model* model);
+
 int oe_model_num_variables(const oe_model* model);
 oe_variable* oe_model_variable(oe_model* model, const char* name);
 oe_variable* oe_model_variable_by_id(oe_model* model, int variable_id);
@@ -57,6 +73,18 @@ int64_t oe_variable_rows(const oe_variable* var);
  * Invalid/unknown keys yield zero rows (the serving contract). */
 int oe_pull_weights(const oe_variable* var, const int64_t* keys, int64_t n,
                     float* out);
+
+/* Batched (micro-batcher) pull: resolve n_unique deduped keys ONCE,
+ * then scatter rows to out by gather — out[i] = row(unique_keys[
+ * gather[i]]) for i in [0, n_out). One index probe per UNIQUE key
+ * instead of per request element: the native leg of the serving
+ * micro-batching scheduler (serving/batcher.py). gather entries
+ * outside [0, n_unique) yield zero rows. out must hold n_out * dim
+ * floats. Returns 0, or -1 on error. */
+int oe_pull_weights_gather(const oe_variable* var,
+                           const int64_t* unique_keys, int64_t n_unique,
+                           const int64_t* gather, int64_t n_out,
+                           float* out);
 
 #ifdef __cplusplus
 }

@@ -9,7 +9,9 @@ state machines whose bug class (torn tails, seq gaps, lost dirty marks,
 mixed-version reads) hides in interleavings no example-based test
 enumerates. This module is the fourth static-analysis leg beside
 graftcheck/graftlint/graftrace: a small EXPLICIT-STATE model checker plus
-faithful models of the four shipped protocols, explored exhaustively.
+faithful models of the shipped protocols (five today — the serving
+lookup micro-batcher joined in the batched-serving round), explored
+exhaustively.
 
 Checker (stdlib-only, like :mod:`.concurrency`, so ``tools/graftproto.py``
 loads it standalone):
@@ -1240,12 +1242,203 @@ def delta_chain(*, commit_order: str = "payload_first",
 
 
 # ---------------------------------------------------------------------------
+# Model 5: serving lookup micro-batcher (serving/batcher.py LookupBatcher)
+# ---------------------------------------------------------------------------
+
+def serving_batcher(*, snapshot_per_flush: bool = True,
+                    drain_on_shutdown: bool = True,
+                    requests: int = 3, queue_cap: int = 2,
+                    swaps: int = 2) -> Model:
+    """The micro-batching lookup scheduler's enqueue/flush/swap/shutdown
+    protocol (``serving/batcher.py`` ``LookupBatcher`` vs
+    ``registry.apply_delta`` hot-swaps and ``close()``).
+
+    Clients offer ``requests`` lookups into a bounded queue
+    (``queue_cap`` — a full or closed queue rejects with a busy
+    response, exactly one response either way). The batcher thread runs
+    one flush at a time: COLLECT the queued batch, SNAPSHOT the
+    published model reference ONCE (the one-reference-grab discipline
+    ``ServingModel.lookup`` already pins for single lookups), then
+    resolve the batch in two pull sub-steps (the per-variable-group
+    pulls of a mixed batch — the window a concurrent hot-swap can land
+    in), then respond to every member. A publisher applies deltas
+    (``swaps`` budget) at any interleaving, including mid-flush. A
+    shutdown stops the queue accepting and DRAINS what was already
+    accepted before stopping.
+
+    Invariants:
+
+    * ``batch_serves_one_version`` — every request of one batch is
+      answered from the SAME model version: the flush's single
+      snapshot. This is the batched-equals-unbatched parity guarantee
+      under a delta hot-swap landing mid-batch ("a batch snapshots
+      exactly one version").
+    * ``no_request_lost_at_shutdown`` — once the batcher is stopped and
+      idle with an empty queue, no accepted request is still waiting:
+      every enqueued request got exactly one response (rows or busy).
+
+    Mutations: ``snapshot_per_flush=False`` re-reads the live model
+    reference at every pull sub-step instead of snapshotting once (the
+    seeded ``resnapshot_per_pull`` — a swap between two variable
+    groups' pulls hands one batch rows from two versions);
+    ``drain_on_shutdown=False`` discards the queue at shutdown without
+    responding (the seeded ``drop_queue_on_shutdown`` — accepted
+    requests hang forever).
+
+    Bounds: ``requests`` offers, ``queue_cap`` queue slots, ``swaps``
+    hot-swaps, one in-flight flush — exhaustive within the budget.
+    """
+    init: State = {"version": 0, "swaps_left": swaps,
+                   "accepting": True, "queue": (),
+                   "batcher": ("idle",), "mixed": False}
+    for i in range(requests):
+        init[f"q{i}"] = "new"          # new|queued|rejected|served
+        init[f"q{i}_ver"] = -1
+
+    actions: List[Action] = []
+
+    def offer_ok(i):
+        def guard(s):
+            return s[f"q{i}"] == "new" and s["accepting"] \
+                and len(s["queue"]) < queue_cap
+
+        def apply(s):
+            s[f"q{i}"] = "queued"
+            s["queue"] = s["queue"] + (i,)
+        return Action(f"offer_ok({i})", f"client{i}", guard, apply,
+                      syncs=("serving.batch.enqueue",))
+
+    def offer_busy(i):
+        def guard(s):
+            return s[f"q{i}"] == "new" and \
+                (not s["accepting"] or len(s["queue"]) >= queue_cap)
+
+        def apply(s):
+            s[f"q{i}"] = "rejected"     # the 429-busy response
+        return Action(f"offer_busy({i})", f"client{i}", guard, apply,
+                      syncs=("serving.batch.reject",))
+
+    for i in range(requests):
+        actions.append(offer_ok(i))
+        actions.append(offer_busy(i))
+
+    # -- the flush state machine -------------------------------------------
+    def collect_guard(s):
+        return s["batcher"] == ("idle",) and s["queue"] != ()
+
+    def collect_apply(s):
+        s["batcher"] = ("col", s["queue"])
+        s["queue"] = ()
+    actions.append(Action("collect", "batcher", collect_guard,
+                          collect_apply,
+                          syncs=("serving.batch.collect",)))
+
+    def snap_guard(s):
+        return s["batcher"][0] == "col"
+
+    def snap_apply(s):
+        # the ONE reference grab; the mutation defers reading to the
+        # pulls (snapshot value -1 = "no snapshot taken")
+        snap = s["version"] if snapshot_per_flush else -1
+        s["batcher"] = ("p0", s["batcher"][1], snap)
+    actions.append(Action("snapshot", "batcher", snap_guard, snap_apply,
+                          syncs=("serving.batch.snapshot",)))
+
+    def serve(s, members, snap):
+        ver = snap if snap >= 0 else s["version"]
+        for i in members:
+            s[f"q{i}"] = "served"
+            s[f"q{i}_ver"] = ver
+
+    def pull0_guard(s):
+        return s["batcher"][0] == "p0"
+
+    def pull0_apply(s):
+        _pc, batch, snap = s["batcher"]
+        serve(s, batch[:1], snap)       # first variable group
+        s["batcher"] = ("p1", batch, snap)
+    actions.append(Action("pull_group_a", "batcher", pull0_guard,
+                          pull0_apply, syncs=("serving.batch.pull",)))
+
+    def pull1_guard(s):
+        return s["batcher"][0] == "p1"
+
+    def pull1_apply(s):
+        _pc, batch, snap = s["batcher"]
+        serve(s, batch[1:], snap)       # remaining variable groups
+        vers = {s[f"q{i}_ver"] for i in batch}
+        if len(vers) > 1:
+            s["mixed"] = True
+        s["batcher"] = ("resp", batch)
+    actions.append(Action("pull_group_b", "batcher", pull1_guard,
+                          pull1_apply, syncs=("serving.batch.pull",)))
+
+    def resp_guard(s):
+        return s["batcher"][0] == "resp"
+
+    def resp_apply(s):
+        s["batcher"] = ("idle",)
+    actions.append(Action("respond", "batcher", resp_guard, resp_apply,
+                          syncs=("serving.batch.respond",)))
+
+    # -- hot-swap publisher (registry.apply_delta order) --------------------
+    def swap_guard(s):
+        return s["swaps_left"] > 0
+
+    def swap_apply(s):
+        s["swaps_left"] -= 1
+        s["version"] += 1
+    actions.append(Action("apply_delta", "publisher", swap_guard,
+                          swap_apply,
+                          syncs=("registry.find", "registry.swap.build",
+                                 "registry.swap.commit")))
+
+    # -- shutdown -----------------------------------------------------------
+    def stop_guard(s):
+        return s["accepting"]
+
+    def stop_apply(s):
+        s["accepting"] = False
+        if not drain_on_shutdown:
+            s["queue"] = ()             # mutated: accepted requests dropped
+    actions.append(Action("shutdown", "control", stop_guard, stop_apply,
+                          syncs=("serving.batch.shutdown",)))
+
+    # -- invariants ---------------------------------------------------------
+    def inv_one_version(s):
+        return not s["mixed"]
+
+    def inv_no_lost(s):
+        # stopped + idle + empty queue, yet an accepted request still
+        # waits: it will never be answered
+        if s["accepting"] or s["queue"] != () \
+                or s["batcher"] != ("idle",):
+            return True
+        return all(s[f"q{i}"] != "queued" for i in range(requests))
+
+    def is_done(s):
+        return s["batcher"] == ("idle",) and s["queue"] == () \
+            and all(s[f"q{i}"] in ("served", "rejected")
+                    for i in range(requests))
+
+    return make_model(
+        "serving_batcher", init, actions,
+        [("batch_serves_one_version", inv_one_version),
+         ("no_request_lost_at_shutdown", inv_no_lost)],
+        is_done,
+        notes="LookupBatcher bounded enqueue -> collect/snapshot/pull/"
+              "respond flush vs apply_delta hot-swaps and drain-on-"
+              "shutdown (serving/batcher.py)")
+
+
+# ---------------------------------------------------------------------------
 # shipped registry + schedule export
 # ---------------------------------------------------------------------------
 
 def shipped_models() -> List[Model]:
-    """The four shipped-protocol models the CLI checks exhaustively."""
-    return [delta_chain(), hot_swap(), dirty_tracker(), ha_registry()]
+    """The five shipped-protocol models the CLI checks exhaustively."""
+    return [delta_chain(), hot_swap(), dirty_tracker(), ha_registry(),
+            serving_batcher()]
 
 
 def sample_traces(model: Model, k: int = 2

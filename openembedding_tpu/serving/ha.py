@@ -83,6 +83,18 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
                    help="binary data-plane codec (''|zlib|zstd) — the "
                         "reference's server.message_compress; overrides "
                         "the config file")
+    p.add_argument("--batch-rows", type=int, default=None,
+                   help="arm the micro-batching lookup scheduler with "
+                        "this per-flush row cap (0 = unbatched; "
+                        "serving/batcher.py — concurrent flat lookups "
+                        "coalesce into one key-deduped pull)")
+    p.add_argument("--batch-wait-us", type=int, default=None,
+                   help="adaptive-flush wait budget in microseconds "
+                        "(the latency an idle server adds collecting "
+                        "batch-mates)")
+    p.add_argument("--batch-queue-rows", type=int, default=None,
+                   help="bounded batcher queue depth in rows; offers "
+                        "past it get 429-busy backpressure")
     p.add_argument("--trace-out", default="",
                    help="record graftscope spans and export them as "
                         "Chrome-trace JSON here on (SIGTERM/ctrl-C) "
@@ -114,6 +126,19 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
 
     mesh = create_mesh(1, len(jax.devices()))
     registry = ModelRegistry(mesh, default_hash_capacity=hash_capacity)
+    batch_rows = (args.batch_rows if args.batch_rows is not None
+                  else cfg.batch_rows)
+    if batch_rows > 0:
+        registry.enable_batching(
+            max_batch_rows=batch_rows,
+            max_wait_us=(args.batch_wait_us
+                         if args.batch_wait_us is not None
+                         else cfg.batch_wait_us),
+            max_queue_rows=(args.batch_queue_rows
+                            if args.batch_queue_rows is not None
+                            else cfg.batch_queue_rows))
+        print(f"replica: micro-batching armed (rows={batch_rows})",
+              flush=True)
     peers = [e for e in args.peers.split(",") if e]
     server = ControllerServer(registry, port=port, peers=peers,
                               compress=compress).start()
@@ -132,6 +157,14 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
         if peers:
             n = restore_from_peers(registry, peers, compress=compress)
             print(f"replica: restored {n} model(s) from peers", flush=True)
+
+        if batch_rows > 0:
+            # compile the batched pull programs BEFORE declaring ready:
+            # the first storm must measure steady state, not XLA
+            # compiles (one program per pow2 flush bucket x key dtype)
+            n = registry.warm_batch_programs()
+            print(f"replica: warmed {n} batched pull program(s)",
+                  flush=True)
 
         print("replica: ready", flush=True)
         while True:
@@ -379,7 +412,11 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
                   shard_index: int = 0,
                   shard_count: int = 1,
                   compress: str = "",
-                  trace_out: str = "") -> subprocess.Popen:
+                  trace_out: str = "",
+                  batch_rows: int = 0,
+                  batch_wait_us: Optional[int] = None,
+                  batch_queue_rows: Optional[int] = None
+                  ) -> subprocess.Popen:
     """Start a replica daemon as a child process (test/driver helper)."""
     cmd = [sys.executable, "-m", "openembedding_tpu.serving.ha",
            "--port", str(port)]
@@ -387,6 +424,12 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
         cmd += ["--compress", compress]
     if trace_out:
         cmd += ["--trace-out", trace_out]
+    if batch_rows:
+        cmd += ["--batch-rows", str(batch_rows)]
+        if batch_wait_us is not None:
+            cmd += ["--batch-wait-us", str(batch_wait_us)]
+        if batch_queue_rows is not None:
+            cmd += ["--batch-queue-rows", str(batch_queue_rows)]
     for item in load:
         cmd += ["--load", item]
     if peers:
@@ -594,6 +637,7 @@ class RoutingClient:
         start = random.randrange(len(order))
         order = order[start:] + order[:start]
         last_err: Optional[Exception] = None
+        busy429: Optional[Exception] = None
         for i, ep in enumerate(order):
             sync_point("routing.attempt")
             t0 = time.perf_counter()
@@ -603,12 +647,16 @@ class RoutingClient:
             # else every 404 would read as a dead replica
             except urllib.error.HTTPError as e:
                 dt = time.perf_counter() - t0
-                if e.code in (409, 503):  # CREATING etc: try another replica
+                # 409/503: CREATING etc; 429: batcher queue full — THIS
+                # replica is oversubscribed, another may have headroom
+                if e.code in (409, 429, 503):  # busy: try another replica
                     scope.record_span("serving.rpc", t0, dt,
                                       {"replica": ep, "outcome": "busy"},
                                       error=f"HTTP{e.code}")
                     scope.HISTOGRAMS.inc("serving_request_retries")
                     last_err = e
+                    if e.code == 429:
+                        busy429 = e
                     continue
                 scope.record_span("serving.rpc", t0, dt,
                                   {"replica": ep, "outcome": "error"},
@@ -629,6 +677,16 @@ class RoutingClient:
                               {"replica": ep,
                                "outcome": "ok" if i == 0 else "ok_failover"})
             return out
+        if busy429 is not None:
+            # SOME replica rejected with batcher backpressure (even if
+            # the others were dead — the chaos + backpressure mix):
+            # surface the 429 itself, not a dead-replica error — the
+            # caller (graftload) must count a rejection, and a retrying
+            # client should back off, not failover-probe. Tracked on
+            # its own flag: last_err holds whichever replica failed
+            # LAST in rotation order, which under a mixed storm is a
+            # coin flip between the dead one and the busy one.
+            raise busy429
         raise ConnectionError(
             f"no live replica among {self.endpoints}: {last_err}")
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
@@ -71,6 +71,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.oe_pull_weights.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
         ctypes.POINTER(ctypes.c_float)]
+    lib.oe_model_version.restype = ctypes.c_int64
+    lib.oe_model_version.argtypes = [ctypes.c_void_p]
+    lib.oe_pull_weights_gather.restype = ctypes.c_int
+    lib.oe_pull_weights_gather.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float)]
     return lib
 
 
@@ -100,6 +107,14 @@ class NativeModel:
         return self._lib.oe_model_sign(self._model).decode()
 
     @property
+    def version(self) -> int:
+        """Delta-chain seq this load replayed up to (0 for plain full
+        dumps) — ``checkpoint_delta.applied_seq`` semantics: the native
+        reader resolves ``delta_manifest`` chains directly at open, so
+        a delta-compacted dir serves WITHOUT a prior full save."""
+        return int(self._lib.oe_model_version(self._model))
+
+    @property
     def num_variables(self) -> int:
         return self._lib.oe_model_num_variables(self._model)
 
@@ -118,6 +133,15 @@ class NativeModel:
     def variable_vocab(self, variable) -> int:
         return self._lib.oe_variable_vocab(self._var(variable))
 
+    @staticmethod
+    def _join_keys(arr: np.ndarray) -> np.ndarray:
+        """Wide [..., 2] int32 pairs -> joined 64-bit values (the native
+        index is keyed by joined ids); other arrays pass through."""
+        if arr.ndim >= 2 and arr.shape[-1] == 2 and arr.dtype == np.int32:
+            from .. import hash_table as hash_lib
+            return hash_lib.join64(arr)
+        return arr
+
     def lookup(self, variable, keys: Sequence[int]) -> np.ndarray:
         """Read-only pull: [n] keys -> [n, dim] float32 rows (missing/
         invalid keys -> zero rows). Wide [n, 2] int32 pair keys (the
@@ -135,11 +159,7 @@ class NativeModel:
         # and both paths must feed the same units into one series
         from ..utils.observability import record_serving_lookup
         record_serving_lookup(name, arr.size)
-        if arr.ndim >= 2 and arr.shape[-1] == 2 and arr.dtype == np.int32:
-            # wide pairs of ANY batch shape ([n, 2], [B, F, 2], ...):
-            # join over the last axis
-            from .. import hash_table as hash_lib
-            arr = hash_lib.join64(arr)
+        arr = self._join_keys(arr)
         k = np.ascontiguousarray(arr.astype(np.int64).ravel())
         out = np.zeros((k.size, dim), np.float32)
         # request-scoped span: the native leg of a traced serving
@@ -154,3 +174,69 @@ class NativeModel:
             raise RuntimeError(self._lib.oe_last_error().decode())
         # batch shape AFTER the join: pair inputs collapse their last axis
         return out.reshape(arr.shape + (dim,))
+
+    def pull_gather(self, variable, unique_keys: np.ndarray,
+                    gather: np.ndarray) -> np.ndarray:
+        """The batched C entry point (``oe_pull_weights_gather``): each
+        UNIQUE key probes the native index exactly once, rows scatter
+        to ``out[i] = row(unique_keys[gather[i]])`` in one call — the
+        micro-batcher's data plane on the mmap path."""
+        v = self._var(variable)
+        dim = self._lib.oe_variable_dim(v)
+        name = self._lib.oe_variable_name(v).decode()
+        uniq = np.ascontiguousarray(
+            self._join_keys(np.asarray(unique_keys))
+            .astype(np.int64).ravel())
+        gidx = np.ascontiguousarray(np.asarray(gather, np.int64).ravel())
+        out = np.zeros((gidx.size, dim), np.float32)
+        with scope.span("serving.native_lookup_batched", table=name):
+            rc = self._lib.oe_pull_weights_gather(
+                v, uniq.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                uniq.size,
+                gidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                gidx.size,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(self._lib.oe_last_error().decode())
+        return out
+
+    def lookup_batched(self, variable, requests) -> list:
+        """Resolve SEVERAL flat key arrays with ONE deduped native call:
+        concatenate, dedup, one ``oe_pull_weights_gather``, split rows
+        back per request. The in-process coalescing primitive the
+        native micro-batcher flushes through."""
+        from . import batcher as batcher_mod
+        from ..utils.observability import record_serving_lookup
+        name = (variable if isinstance(variable, str)
+                else self._lib.oe_variable_name(
+                    self._var(variable)).decode())
+        arrs = [np.asarray(r) for r in requests]
+        for a in arrs:
+            record_serving_lookup(name, a.size)
+        joined = [self._join_keys(a) for a in arrs]
+        cat = np.concatenate([j.astype(np.int64).ravel()
+                              for j in joined]) if joined \
+            else np.zeros(0, np.int64)
+        uniq, inverse = batcher_mod.dedup_keys(cat)
+        rows = self.pull_gather(name, uniq, inverse)
+        out = []
+        off = 0
+        for j in joined:
+            n = int(np.prod(j.shape, dtype=np.int64)) if j.ndim else 1
+            out.append(rows[off:off + n]
+                       .reshape(j.shape + (rows.shape[1],)))
+            off += n
+        return out
+
+    def make_batcher(self, **cfg) -> "Any":
+        """A :class:`~..serving.batcher.LookupBatcher` over this model:
+        concurrent native lookups coalesce into one
+        ``oe_pull_weights_gather`` per flush. The mmap view is
+        immutable after open, so the snapshot hook is trivial."""
+        from .batcher import LookupBatcher
+
+        def _pull_scatter(_snap, name, uniq, inverse):
+            return self.pull_gather(name, uniq, inverse)
+
+        return LookupBatcher(self.sign or "native", lambda: None,
+                             None, pull_scatter=_pull_scatter, **cfg)

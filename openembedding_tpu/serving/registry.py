@@ -21,6 +21,7 @@ Capability parity with the reference's serving plane (SURVEY §3.5):
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import traceback
 from typing import Any, Dict, List, Optional
@@ -144,17 +145,64 @@ class ServingModel:
         """
         name = (variable if isinstance(variable, str)
                 else self._by_id[int(variable)])
+        # ONE reference grab = one consistent version: a concurrent
+        # apply_delta publishes a whole NEW states dict (never mutates
+        # this one), so every row this lookup returns comes from exactly
+        # one version — the swap-during-lookup interleaving schedule
+        # pins this (tests/test_delta_checkpoint.py)
+        states = self.states
+        sync_point("serving.lookup.snapshot")
+        return self._lookup_impl(name, indices, states)
+
+    def batchable(self, variable: Any, indices) -> Optional[str]:
+        """The variable NAME when this query can ride the micro-batcher
+        (a FLAT row-semantics query: narrow ``[n]`` ids, or ``[n, 2]``
+        pairs on a wide spec), else None. Sequence/pooled queries fall
+        through to the direct path — batching concatenates key streams,
+        which only preserves responses bit-identically for one-row-per-
+        key semantics."""
+        name = (variable if isinstance(variable, str)
+                else self._by_id.get(int(variable)))
+        if name is None or name not in self.collection.specs:
+            return None
+        spec = self.collection.specs[name]
+        idx = np.asarray(indices)
+        if not np.issubdtype(idx.dtype, np.integer):
+            return None
+        if idx.ndim == 1:
+            return name
+        # pair queries batch only in the router's wire form (int32
+        # words): dedup_keys joins pairs via hash_table.join64, whose
+        # uint32 word view rejects 64-bit-typed columns — those fall
+        # through to the direct path, which widens them itself
+        if idx.ndim == 2 and idx.shape[-1] == 2 and spec.use_hash \
+                and spec.key_dtype == "wide" and idx.dtype == np.int32:
+            return name
+        return None
+
+    def _lookup_impl(self, name: str, indices, states,
+                     record: bool = True, span: bool = True) -> jnp.ndarray:
+        """The pull against an EXPLICIT states snapshot — shared by the
+        direct path (which snapshots per lookup) and the micro-batcher
+        (ONE snapshot per flush covers every member request;
+        ``record=False`` there — the batcher records per-REQUEST sizes
+        at enqueue, so the deduped batch pull must not double-count).
+        ``span=False`` suppresses the serving.lookup span: warm-up
+        compiles must not land boot-time XLA compile latencies in the
+        serving histograms."""
         spec = self.collection.specs[name]
         # serving-side batch stats: lookup-size histogram (always on)
         # + the gated uniqueness counters, through the same machinery
         # the training pull uses (record_batch_stats) — both land on
         # /metrics and in the graftscope distribution listing
         from ..utils import observability
-        observability.record_serving_lookup(
-            name, getattr(indices, "size", None)
-            or np.asarray(indices).size)
-        if observability.evaluate_performance():
-            observability.record_batch_stats({name: np.asarray(indices)})
+        if record:
+            observability.record_serving_lookup(
+                name, getattr(indices, "size", None)
+                or np.asarray(indices).size)
+            if observability.evaluate_performance():
+                observability.record_batch_stats(
+                    {name: np.asarray(indices)})
         idx = jnp.asarray(indices)
         # narrow id columns address wide tables via the same widening
         # bridge the training pull uses; pair_ndim=2 so the serving wire's
@@ -186,14 +234,9 @@ class ServingModel:
                 from .. import hash_table as hash_lib
                 empty = hash_lib.empty_key(idx.dtype)
                 idx = jnp.where(idx % G == k, idx, empty)
-        # ONE reference grab = one consistent version: a concurrent
-        # apply_delta publishes a whole NEW states dict (never mutates
-        # this one), so every row this lookup returns comes from exactly
-        # one version — the swap-during-lookup interleaving schedule
-        # pins this (tests/test_delta_checkpoint.py)
-        states = self.states
-        sync_point("serving.lookup.snapshot")
-        with scope.span("serving.lookup", table=name):
+        ctx = (scope.span("serving.lookup", table=name) if span
+               else contextlib.nullcontext())
+        with ctx:
             rows = self.collection.pull(states, {name: idx},
                                         batch_sharded=False,
                                         read_only=True,
@@ -253,6 +296,11 @@ class ModelRegistry:
         # by close() so shutdown quiesces instead of relying on daemon
         # teardown killing a loader mid-commit
         self._loaders: Dict[str, threading.Thread] = {}
+        # micro-batching (serving/batcher.py): enable_batching arms the
+        # config; per-model batchers are created lazily on first batched
+        # lookup and drained at delete/close
+        self._batch_cfg: Optional[Dict[str, Any]] = None
+        self._batchers: Dict[str, Any] = {}
         from ..utils import observability
         observability.register_memory_source("serving", "registry", self)
 
@@ -331,6 +379,14 @@ class ModelRegistry:
                     self._models[sign] = model
                     self._status[sign]["model_status"] = ModelStatus.NORMAL
                     self._status[sign]["version"] = model.version
+                # a same-sign RELOAD replaced the model object: drain
+                # the replaced model's batcher so its closures stop
+                # pinning the old states (_batcher_for also refuses to
+                # hand out a batcher bound to a replaced model, so this
+                # is resource hygiene, not correctness). keep_model
+                # spares a batcher a racing lookup already bound to
+                # the NEW model.
+                self._close_batchers([sign], keep_model=model)
             except Exception as e:  # noqa: BLE001 — recorded, not swallowed
                 with self._lock:
                     self._status[sign]["model_status"] = ModelStatus.ERROR
@@ -365,6 +421,186 @@ class ModelRegistry:
                 t.start()
         return sign
 
+    # --- micro-batched lookups (serving/batcher.py) ------------------------
+    def enable_batching(self, *, max_batch_rows: int = 0,
+                        max_wait_us: Optional[int] = None,
+                        max_queue_rows: int = 0,
+                        timeout: float = 30.0) -> None:
+        """Arm the micro-batching lookup scheduler: concurrent flat
+        lookups against one model coalesce into ONE key-deduped batched
+        pull per flush (``serving/batcher.py``; zero/None keeps the
+        batcher default — an EXPLICIT ``max_wait_us=0`` is honored:
+        flush immediately, coalescing only what is already queued).
+        Responses stay bit-identical to unbatched lookups — each flush
+        snapshots exactly one model version (graftproto
+        ``serving_batcher``). Call before serving traffic; the REST
+        plane routes through :meth:`lookup` automatically."""
+        from . import batcher as batcher_mod
+        cfg = {"max_batch_rows": max_batch_rows
+               or batcher_mod.DEFAULT_MAX_BATCH_ROWS,
+               "max_wait_us": batcher_mod.DEFAULT_MAX_WAIT_US
+               if max_wait_us is None else max_wait_us,
+               "max_queue_rows": max_queue_rows
+               or batcher_mod.DEFAULT_MAX_QUEUE_ROWS,
+               "timeout": timeout}
+        with self._lock:
+            self._batch_cfg = cfg
+
+    @property
+    def batching_enabled(self) -> bool:
+        with self._lock:
+            return self._batch_cfg is not None
+
+    def _batcher_for(self, sign: str, model: ServingModel):
+        """This sign's batcher, created lazily under the registry lock
+        and bound to ONE ServingModel object (the flusher thread starts
+        at construction; pulls read the model's PUBLISHED state
+        reference once per flush, so apply_delta hot-swaps keep working
+        untouched — but a same-sign model REPLACEMENT via
+        create_model/register_model gets a fresh batcher, the stale one
+        drained: its closures capture the replaced model and would
+        serve the old checkpoint's rows forever)."""
+        from . import batcher as batcher_mod
+        stale = None
+        try:
+            with self._lock:
+                entry = self._batchers.get(sign)
+                if entry is not None:
+                    if entry[0] is model:
+                        return entry[1]
+                    stale = self._batchers.pop(sign)[1]
+                # only LIVE models get a (re)created batcher: a lookup
+                # racing delete_model must not resurrect a flusher
+                # thread for the deleted sign (it would pin the dead
+                # model's states until close())
+                if self._batch_cfg is None \
+                        or self._models.get(sign) is not model:
+                    return None
+                b = self._make_batcher(sign, model, self._batch_cfg)
+                self._batchers[sign] = (model, b)
+                return b
+        finally:
+            if stale is not None:
+                # outside the registry lock: the drain flush pulls
+                # against the old model's snapshot (device work)
+                stale.close()
+
+    def _make_batcher(self, sign: str, model: ServingModel, cfg):
+        from . import batcher as batcher_mod
+
+        def _snap(model=model):
+            # the flush's one reference grab — the same discipline
+            # ServingModel.lookup pins per single lookup
+            return model.states
+
+        def _pull(states, name, uniq, model=model):
+            # BUCKET the unique count to powers of two before the
+            # jitted pull: every distinct shape is its own XLA
+            # compile, and raw dedup counts vary per flush — the
+            # first measured storm spent its whole window compiling
+            # hundreds of one-off programs. Padding repeats the
+            # last key (a read-only gather makes duplicates free)
+            # and the pad rows are sliced off before the scatter.
+            n = int(uniq.shape[0])
+            if n:
+                # floor 64: small flushes share one shape; see
+                # warm_batch_programs for the boot-time compile
+                cap = 1 << max(6, (n - 1).bit_length())
+                if cap != n:
+                    uniq = np.concatenate(
+                        [uniq, np.repeat(uniq[-1:], cap - n, axis=0)])
+            rows = np.asarray(model._lookup_impl(
+                name, uniq, states, record=False), np.float32)
+            return rows[:n]
+
+        return batcher_mod.LookupBatcher(sign, _snap, _pull, **cfg)
+
+    def warm_batch_programs(self, *, dtypes=("int32", "int64")) -> int:
+        """Pre-compile the batched pull programs every NORMAL model's
+        flushes will dispatch (each power-of-two bucket x key dtype is
+        one XLA program): a serving daemon warms at boot so the first
+        storm measures STEADY-state latency, not compile stalls.
+        Returns the number of programs warmed. No-op unless batching
+        is armed."""
+        with self._lock:
+            cfg = self._batch_cfg
+            models = list(self._models.values())
+        if cfg is None:
+            return 0
+        n = 0
+        for model in models:
+            states = model.states
+            for name, spec in model.collection.specs.items():
+                wide = spec.use_hash and spec.key_dtype == "wide"
+                cap = 64
+                while True:
+                    # wide tables serve BOTH int32 pair queries and
+                    # narrow joined-id queries (the widening bridge),
+                    # and batchable routes both to the batcher — warm
+                    # every program the flushes can dispatch
+                    for dt in dtypes:
+                        model._lookup_impl(name,
+                                           np.zeros(cap, np.dtype(dt)),
+                                           states, record=False,
+                                           span=False)
+                        n += 1
+                    if wide:
+                        model._lookup_impl(name,
+                                           np.zeros((cap, 2), np.int32),
+                                           states, record=False,
+                                           span=False)
+                        n += 1
+                    if cap >= cfg["max_batch_rows"]:
+                        break
+                    cap <<= 1
+        return n
+
+    def lookup(self, sign: str, variable: Any, indices) -> np.ndarray:
+        """Serve one lookup, micro-batched when armed and the query is
+        flat (row semantics); sequence/pooled queries and disabled
+        batching fall through to the direct ``ServingModel.lookup``.
+        Raises ``batcher.BusyError`` when the bounded queue rejects the
+        offer (REST maps it to 429-busy)."""
+        model = self.find_model(sign)
+        idx = np.asarray(indices)
+        with self._lock:
+            cfg = self._batch_cfg
+        name = model.batchable(variable, idx) if cfg is not None else None
+        # oversized single requests bypass the batcher: they would
+        # flush alone into a pow2 bucket ABOVE the warmed ladder (an
+        # un-warmed XLA compile in the serving path); the direct pull
+        # compiles per raw shape exactly as the unbatched plane always
+        # has, so they are no worse off there
+        if name is not None and int(idx.shape[0]) <= cfg["max_batch_rows"]:
+            b = self._batcher_for(sign, model)
+            if b is not None:
+                return b.lookup(name, idx)
+            # batching disarmed/closed between the check and the
+            # batcher fetch (registry.close racing a request): the
+            # direct path below stays correct
+        return model.lookup(variable, idx)
+
+    def _close_batchers(self, signs=None, keep_model=None) -> None:
+        """Drain + drop batchers. ``keep_model`` protects a batcher
+        already bound to that model object: a reload's post-publish
+        cleanup must not close the fresh batcher a concurrent lookup
+        just created for the NEW model (it would answer live requests
+        with spurious busy rejections)."""
+        with self._lock:
+            if signs is None:
+                entries, self._batchers = list(self._batchers.values()), {}
+            else:
+                entries = []
+                for s in signs:
+                    entry = self._batchers.get(s)
+                    if entry is None or entry[0] is keep_model:
+                        continue
+                    entries.append(self._batchers.pop(s))
+        for _model, b in entries:
+            # outside the registry lock: close() drains the queue, and
+            # a drain flush pulls against the model (device work)
+            b.close()
+
     def join_loads(self, timeout: float = 60.0) -> None:
         """Wait for every outstanding async ``create_model`` load thread
         (per-thread ``timeout`` seconds; a stuck loader is abandoned, not
@@ -377,8 +613,15 @@ class ModelRegistry:
 
     def close(self, timeout: float = 60.0) -> None:
         """Quiesce the registry: join async loaders so shutdown never
-        relies on daemon teardown killing one mid-commit."""
+        relies on daemon teardown killing one mid-commit, and drain
+        every model's micro-batcher (accepted requests get their
+        response; later offers reject as busy). Batching disarms so a
+        straggler lookup cannot resurrect a flusher thread after the
+        quiesce."""
         self.join_loads(timeout)
+        with self._lock:
+            self._batch_cfg = None
+        self._close_batchers()
 
     def register_model(self, model: ServingModel, *,
                        replica_num: int = 3) -> str:
@@ -395,6 +638,9 @@ class ModelRegistry:
                 "shard_index": ss[0], "shard_count": ss[1],
                 "version": model.version,
             }
+        # drain any batcher bound to a model this install replaced
+        # (same hygiene as the create_model reload path)
+        self._close_batchers([model.sign], keep_model=model)
         return model.sign
 
     def apply_delta(self, sign: str, delta) -> Dict[str, Any]:
@@ -456,6 +702,9 @@ class ModelRegistry:
             self._status[sign]["model_status"] = ModelStatus.DELETING
             self._models.pop(sign, None)
             del self._status[sign]
+        # drain this model's batcher AFTER the status flip: in-flight
+        # flushes finish against their snapshot, new offers reject
+        self._close_batchers([sign])
 
     def find_model(self, sign: str) -> ServingModel:
         """NORMAL-status model or error — the find_model_variable gate

@@ -33,6 +33,7 @@ _NULL_CTX = contextlib.nullcontext
 import numpy as np
 
 from ..analysis import scope
+from .batcher import BusyError
 from .registry import ModelRegistry
 
 DEFAULT_PORT = 8010
@@ -285,9 +286,11 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 m = re.fullmatch(r"/models/([^/]+)/lookup", self.path)
                 if m:
                     req = self._body()
-                    model = registry.find_model(m.group(1))
-                    rows = model.lookup(
-                        req["variable"],
+                    # registry.lookup: micro-batched when armed (flat
+                    # queries coalesce into one deduped pull), direct
+                    # otherwise — responses bit-identical either way
+                    rows = registry.lookup(
+                        m.group(1), req["variable"],
                         np.asarray(req["indices"], dtype=np.int64
                                    if req.get("int64") else np.int32))
                     return self._send(200, {"rows": np.asarray(rows).tolist()})
@@ -320,9 +323,9 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                     idx = np.frombuffer(
                         raw[nl + 1:],
                         dtype=np.dtype(head["dtype"])).reshape(shape)
-                    model = registry.find_model(m.group(1))
-                    rows = np.asarray(model.lookup(head["variable"], idx),
-                                      dtype=np.float32)
+                    rows = np.asarray(
+                        registry.lookup(m.group(1), head["variable"], idx),
+                        dtype=np.float32)
                     rhead = {"shape": list(rows.shape)}
                     body = rows.tobytes()
                     if compress and compress in head.get(
@@ -341,6 +344,13 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 self._send(404, {"error": "not found"})
             except (KeyError, ValueError) as e:
                 self._send(400, {"error": str(e)})
+            except BusyError as e:
+                # bounded-queue backpressure (batcher.BusyError, a
+                # RuntimeError subclass — caught FIRST): the offer was
+                # REJECTED, counted, and the client should back off or
+                # try another replica; accepted requests are unaffected
+                scope.HISTOGRAMS.inc("serving_rejected_requests")
+                self._send(429, {"error": str(e)})
             except RuntimeError as e:
                 self._send(409, {"error": str(e)})
             except Exception as e:  # noqa: BLE001

@@ -153,6 +153,15 @@ _check(OffloadConfig, "persist_compress", _persist_codec_ok,
        "deflate-only)")
 
 
+# micro-batcher sizing defaults — the ONE home (serving/batcher.py and
+# tools/graftload.py import these, so retuning here retunes every
+# surface): sized from the measured serving_lookup_rows distribution
+# (README "Serving load & SLO gate" tuning guidance)
+DEFAULT_BATCH_ROWS = 1024
+DEFAULT_BATCH_WAIT_US = 200
+DEFAULT_BATCH_QUEUE_ROWS = 1 << 15
+
+
 @dataclasses.dataclass(frozen=True)
 class ServingConfig:
     """Serving controller defaults (serving/; reference controller.cc
@@ -165,6 +174,16 @@ class ServingConfig:
     # ""|zlib|zstd — the reference's server.message_compress
     # (client/EnvConfig.cpp:27-34)
     message_compress: str = ""
+    # micro-batching lookup scheduler (serving/batcher.py): 0 disables;
+    # > 0 arms the per-model batcher with this row cap per flush. Tune
+    # from the serving_lookup_rows histogram (README "Serving load &
+    # SLO gate"): batch_rows ~ a few x the p99 request size times the
+    # concurrency you want coalesced; batch_wait_us bounds the latency
+    # an idle server adds waiting for batch-mates
+    batch_rows: int = 0
+    batch_wait_us: int = DEFAULT_BATCH_WAIT_US
+    # bounded queue depth in ROWS — offers past it get 429-busy
+    batch_queue_rows: int = DEFAULT_BATCH_QUEUE_ROWS
 
     def __post_init__(self):
         _validate(self)
@@ -176,6 +195,10 @@ _check(ServingConfig, "replica_num", lambda v: v >= 1, "must be >= 1")
 _check(ServingConfig, "hash_capacity", lambda v: v > 0, "must be > 0")
 _check(ServingConfig, "message_compress", _compress_ok,
        "must be a known, available codec ('', 'zlib', 'zstd')")
+_check(ServingConfig, "batch_rows", lambda v: v >= 0,
+       "must be >= 0 (0 disables micro-batching)")
+_check(ServingConfig, "batch_wait_us", lambda v: v >= 0, "must be >= 0")
+_check(ServingConfig, "batch_queue_rows", lambda v: v > 0, "must be > 0")
 
 
 @dataclasses.dataclass(frozen=True)

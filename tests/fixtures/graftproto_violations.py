@@ -57,6 +57,17 @@ MUTATIONS = [
      "normal_status_implies_model_installed",
      "publishing status=NORMAL before installing the model object: "
      "find_model hands a lookup a missing model inside the window"),
+    ("resnapshot_per_pull", "serving_batcher",
+     {"snapshot_per_flush": False},
+     "batch_serves_one_version",
+     "re-reading the live model reference at every per-variable pull "
+     "instead of snapshotting once per flush: a hot-swap landing "
+     "between two groups' pulls answers one batch from two versions"),
+    ("drop_queue_on_shutdown", "serving_batcher",
+     {"drain_on_shutdown": False},
+     "no_request_lost_at_shutdown",
+     "shutdown discarding the accepted queue instead of draining it: "
+     "enqueued requests never get their response and hang forever"),
 ]
 
 

@@ -146,18 +146,26 @@ _FP = "cpu8-test-c2"
 _DEV = {"platform": "cpu", "n_devices": 8, "device_kind": "cpu"}
 
 
-def _serving_record(ts, qps=200.0, p99=8.0):
+def _serving_record(ts, qps=200.0, p99=8.0, batched=False):
+    kwargs = {}
+    config = {"source": "graftload", "qps": 200.0, "duration": 5.0,
+              "batch": 16, "workers": 32, "path": "both",
+              "replicas": 2, "sweep": False, "chaos": False}
+    if batched:
+        config["batched"] = True
+        kwargs = {"rejected": 3,
+                  "batch_stats": {"batch_flushes": 120.0,
+                                  "batch_requests": 400.0,
+                                  "batch_rows": 6400.0,
+                                  "batch_unique_rows": 5200.0}}
     return gw.make_serving_record(
         routes={"rest": {"calls": 400, "p50_ms": 2.0, "p95_ms": 5.0,
                          "p99_ms": p99},
                 "native": {"calls": 400, "p50_ms": 0.5, "p95_ms": 1.0,
                            "p99_ms": 2.0}},
         offered_qps=qps * 1.02, achieved_qps=qps, errors=0, replicas=2,
-        qps_band=(qps * 0.9, qps * 1.1),
-        config={"source": "graftload", "qps": 200.0, "duration": 5.0,
-                "batch": 16, "workers": 32, "path": "both",
-                "replicas": 2, "sweep": False, "chaos": False},
-        fingerprint=_FP, device=_DEV, ts=ts)
+        qps_band=(qps * 0.9, qps * 1.1), config=config,
+        fingerprint=_FP, device=_DEV, ts=ts, **kwargs)
 
 
 def test_serving_record_schema_roundtrip():
@@ -168,12 +176,31 @@ def test_serving_record_schema_roundtrip():
     assert rec["scope"]["rest"]["p99_ms"] == 8.0
 
 
+def test_serving_record_batched_stats_roundtrip():
+    """The batched arm's record carries the backpressure/coalescing
+    stats and stays schema-valid; its config keys a SEPARATE baseline
+    group from the unbatched arm."""
+    rec = _serving_record("2026-08-01T00:00:00+00:00", batched=True)
+    assert gw.validate_record(rec) == []
+    assert rec["serving"]["rejected"] == 3
+    assert rec["serving"]["batch"]["batch_flushes"] == 120.0
+    plain = _serving_record("2026-08-01T00:00:00+00:00")
+    assert gw._group_key(rec) != gw._group_key(plain)
+
+
 @pytest.mark.parametrize("mutate,fragment", [
     (lambda r: r["serving"].pop("achieved_qps"), "achieved_qps"),
     (lambda r: r["serving"].update(offered_qps=-1), "offered_qps"),
     (lambda r: r["serving"].update(errors=-2), "errors"),
     (lambda r: r["serving"].update(replicas=0), "replicas"),
     (lambda r: r["scope"]["rest"].update(p99_ms="fast"), "p99_ms"),
+    (lambda r: r["serving"].update(rejected=-1), "rejected"),
+    (lambda r: r["serving"].update(rejected=True), "rejected"),
+    (lambda r: r["serving"].update(batch="lots"), "batch"),
+    (lambda r: r["serving"].update(batch={"batch_rows": -4.0}),
+     "batch.batch_rows"),
+    (lambda r: r["serving"].update(batch={"batch_rows": "many"}),
+     "batch.batch_rows"),
 ])
 def test_serving_record_schema_lists_problems(mutate, fragment):
     rec = _serving_record("2026-08-01T00:00:00+00:00")

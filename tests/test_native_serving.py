@@ -6,6 +6,7 @@ this framework's checkpoint format. Ground truth here is the Python
 registry's read-only pull on the same checkpoint.
 """
 
+import os
 import shutil
 
 import numpy as np
@@ -192,3 +193,159 @@ def test_native_wide_key_dump(native_lib, tmp_path, devices8):
     # unknown 64-bit key -> zero row; lo-word collision stays distinct
     got2 = m.lookup("w", np.asarray([17 + (1 << 35)], np.int64))
     np.testing.assert_array_equal(got2, 0.0)
+
+
+# --- delta-compacted dirs served directly (ISSUE 14 satellite) ---------------
+
+def _delta_dir(tmp_path, devices8, steps=2, name="d"):
+    """Armed chain + ``steps`` committed deltas (compaction budgets
+    lifted — these tests need the CHAIN on disk; the tiny test base
+    would otherwise trip the bytes-ratio fold immediately); returns
+    (coll, per-step (states, hash-probe) list, path)."""
+    import openembedding_tpu.checkpoint_delta as cd
+    from test_delta_checkpoint import make_coll, train
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / name)
+    ckpt.save_checkpoint(path, coll, states, model_sign=f"delta-{name}")
+    per_step = []
+    for i in range(steps):
+        states, idx = train(coll, states, seed=i)
+        info = cd.save_delta(path, coll, states, step=i + 1,
+                             compact_chain_len=1000,
+                             compact_bytes_ratio=1000.0)
+        assert info["seq"] == i + 1
+        per_step.append((states, np.asarray(idx["hsh"])))
+    return coll, per_step, path
+
+
+def _native_vs_python(m, coll, states, hkeys, vocab=256):
+    """Native rows must EXACTLY match the python pull on ``states``."""
+    probe = np.concatenate([np.arange(vocab), [-1, vocab, 10**7]])
+    gt_ids = np.where((probe < 0) | (probe >= vocab), -1, probe)
+    want = np.asarray(coll.pull(
+        states, {"arr": jnp.asarray(gt_ids.astype(np.int32))},
+        batch_sharded=False, read_only=True)["arr"], np.float32)
+    np.testing.assert_array_equal(
+        m.lookup("arr", probe).astype(np.float32), want)
+    want_h = np.asarray(coll.pull(
+        states, {"hsh": jnp.asarray(hkeys)}, batch_sharded=False,
+        read_only=True)["hsh"], np.float32)
+    np.testing.assert_array_equal(
+        m.lookup("hsh", hkeys.astype(np.int64)).astype(np.float32),
+        want_h)
+
+
+def test_native_reads_delta_chain_directly(native_lib, tmp_path,
+                                           devices8):
+    """The zero-JAX mmap path resolves delta_manifest chains at open:
+    rows equal the python ``load_checkpoint`` replay of the same chain
+    (which is bit-identical to a full save of the live state), and the
+    reported version is the applied chain seq."""
+    from test_delta_checkpoint import make_coll
+    from openembedding_tpu.serving.native import NativeModel
+    coll, per_step, path = _delta_dir(tmp_path, devices8)
+    states, hkeys = per_step[-1]
+    with NativeModel(path, native_lib) as m:
+        assert m.version == 2
+        _native_vs_python(m, coll, states, hkeys)
+        # ... and equal to the python loader's replay of the SAME chain
+        coll2 = make_coll(create_mesh(2, 4, devices8), track=False)
+        loaded = ckpt.load_checkpoint(path, coll2)
+        want = np.asarray(coll2.pull(
+            loaded, {"arr": jnp.arange(256, dtype=jnp.int32)},
+            batch_sharded=False, read_only=True)["arr"], np.float32)
+        np.testing.assert_array_equal(
+            m.lookup("arr", np.arange(256)).astype(np.float32), want)
+
+
+def test_native_delta_after_compaction(native_lib, tmp_path, devices8):
+    """A compacted chain (folded base, empty chain, content_seq) serves
+    the same rows at the same version."""
+    import openembedding_tpu.checkpoint_delta as cd
+    from openembedding_tpu.serving.native import NativeModel
+    coll, per_step, path = _delta_dir(tmp_path, devices8)
+    states, hkeys = per_step[-1]
+    out = cd.compact(path, background=False)
+    assert out["compacted"]
+    with NativeModel(path, native_lib) as m:
+        assert m.version == 2          # content_seq carries the version
+        _native_vs_python(m, coll, states, hkeys)
+
+
+def test_native_delta_torn_final_recovers(native_lib, tmp_path,
+                                          devices8):
+    """Torn FINAL delta: recover to the last complete delta (version
+    and rows of seq 1 — matching load_checkpoint); torn MIDDLE: the
+    load fails loudly."""
+    import glob as glob_mod
+    from openembedding_tpu.serving.native import NativeModel
+    coll, per_step, path = _delta_dir(tmp_path, devices8)
+    states1, hkeys1 = per_step[0]
+    for f in glob_mod.glob(os.path.join(path, "delta_000002_*")):
+        with open(f, "r+b") as fh:
+            fh.seek(10)
+            fh.write(b"\xde\xad\xbe\xef")
+    with NativeModel(path, native_lib) as m:
+        assert m.version == 1
+        _native_vs_python(m, coll, states1, hkeys1)
+    for f in glob_mod.glob(os.path.join(path, "delta_000001_*")):
+        os.remove(f)                   # now the tear is MID-chain
+    with pytest.raises(RuntimeError, match="mid-chain"):
+        NativeModel(path, native_lib)
+
+
+def test_native_delta_compressed_payload_refused(native_lib, tmp_path,
+                                                 devices8):
+    """Deflated delta payloads fail the load with a CLEAR message (the
+    dependency-free reader trades codec support; the bytes are intact,
+    so 'recovering' past them would silently drop data)."""
+    import openembedding_tpu.checkpoint_delta as cd
+    from test_delta_checkpoint import make_coll, train
+    from openembedding_tpu.serving.native import NativeModel
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "z")
+    ckpt.save_checkpoint(path, coll, states, model_sign="delta-z")
+    states, _ = train(coll, states, seed=0)
+    info = cd.save_delta(path, coll, states, step=1, compress="zlib")
+    assert info["seq"] == 1
+    with pytest.raises(RuntimeError, match="deflated|uncompressed"):
+        NativeModel(path, native_lib)
+
+
+def test_native_batched_gather_entry(native_lib, saved_model):
+    """oe_pull_weights_gather: one probe per unique key, scattered rows
+    equal per-request lookups; out-of-range gather -> zero rows; the
+    native micro-batcher coalesces concurrent lookups through it."""
+    import threading
+    from openembedding_tpu.serving.native import NativeModel
+    path, coll, states, hkeys = saved_model
+    with NativeModel(path, native_lib) as m:
+        reqs = [np.array([7, 3, 7, 90], np.int64),
+                np.array([3, 11], np.int64)]
+        outs = m.lookup_batched("arr", reqs)
+        for r, o in zip(reqs, outs):
+            np.testing.assert_array_equal(o, m.lookup("arr", r))
+        # explicit gather: dangling index -> zeros
+        rows = m.pull_gather("arr", np.array([7], np.int64),
+                             np.array([0, 5, -1], np.int64))
+        np.testing.assert_array_equal(rows[0], m.lookup("arr", [7])[0])
+        np.testing.assert_array_equal(rows[1:], 0.0)
+        # the native batcher: concurrent lookups, bit-equal responses
+        with m.make_batcher(max_wait_us=2000) as b:
+            got = {}
+
+            def go(i, ids):
+                got[i] = b.lookup("arr", ids)
+
+            ts = [threading.Thread(target=go, args=(i, r))
+                  for i, r in enumerate(reqs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(30)
+        for i, r in enumerate(reqs):
+            np.testing.assert_array_equal(got[i], m.lookup("arr", r))

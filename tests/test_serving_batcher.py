@@ -1,0 +1,510 @@
+"""Micro-batched serving data plane (ISSUE 14 tentpole).
+
+Batched responses must be BIT-identical to unbatched lookups — including
+across a delta hot-swap landing mid-batch (one snapshot per flush,
+pinned by PointGate/SerialSchedule replays of the graftproto
+``serving_batcher`` schedules) — shutdown answers every queued request
+exactly once, and a bounded queue degrades oversubscription to 429
+rejections, never to errors on accepted requests.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from openembedding_tpu import EmbeddingCollection, EmbeddingSpec
+from openembedding_tpu import checkpoint as ckpt
+from openembedding_tpu import checkpoint_delta as cd
+from openembedding_tpu.analysis import scope
+from openembedding_tpu.analysis.concurrency import (PointGate,
+                                                    SerialSchedule,
+                                                    clear_schedule,
+                                                    install_schedule)
+from openembedding_tpu.parallel.mesh import create_mesh
+from openembedding_tpu.serving.batcher import (BusyError, LookupBatcher,
+                                               dedup_keys)
+from openembedding_tpu.serving.registry import ModelRegistry
+from openembedding_tpu.utils import observability as obs
+
+from test_delta_checkpoint import make_coll, train
+
+VOCAB, DIM = 256, 4
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_schedule():
+    yield
+    clear_schedule()
+
+
+@pytest.fixture()
+def served(devices8, tmp_path):
+    """A trained delta-armed model loaded into a BATCHED registry,
+    plus the trainer-side collection/states for ground truth."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh)
+    states = coll.init(jax.random.PRNGKey(0))
+    path = str(tmp_path / "m")
+    ckpt.save_checkpoint(path, coll, states, model_sign="batch-1")
+    states, _ = train(coll, states, seed=0)
+    info = cd.save_delta(path, coll, states, step=1, return_payload=True)
+    assert info["seq"] == 1
+    reg = ModelRegistry(mesh, default_hash_capacity=2048)
+    reg.enable_batching(max_batch_rows=64, max_wait_us=3000)
+    sign = reg.create_model(path, block=True)
+    yield reg, sign, coll, states, path
+    reg.close()
+
+
+# --- bit-identical parity ----------------------------------------------------
+
+def test_batched_parity_bit_identical(served):
+    """Concurrent flat lookups (duplicate keys, both dtypes) coalesce
+    into shared flushes; every response must be EXACTLY the unbatched
+    rows (`==`, not allclose — the pull is a pure gather)."""
+    reg, sign, _coll, _states, _path = served
+    model = reg.find_model(sign)
+    rng = np.random.RandomState(7)
+    queries = [("arr", rng.randint(0, VOCAB, 16).astype(np.int32)),
+               ("arr", rng.randint(0, VOCAB, 5).astype(np.int64)),
+               ("hsh", rng.randint(0, 2**20, 16).astype(np.int32)),
+               ("arr", np.array([3, 3, 3, 9], np.int32)),
+               ("hsh", np.array([12345, 12345], np.int32))]
+    want = [np.asarray(model.lookup(v, q), np.float32)
+            for v, q in queries]
+    got = [None] * len(queries)
+
+    def go(i, v, q):
+        got[i] = np.asarray(reg.lookup(sign, v, q), np.float32)
+
+    threads = [threading.Thread(target=go, args=(i, v, q))
+               for i, (v, q) in enumerate(queries)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    for i, w in enumerate(want):
+        np.testing.assert_array_equal(got[i], w, err_msg=f"query {i}")
+    # coalescing actually happened: fewer flushes than requests
+    assert obs.GLOBAL.snapshot().get("batch_flushes",
+                                     {}).get("count", 0) >= 1
+
+
+def test_sequence_queries_fall_through_unbatched(served):
+    """Pooled/sequence-shaped queries are NOT batchable (concatenating
+    key streams breaks their semantics) — they take the direct path and
+    stay correct."""
+    reg, sign, _coll, _states, _path = served
+    model = reg.find_model(sign)
+    assert model.batchable("arr", np.arange(4, dtype=np.int32)) == "arr"
+    seq = np.arange(8, dtype=np.int32).reshape(2, 4)
+    assert model.batchable("arr", seq) is None
+    np.testing.assert_array_equal(
+        np.asarray(reg.lookup(sign, "arr", seq)),
+        np.asarray(model.lookup("arr", seq)))
+
+
+def test_dedup_keys_unit():
+    uniq, inv = dedup_keys(np.array([5, 1, 5, 9, 1], np.int64))
+    np.testing.assert_array_equal(uniq, [1, 5, 9])
+    np.testing.assert_array_equal(uniq[inv], [5, 1, 5, 9, 1])
+    pairs = np.array([[1, 0], [2, 0], [1, 0]], np.int32)
+    up, inv = dedup_keys(pairs)
+    assert up.shape == (2, 2)
+    np.testing.assert_array_equal(up[inv], pairs)
+
+
+# --- swap-landing-mid-batch (PointGate schedule) -----------------------------
+
+def test_swap_mid_batch_serves_exactly_one_version(served):
+    """The acceptance schedule: a delta hot-swap lands while a batch is
+    parked between its snapshot and its pulls. The batch must answer
+    from its ONE snapshot (the pre-swap version, bit-identical to
+    unbatched pre-swap lookups); the next lookup sees the new version
+    whole."""
+    reg, sign, coll, states, path = served
+    model = reg.find_model(sign)
+    probe = np.arange(0, 32, dtype=np.int32)
+    want_old = np.asarray(model.lookup("arr", probe), np.float32)
+    states2, idx2 = train(coll, states, seed=5,
+                          arr_ids=np.arange(0, 16, dtype=np.int32))
+    info = cd.save_delta(path, coll, states2, step=2,
+                         return_payload=True)
+    delta = info["delta"]
+    want_new = np.asarray(coll.pull(
+        states2, {"arr": jnp.asarray(probe)}, batch_sharded=False,
+        read_only=True)["arr"], np.float32)
+    assert not np.array_equal(want_old, want_new)
+
+    gate = PointGate(["serving.batch.pull"], timeout=30)
+    install_schedule(gate)
+    out = {}
+
+    def storm():
+        out["rows"] = np.asarray(reg.lookup(sign, "arr", probe),
+                                 np.float32)
+
+    t = threading.Thread(target=storm, name="storm")
+    t.start()
+    # the flusher is parked AFTER its snapshot, before the pull — the
+    # exact window the graftproto counterexample swaps in
+    assert gate.wait_arrival("serving.batch.pull")
+    res = reg.apply_delta(sign, delta)
+    assert res["applied"] and res["version"] == 2
+    gate.open("serving.batch.pull")
+    t.join(30)
+    clear_schedule()
+    assert not t.is_alive()
+    # the parked batch answered from its single pre-swap snapshot
+    np.testing.assert_array_equal(out["rows"], want_old)
+    # post-swap lookups (batched) see the new version whole
+    np.testing.assert_array_equal(
+        np.asarray(reg.lookup(sign, "arr", probe), np.float32), want_new)
+
+
+def test_resnapshot_mutation_replay_mixes_versions(served):
+    """The graftproto ``resnapshot_per_pull`` counterexample executed
+    for real: with the one-line mutation (each group's pull re-reads
+    the LIVE model reference instead of the flush snapshot), driving
+    the exported schedule — enqueue x2 / collect / snapshot / pull /
+    swap / pull — hands ONE batch rows from TWO versions. The
+    unmutated batcher under the identical schedule serves both from
+    the snapshot."""
+    import shutil
+    reg, sign, coll, states, path = served
+    model = reg.find_model(sign)
+    probe32 = np.arange(0, 16, dtype=np.int32)   # group A (int32)
+    probe64 = np.arange(0, 16, dtype=np.int64)   # group B (int64)
+    want_old = np.asarray(model.lookup("arr", probe32), np.float32)
+    # version-1 snapshot of the dir: the control run reloads from it
+    # (saving delta 2 below advances the REAL chain on disk)
+    path_v1 = path + "_v1"
+    shutil.copytree(path, path_v1)
+    states2, _ = train(coll, states, seed=6,
+                       arr_ids=np.arange(0, 16, dtype=np.int32))
+    delta = cd.save_delta(path, coll, states2, step=2,
+                          return_payload=True)["delta"]
+    want_new = np.asarray(coll.pull(
+        states2, {"arr": jnp.asarray(probe32)}, batch_sharded=False,
+        read_only=True)["arr"], np.float32)
+
+    def run(mutate, sign, model):
+        b = reg._batcher_for(sign, model)
+        if mutate:
+            # the modeled bug: pulls read model.states LIVE, the
+            # snapshot is ignored
+            b._pull_unique = lambda _snap, name, uniq: np.asarray(
+                model._lookup_impl(name, uniq, model.states,
+                                   record=False), np.float32)
+        # the exported counterexample order: swap lands BETWEEN the two
+        # variable-group pulls of one batch
+        sched = SerialSchedule(
+            ["serving.batch.pull", "registry.find",
+             "registry.swap.build", "registry.swap.commit",
+             "serving.batch.pull"], timeout=30)
+        install_schedule(sched)
+        r1 = b.offer("arr", probe32)
+        r2 = b.offer("arr", probe64)
+        res = reg.apply_delta(sign, delta)
+        assert res["applied"]
+        rows1 = r1.wait(30)
+        rows2 = r2.wait(30)
+        clear_schedule()
+        assert sched.done()
+        return np.asarray(rows1, np.float32), np.asarray(rows2,
+                                                         np.float32)
+
+    rows1, rows2 = run(True, sign, model)
+    np.testing.assert_array_equal(rows1, want_old)
+    np.testing.assert_array_equal(rows2, want_new)   # the MIXED batch
+    # the control model starts at version 1 (the pre-delta snapshot)
+    reg.delete_model(sign)
+    sign = reg.create_model(path_v1, model_sign="batch-ctl", block=True)
+    model = reg.find_model(sign)
+    assert model.version == 1
+    rows1, rows2 = run(False, sign, model)
+    np.testing.assert_array_equal(rows1, want_old)
+    np.testing.assert_array_equal(rows2, want_old)   # one version
+    reg.delete_model(sign)
+
+
+# --- shutdown-with-queued-requests -------------------------------------------
+
+def test_shutdown_drains_every_queued_request(served):
+    """Every request accepted before shutdown gets exactly one response
+    (the drain discipline the ``drop_queue_on_shutdown`` mutation
+    deletes); offers after shutdown reject as busy."""
+    reg, sign, _coll, _states, _path = served
+    model = reg.find_model(sign)
+    b = reg._batcher_for(sign, model)
+    probe = np.arange(8, dtype=np.int32)
+    want = np.asarray(model.lookup("arr", probe), np.float32)
+
+    gate = PointGate(["serving.batch.pull"], timeout=30)
+    install_schedule(gate)
+    first = b.offer("arr", probe)
+    assert gate.wait_arrival("serving.batch.pull")
+    # flusher parked mid-flush: these QUEUE behind it
+    queued = [b.offer("arr", probe) for _ in range(3)]
+    closer = threading.Thread(target=b.close, name="closer")
+    closer.start()
+    gate.open("serving.batch.pull")
+    closer.join(30)
+    clear_schedule()
+    assert not closer.is_alive()
+    for req in [first] + queued:
+        np.testing.assert_array_equal(
+            np.asarray(req.wait(1.0), np.float32), want)
+    with pytest.raises(BusyError):
+        b.offer("arr", probe)
+
+
+# --- backpressure ------------------------------------------------------------
+
+def test_bounded_queue_rejects_never_collapses():
+    """Oversubscription degrades to rejections: a storm past the queue
+    bound gets 429-style BusyError, while every ACCEPTED request
+    completes correctly (no error, no latency collapse). Pure-host
+    batcher with a slow synthetic pull — no jax involved."""
+    calls = []
+
+    def slow_pull(_snap, _name, uniq):
+        time.sleep(0.02)
+        calls.append(uniq.size)
+        return uniq[:, None].astype(np.float32) * np.ones(4, np.float32)
+
+    rejected_before = obs.GLOBAL.snapshot().get(
+        "serving_rejected", {}).get("count", 0)
+    b = LookupBatcher("bp", lambda: None, slow_pull,
+                      max_batch_rows=8, max_wait_us=0, max_queue_rows=16)
+    try:
+        accepted, rejected = [], 0
+        for i in range(200):
+            try:
+                accepted.append(b.offer("v", np.arange(4, dtype=np.int64)))
+            except BusyError:
+                rejected += 1
+        assert rejected > 0, "storm never hit the bound"
+        assert accepted, "everything rejected"
+        for req in accepted:
+            rows = req.wait(30)
+            np.testing.assert_array_equal(
+                rows, np.arange(4)[:, None] * np.ones(4, np.float32))
+    finally:
+        b.close()
+    after = obs.GLOBAL.snapshot()["serving_rejected"]["count"]
+    assert after - rejected_before == rejected
+    assert "oe_serving_rejected_total" in obs.prometheus_text()
+
+
+def test_oversized_single_request_admitted_when_idle():
+    """A single request larger than the whole queue bound can never
+    satisfy the row arithmetic — an idle batcher must admit it alone
+    (it flushes alone) instead of 429ing it forever; with work already
+    queued it still gets the rejection."""
+    release = threading.Event()
+
+    def gated_pull(_snap, _name, uniq):
+        release.wait(10)
+        return uniq[:, None].astype(np.float32) * np.ones(2, np.float32)
+
+    b = LookupBatcher("big", lambda: None, gated_pull,
+                      max_batch_rows=8, max_wait_us=0, max_queue_rows=8)
+    try:
+        big = b.offer("v", np.arange(20, dtype=np.int64))  # idle: admitted
+        # wait until the flusher popped the big request (it is now
+        # parked inside the gated pull) so the small offer below is
+        # judged against an empty queue, not the in-flight rows
+        deadline = time.perf_counter() + 10
+        while b.stats()["queue_rows"] and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        # a second oversized offer while a small one occupies the
+        # queue must still reject
+        small = b.offer("v", np.arange(4, dtype=np.int64))
+        with pytest.raises(BusyError):
+            b.offer("v", np.arange(20, dtype=np.int64))
+        release.set()
+        np.testing.assert_array_equal(
+            big.wait(10),
+            np.arange(20)[:, None] * np.ones(2, np.float32))
+        np.testing.assert_array_equal(
+            small.wait(10),
+            np.arange(4)[:, None] * np.ones(2, np.float32))
+    finally:
+        release.set()
+        b.close()
+
+
+def test_batcher_pull_error_reaches_every_group_member():
+    def boom(_snap, _name, _uniq):
+        raise RuntimeError("pull exploded")
+
+    b = LookupBatcher("err", lambda: None, boom, max_wait_us=5000)
+    try:
+        r1 = b.offer("v", np.arange(3, dtype=np.int64))
+        r2 = b.offer("v", np.arange(3, dtype=np.int64))
+        for r in (r1, r2):
+            with pytest.raises(RuntimeError, match="pull exploded"):
+                r.wait(30)
+    finally:
+        b.close()
+
+
+def test_flusher_survives_snapshot_error():
+    """An exception OUTSIDE the per-group pull guard (e.g. the
+    snapshot() hook) must not kill the flusher thread: the batch's
+    waiters get the error, and the batcher keeps serving subsequent
+    requests (a dead flusher would silently accept offers that then
+    block their whole timeout)."""
+    boom = [True]
+
+    def snap():
+        if boom[0]:
+            raise RuntimeError("snapshot exploded")
+        return None
+
+    def pull(_snap, _name, uniq):
+        return uniq[:, None].astype(np.float32) * np.ones(4, np.float32)
+
+    b = LookupBatcher("snap-err", snap, pull, max_wait_us=0)
+    try:
+        with pytest.raises(RuntimeError, match="snapshot exploded"):
+            b.lookup("v", np.arange(3, dtype=np.int64))
+        boom[0] = False
+        rows = b.lookup("v", np.arange(3, dtype=np.int64), timeout=10)
+        np.testing.assert_array_equal(
+            rows, np.arange(3)[:, None] * np.ones(4, np.float32))
+        assert b._thread.is_alive()
+    finally:
+        b.close()
+
+
+def test_same_sign_reload_rebinds_batcher(served):
+    """A same-sign model RELOAD must not leave batched traffic bound to
+    the replaced model: the stale batcher (whose closures capture the
+    old ServingModel) is drained and a fresh one binds to the new
+    object, so batched lookups serve the RELOADED rows."""
+    reg, sign, coll, states, path = served
+    model = reg.find_model(sign)
+    b_old = reg._batcher_for(sign, model)
+    probe = np.arange(8, dtype=np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(reg.lookup(sign, "arr", probe), np.float32),
+        np.asarray(model.lookup("arr", probe), np.float32))
+    # advance the chain on disk, then RELOAD under the same sign
+    states2, _ = train(coll, states, seed=9,
+                       arr_ids=np.arange(0, 16, dtype=np.int32))
+    cd.save_delta(path, coll, states2, step=2)
+    reg.create_model(path, model_sign=sign, block=True)
+    model2 = reg.find_model(sign)
+    assert model2 is not model and model2.version == 2
+    want2 = np.asarray(model2.lookup("arr", probe), np.float32)
+    got = np.asarray(reg.lookup(sign, "arr", probe), np.float32)
+    np.testing.assert_array_equal(got, want2)
+    b_new = reg._batcher_for(sign, model2)
+    assert b_new is not b_old
+    # the stale batcher was closed: it rejects further offers
+    with pytest.raises(BusyError):
+        b_old.offer("arr", probe)
+
+
+def test_rotate_surfaces_all_busy_as_429():
+    """When EVERY replica rejects with batcher backpressure, the
+    routing client raises the 429 itself (a defined rejection the load
+    tools count apart), not a dead-replica ConnectionError; a mix of
+    dead + busy still reports dead-replica semantics."""
+    import io
+    import urllib.error
+    from openembedding_tpu.serving import ha
+
+    router = ha.RoutingClient(["h1:1", "h2:1"])
+
+    def busy(ep):
+        raise urllib.error.HTTPError(f"http://{ep}/x", 429,
+                                     "busy", {}, io.BytesIO(b""))
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        router._rotate(busy)
+    assert ei.value.code == 429
+
+    def half_dead(ep):
+        if ep == "h1:1":
+            raise ConnectionError("down")
+        raise urllib.error.HTTPError(f"http://{ep}/x", 503,
+                                     "creating", {}, io.BytesIO(b""))
+
+    with pytest.raises(ConnectionError):
+        router._rotate(half_dead)
+
+    # dead replica MIXED with a busy one (the chaos + backpressure
+    # storm): the 429 must surface regardless of which replica the
+    # randomized rotation probed last — a ConnectionError here would
+    # count the defined rejection as a request error
+    def dead_plus_busy(ep):
+        if ep == "h1:1":
+            raise ConnectionError("down")
+        raise urllib.error.HTTPError(f"http://{ep}/x", 429,
+                                     "busy", {}, io.BytesIO(b""))
+
+    for _ in range(8):  # cover both rotation orders
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            router._rotate(dead_plus_busy)
+        assert ei.value.code == 429
+
+
+def test_graftload_counts_rejections_apart_from_errors():
+    """run_storm tallies RejectedError separately: rejections are not
+    completions (achieved drops) and not errors (the chaos gate stays
+    meaningful under deliberate backpressure)."""
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__))), "tools"))
+    from tools import graftload as gl
+
+    def send(i):
+        if i % 3 == 0:
+            raise gl.RejectedError("busy")
+
+    arrivals = np.linspace(0.0, 0.2, 30)
+    res = gl.run_storm(send, arrivals, route="rest", offered_qps=150.0,
+                       duration=0.2, workers=4)
+    assert res.rejected == 10 and res.errors == 0
+    assert res.calls == 30
+    assert res.summary()["rejected"] == 10
+
+
+# --- observability -----------------------------------------------------------
+
+def test_batch_metrics_and_member_traces(served):
+    """serving_batch_rows / serving_batch_wait_us histograms fill, the
+    oe_batch_* counters land on the prometheus page, and the flush's
+    member spans carry each request's trace id (the merged Perfetto
+    story shows coalescing)."""
+    reg, sign, _coll, _states, _path = served
+    scope.set_tracing(True)
+    scope.reset()
+    try:
+        rows_before = scope.HISTOGRAMS.count("serving_batch_rows")
+        with scope.trace_context() as tid:
+            reg.lookup(sign, "arr", np.arange(6, dtype=np.int32))
+        assert scope.HISTOGRAMS.count("serving_batch_rows") \
+            == rows_before + 1
+        assert scope.HISTOGRAMS.count("serving_batch_wait_us") >= 1
+        text = obs.prometheus_text()
+        assert "oe_batch_rows_total" in text
+        assert "oe_batch_flushes_total" in text
+        assert "oe_serving_lookup_rows_bucket" in text
+        # the member span carries the REQUEST's trace id
+        trace = scope.export_chrome_trace()
+        members = [e for e in trace["traceEvents"]
+                   if e.get("name") == "serving.batch.member"
+                   and e.get("args", {}).get("trace") == tid]
+        assert members, "no member span with the request trace id"
+    finally:
+        scope.set_tracing(None)

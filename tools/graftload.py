@@ -54,6 +54,13 @@ DEMO_VOCAB = 1024
 DEMO_DIM = 8
 
 
+class RejectedError(Exception):
+    """The request was REJECTED by batcher backpressure (HTTP 429 from
+    every replica): counted separately from errors — an oversubscribed
+    offer is SUPPOSED to degrade to rejections, never to failures on
+    accepted requests."""
+
+
 # --- open-loop scheduling ----------------------------------------------------
 
 def poisson_arrivals(rate: float, duration: float,
@@ -82,17 +89,21 @@ class StormResult:
 
     def __init__(self, route: str, offered_qps: float, duration: float,
                  latencies_ms: np.ndarray, arrival_s: np.ndarray,
-                 errors: int):
+                 errors: int, rejected: int = 0):
         self.route = route
         self.offered_qps = float(offered_qps)
         self.duration = float(duration)
         self.latencies_ms = np.asarray(latencies_ms, np.float64)
         self.arrival_s = np.asarray(arrival_s, np.float64)
         self.errors = int(errors)
+        # 429-busy rejections (batcher backpressure): not completions,
+        # not errors — the bounded queue doing its job under an offer
+        # past capacity
+        self.rejected = int(rejected)
 
     @property
     def calls(self) -> int:
-        return int(self.latencies_ms.size) + self.errors
+        return int(self.latencies_ms.size) + self.errors + self.rejected
 
     @property
     def achieved_qps(self) -> float:
@@ -136,6 +147,7 @@ class StormResult:
                 "offered_qps": round(self.offered_qps, 2),
                 "achieved_qps": round(self.achieved_qps, 2),
                 "calls": self.calls, "errors": self.errors,
+                "rejected": self.rejected,
                 "error_rate": round(self.error_rate, 4),
                 "p50_ms": round(self.quantile_ms(0.50), 3),
                 "p95_ms": round(self.quantile_ms(0.95), 3),
@@ -151,7 +163,7 @@ def run_storm(send: Callable[[int], None], arrivals: np.ndarray, *,
     latency excluded (an error is not a service time)."""
     workers = max(1, min(int(workers), max(1, arrivals.size)))
     lock = threading.Lock()
-    state = {"next": 0, "errors": 0}
+    state = {"next": 0, "errors": 0, "rejected": 0}
     lat: List[float] = []
     arr: List[float] = []
     err_first: List[BaseException] = []
@@ -171,6 +183,13 @@ def run_storm(send: Callable[[int], None], arrivals: np.ndarray, *,
                 time.sleep(delay)
             try:
                 send(i)
+            except RejectedError:
+                # 429 backpressure: a rejection is a DEFINED response,
+                # not a failure — tallied apart from errors so the
+                # never-error chaos invariant stays meaningful
+                with lock:
+                    state["rejected"] += 1
+                continue
             except Exception as e:  # noqa: BLE001 — counted, not fatal
                 with lock:
                     state["errors"] += 1
@@ -190,7 +209,8 @@ def run_storm(send: Callable[[int], None], arrivals: np.ndarray, *,
     for t in threads:
         t.join()
     res = StormResult(route, offered_qps, duration,
-                      np.asarray(lat), np.asarray(arr), state["errors"])
+                      np.asarray(lat), np.asarray(arr), state["errors"],
+                      state["rejected"])
     if err_first:
         res.first_error = repr(err_first[0])  # type: ignore[attr-defined]
     return res
@@ -213,14 +233,22 @@ def make_rest_sender(router, sign: str, variable: str, vocab: int,
     """Per-request REST lookup through the routing client: fresh random
     ids per request (pre-drawn — the storm loop must not pay RNG time),
     each under its own trace id so the Perfetto story is per-request."""
+    import urllib.error
     from openembedding_tpu.analysis import scope
     rng = np.random.RandomState(seed)
     pool = rng.randint(0, vocab, size=(256, batch)).astype(np.int32)
 
     def send(i: int) -> None:
         ids = pool[i % pool.shape[0]]
-        with scope.trace_context():
-            rows = router.lookup(sign, variable, ids)
+        try:
+            with scope.trace_context():
+                rows = router.lookup(sign, variable, ids)
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                # every replica's bounded batcher queue was full: the
+                # request was REJECTED, by design — not a failure
+                raise RejectedError(str(e)) from e
+            raise
         if rows.shape[0] != batch:
             raise RuntimeError(f"short read: {rows.shape}")
 
@@ -228,21 +256,63 @@ def make_rest_sender(router, sign: str, variable: str, vocab: int,
 
 
 def make_native_sender(model, variable: str, vocab: int, batch: int,
-                       seed: int = 2) -> Callable[[int], None]:
-    """Per-request native (zero-JAX mmap) lookup — the latency floor."""
+                       seed: int = 2,
+                       batcher=None) -> Callable[[int], None]:
+    """Per-request native (zero-JAX mmap) lookup — the latency floor.
+    With ``batcher`` (a ``NativeModel.make_batcher`` scheduler),
+    concurrent sends coalesce into one ``oe_pull_weights_gather`` per
+    flush instead of serializing on the ctypes handle."""
     from openembedding_tpu.analysis import scope
+    from openembedding_tpu.serving.batcher import BusyError
     rng = np.random.RandomState(seed)
     pool = rng.randint(0, vocab, size=(256, batch)).astype(np.int64)
     lock = threading.Lock()   # one ctypes handle; serialize calls
 
     def send(i: int) -> None:
         ids = pool[i % pool.shape[0]]
-        with scope.trace_context(), lock:
-            rows = model.lookup(variable, ids)
+        if batcher is not None:
+            try:
+                with scope.trace_context():
+                    rows = batcher.lookup(variable, ids)
+            except BusyError as e:
+                # bounded-queue backpressure: a DEFINED rejection,
+                # tallied apart from errors (mirrors the REST 429 path)
+                raise RejectedError(str(e)) from e
+        else:
+            with scope.trace_context(), lock:
+                rows = model.lookup(variable, ids)
         if rows.shape[0] != batch:
             raise RuntimeError(f"short read: {rows.shape}")
 
     return send
+
+
+def scrape_batch_stats(endpoints) -> Dict[str, float]:
+    """Sum the replicas' ``oe_batch_*`` / ``oe_serving_rejected_*``
+    counters off /metrics — the server-side coalescing evidence a
+    --batched storm reports (flushes vs requests = the batching
+    factor). Dead replicas (chaos kills) contribute nothing."""
+    import re as re_mod
+    import urllib.request
+    want = ("oe_batch_flushes_total", "oe_batch_requests_total",
+            "oe_batch_rows_total", "oe_batch_unique_rows_total",
+            "oe_serving_rejected_total")
+    out: Dict[str, float] = {}
+    for ep in endpoints:
+        try:
+            with urllib.request.urlopen(f"http://{ep}/metrics",
+                                        timeout=3) as r:
+                body = r.read().decode()
+        except Exception:  # noqa: BLE001 — a killed replica is expected
+            continue
+        for name in want:
+            m = re_mod.search(rf"^{name} ([0-9.e+]+)$", body,
+                              re_mod.MULTILINE)
+            if m:
+                key = name[len("oe_"):-len("_total")] \
+                    if name.endswith("_total") else name[len("oe_"):]
+                out[key] = out.get(key, 0.0) + float(m.group(1))
+    return out
 
 
 # --- demo cluster ------------------------------------------------------------
@@ -265,11 +335,15 @@ def build_demo_checkpoint(out_dir: str) -> str:
 
 
 def boot_demo_cluster(model_dir: str, replicas: int,
-                      trace_dir: str = ""):
+                      trace_dir: str = "", batch_rows: int = 0,
+                      batch_wait_us: Optional[int] = None,
+                      batch_queue_rows: Optional[int] = None):
     """Spawn ``replicas`` replica daemons serving the demo checkpoint;
     returns (endpoints, procs, trace_paths). With ``trace_dir`` each
     replica records spans and exports them on graceful (SIGTERM)
-    shutdown — the server-side half of the merged Perfetto story."""
+    shutdown — the server-side half of the merged Perfetto story.
+    ``batch_rows > 0`` arms each replica's micro-batching scheduler
+    (the --batched A/B arm)."""
     import socket
     from openembedding_tpu.serving import ha
 
@@ -283,7 +357,9 @@ def boot_demo_cluster(model_dir: str, replicas: int,
     traces = [os.path.join(trace_dir, f"replica_{i}.json") if trace_dir
               else "" for i in range(replicas)]
     procs = [ha.spawn_replica(p, load=[f"{DEMO_SIGN}={model_dir}"],
-                              trace_out=tr)
+                              trace_out=tr, batch_rows=batch_rows,
+                              batch_wait_us=batch_wait_us,
+                              batch_queue_rows=batch_queue_rows)
              for p, tr in zip(ports, traces)]
     for ep, proc in zip(eps, procs):
         if not ha.wait_ready(ep, sign=DEMO_SIGN):
@@ -343,6 +419,22 @@ def main(argv=None) -> int:
                          "storm (demo mode): reads must never error "
                          "while a replica lives, and the trace shows "
                          "the reroute")
+    ap.add_argument("--batched", action="store_true",
+                    help="arm each demo replica's micro-batching "
+                         "lookup scheduler (serving/batcher.py) — the "
+                         "A/B arm against the default unbatched path; "
+                         "replica oe_batch_* counters are scraped off "
+                         "/metrics after the storms")
+    ap.add_argument("--batch-rows", type=int, default=None,
+                    help="per-flush row cap for --batched replicas "
+                         "(default: envconfig.DEFAULT_BATCH_ROWS)")
+    ap.add_argument("--batch-wait-us", type=int, default=None,
+                    help="adaptive flush wait for --batched replicas "
+                         "(default: envconfig.DEFAULT_BATCH_WAIT_US)")
+    ap.add_argument("--batch-queue-rows", type=int, default=None,
+                    help="bounded queue depth (rows) for --batched "
+                         "replicas; offers past it return 429-busy "
+                         "(counted as REJECTED, never as errors)")
     ap.add_argument("--trace", default="",
                     help="write the storm's request-scoped spans as "
                          "Perfetto-loadable JSON")
@@ -367,13 +459,24 @@ def main(argv=None) -> int:
 
     from openembedding_tpu.analysis import scope
     from openembedding_tpu.serving import ha
+    from openembedding_tpu.utils import envconfig
     from tools import graftwatch
+
+    # the batcher knobs' single home is envconfig (imported after the
+    # jax env setup above — the package pulls jax at import)
+    if args.batch_rows is None:
+        args.batch_rows = envconfig.DEFAULT_BATCH_ROWS
+    if args.batch_wait_us is None:
+        args.batch_wait_us = envconfig.DEFAULT_BATCH_WAIT_US
+    if args.batch_queue_rows is None:
+        args.batch_queue_rows = envconfig.DEFAULT_BATCH_QUEUE_ROWS
 
     rc = 0
     procs: List[Any] = []
     replica_traces: List[str] = []
     router = None
     native_model = None
+    native_batcher = None
     tmp_dir = None
     try:
         # --- target selection ---------------------------------------------
@@ -388,7 +491,10 @@ def main(argv=None) -> int:
                   flush=True)
             endpoints, procs, replica_traces = boot_demo_cluster(
                 model_dir, args.replicas,
-                trace_dir=tmp_dir if args.trace else "")
+                trace_dir=tmp_dir if args.trace else "",
+                batch_rows=args.batch_rows if args.batched else 0,
+                batch_wait_us=args.batch_wait_us,
+                batch_queue_rows=args.batch_queue_rows)
             print(f"graftload: {len(endpoints)} replica(s) ready: "
                   f"{endpoints}", flush=True)
         else:
@@ -403,6 +509,11 @@ def main(argv=None) -> int:
                 ap.error("--model-dir required for --path native")
             from openembedding_tpu.serving.native import NativeModel
             native_model = NativeModel(model_dir)
+            if args.batched:
+                native_batcher = native_model.make_batcher(
+                    max_batch_rows=args.batch_rows,
+                    max_wait_us=args.batch_wait_us,
+                    max_queue_rows=args.batch_queue_rows)
 
         if args.trace:
             scope.set_tracing(True)
@@ -414,7 +525,8 @@ def main(argv=None) -> int:
         all_storms: List[StormResult] = []
         sweep_results: List[StormResult] = []
         head = (f"{'route':<8}{'offered':>9}{'achieved':>10}{'calls':>7}"
-                f"{'err':>5}{'p50_ms':>9}{'p95_ms':>9}{'p99_ms':>9}")
+                f"{'err':>5}{'rej':>6}{'p50_ms':>9}{'p95_ms':>9}"
+                f"{'p99_ms':>9}")
         print("\n" + head + "\n" + "-" * len(head))
 
         def run_and_print(route: str, send, rate: float,
@@ -431,8 +543,8 @@ def main(argv=None) -> int:
             all_storms.append(res)
             s = res.summary()
             print(f"{route:<8}{s['offered_qps']:>9}{s['achieved_qps']:>10}"
-                  f"{s['calls']:>7}{s['errors']:>5}{s['p50_ms']:>9}"
-                  f"{s['p95_ms']:>9}{s['p99_ms']:>9}"
+                  f"{s['calls']:>7}{s['errors']:>5}{s['rejected']:>6}"
+                  f"{s['p50_ms']:>9}{s['p95_ms']:>9}{s['p99_ms']:>9}"
                   + ("   CHAOS: killed 1 replica mid-storm"
                      if kill_at is not None else ""), flush=True)
             return res
@@ -447,7 +559,8 @@ def main(argv=None) -> int:
             if native_model is not None:
                 send = make_native_sender(native_model, args.variable,
                                           args.vocab, args.batch,
-                                          seed=50 + ri)
+                                          seed=50 + ri,
+                                          batcher=native_batcher)
                 res = run_and_print("native", send, rate, seed=200 + ri)
                 by_route["native"] = res
                 if router is None:
@@ -492,6 +605,24 @@ def main(argv=None) -> int:
             v = scope.HISTOGRAMS.counter(name)
             if v:
                 print(f"  {name}: {v:.0f}")
+
+        # server-side coalescing evidence: the replicas' oe_batch_*
+        # counters (scraped while they still live — the trace branch
+        # SIGTERMs them below)
+        rejected = sum(r.rejected for r in all_storms)
+        batch_stats: Dict[str, float] = {}
+        if args.batched:
+            batch_stats = scrape_batch_stats(endpoints)
+            if batch_stats.get("batch_flushes"):
+                factor = batch_stats.get("batch_requests", 0.0) \
+                    / batch_stats["batch_flushes"]
+                dedup = batch_stats.get("batch_unique_rows", 0.0) \
+                    / max(1.0, batch_stats.get("batch_rows", 0.0))
+                print(f"  batching: {batch_stats['batch_flushes']:.0f} "
+                      f"flushes, {factor:.2f} requests/flush, "
+                      f"unique/rows {dedup:.2f}")
+        if rejected:
+            print(f"  rejected (429 backpressure): {rejected}")
 
         # --- artifacts -----------------------------------------------------
         if args.trace:
@@ -541,6 +672,21 @@ def main(argv=None) -> int:
                       "skipping the trajectory record", file=sys.stderr)
                 rc = 1
             else:
+                config = {"source": "graftload", "qps": args.qps,
+                          "duration": args.duration,
+                          "batch": args.batch,
+                          "workers": args.workers, "path": args.path,
+                          "replicas": args.replicas,
+                          "sweep": bool(args.sweep),
+                          "chaos": bool(args.chaos)}
+                if args.batched:
+                    # only the BATCHED arm adds these keys: the config
+                    # dict keys the gate's baseline group, and the
+                    # unbatched arm must keep matching its committed
+                    # pre-batching baselines
+                    config["batched"] = True
+                    config["batch_rows"] = args.batch_rows
+                    config["batch_wait_us"] = args.batch_wait_us
                 rec = graftwatch.make_serving_record(
                     routes={k: v.summary()
                             for k, v in by_route.items()},
@@ -548,13 +694,9 @@ def main(argv=None) -> int:
                     achieved_qps=primary.achieved_qps,
                     errors=errors, replicas=max(1, len(endpoints)),
                     qps_band=primary.per_chunk_qps(),
-                    config={"source": "graftload", "qps": args.qps,
-                            "duration": args.duration,
-                            "batch": args.batch,
-                            "workers": args.workers, "path": args.path,
-                            "replicas": args.replicas,
-                            "sweep": bool(args.sweep),
-                            "chaos": bool(args.chaos)})
+                    rejected=rejected,
+                    batch_stats=batch_stats or None,
+                    config=config)
                 graftwatch.append_record(args.trajectory, rec)
                 print(f"graftload: appended serving record to "
                       f"{args.trajectory} (achieved "
@@ -564,6 +706,8 @@ def main(argv=None) -> int:
     finally:
         if router is not None:
             router.close()
+        if native_batcher is not None:
+            native_batcher.close()
         if native_model is not None:
             native_model.close()
         for p in procs:

@@ -7,10 +7,11 @@
     python -m tools.graftproto --emit-schedules out.json
 
 Fourth leg of the static-analysis gate (graftlint / graftrace /
-graftcheck / graftproto): checks the four shipped host-protocol models —
+graftcheck / graftproto): checks the shipped host-protocol models —
 the delta-checkpoint chain (+compactor, crash/tear budgets, racing
 loads), serving hot-swap seq gating, the DirtyTracker claim discipline,
-and the HA registry CREATING window under replica kills — EXHAUSTIVELY
+the HA registry CREATING window under replica kills, and the serving
+lookup micro-batcher (enqueue/flush/swap/shutdown) — EXHAUSTIVELY
 by BFS, printing per-model explored-state counts. Exit 0 only when every
 model's frontier is exhausted with all invariants green and no deadlock.
 
@@ -63,8 +64,9 @@ def _schedule_entry(model, trace):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="exhaustive protocol model checking "
-                    "(delta chain / hot-swap / dirty tracker / HA registry)")
+        description="exhaustive protocol model checking (delta chain / "
+                    "hot-swap / dirty tracker / HA registry / "
+                    "serving batcher)")
     ap.add_argument("--model", default="",
                     help="check one shipped model by name (default: all)")
     ap.add_argument("--max-states", type=int, default=500_000,

@@ -247,6 +247,24 @@ def validate_record(rec: Any) -> List[str]:
             if not isinstance(serving.get("replicas"), int) \
                     or serving.get("replicas", 0) < 1:
                 p.append("serving.replicas: expected int >= 1")
+            # batched-serving fields (optional: pre-batching records
+            # carry neither): rejected offers and the scraped
+            # server-side coalescing counters
+            if "rejected" in serving and (
+                    not isinstance(serving["rejected"], int)
+                    or isinstance(serving["rejected"], bool)
+                    or serving["rejected"] < 0):
+                p.append("serving.rejected: expected int >= 0")
+            batch = serving.get("batch")
+            if batch is not None:
+                if not isinstance(batch, dict):
+                    p.append("serving.batch: expected object or null")
+                else:
+                    for k, v in batch.items():
+                        if not isinstance(v, _NUM) \
+                                or isinstance(v, bool) or v < 0:
+                            p.append(f"serving.batch.{k}: expected "
+                                     "number >= 0")
     return p
 
 
@@ -397,6 +415,8 @@ def make_serving_record(*, routes: Mapping[str, Mapping[str, Any]],
                         errors: int, replicas: int,
                         qps_band: Tuple[float, float],
                         config: Mapping[str, Any],
+                        rejected: int = 0,
+                        batch_stats: Optional[Mapping[str, Any]] = None,
                         fingerprint: Optional[str] = None,
                         device: Optional[Mapping[str, Any]] = None,
                         ts: Optional[str] = None) -> Dict[str, Any]:
@@ -411,7 +431,11 @@ def make_serving_record(*, routes: Mapping[str, Mapping[str, Any]],
     ``qps_band`` as its per-second spread, so "sustained QPS down"
     gates like step throughput. The ``serving`` section carries the
     open-loop accounting (offered vs achieved, error count, replica
-    count). Raises on a schema-invalid assembly."""
+    count) plus — batched storms — the backpressure/coalescing stats:
+    ``rejected`` (429-busy offers; a DEFINED response distinct from
+    errors) and ``batch`` (the replicas' ``oe_batch_*`` counters:
+    flushes / requests / rows / unique rows, scraped off /metrics).
+    Raises on a schema-invalid assembly."""
     scope_section = {
         str(route): {"calls": int(r["calls"]),
                      "p50_ms": round(float(r["p50_ms"]), 4),
@@ -432,7 +456,11 @@ def make_serving_record(*, routes: Mapping[str, Mapping[str, Any]],
     rec["serving"] = {
         "offered_qps": float(offered_qps),
         "achieved_qps": float(achieved_qps),
-        "errors": int(errors), "replicas": int(replicas)}
+        "errors": int(errors), "replicas": int(replicas),
+        "rejected": int(rejected)}
+    if batch_stats:
+        rec["serving"]["batch"] = {str(k): float(v)
+                                   for k, v in batch_stats.items()}
     bad = validate_record(rec)
     if bad:
         raise ValueError(f"assembled serving record is schema-invalid: "
