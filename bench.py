@@ -1002,6 +1002,154 @@ def run_compressed_ab(name, config, *, steps, warmup):
     }
 
 
+def run_ingest_ab(name, config, *, steps, warmup):
+    """Streaming-ingest A/B: the SAME shard data trained from on-disk
+    shards through the parallel reader pool (``data/stream.py``) vs
+    pre-materialized in-memory batch dicts, both on the pipelined
+    plane with the fit-style lookahead. This is the first bench where
+    the input pipeline is on the critical path (ROADMAP item 5: every
+    prior eps number fed synthetic in-memory batches). ``value`` is the
+    STREAMED eps; ``stream_vs_mem`` is the honest cost of ingest
+    (>= 0.9x is the lane's acceptance bar), and the ``ingest`` section
+    carries the stall evidence — ``stall_p95_ms`` must be exactly 0.0
+    post-warmup for the "the step never blocks on data" claim (the
+    stream records a literal 0.0 for every pop that found data ready).
+    Shards regenerate deterministically per seed, so the arms consume
+    identical rows; the streamed arm re-walks the shard files each
+    epoch (fresh parse + hash every time — the cost under test), the
+    in-memory arm cycles the parsed dicts.
+    """
+    import shutil
+    import tempfile
+    import jax
+    from openembedding_tpu.data import stream as stream_lib
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.utils import observability as obs
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    data_ax = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    mesh = create_mesh(data_ax, n_dev // data_ax)
+    batch = config["batch"]
+    cfg = dict(config, plane=config.get("plane", "a2a+pipelined"))
+    readers = int(config.get("readers", 2))
+    ring = int(config.get("ring_batches", 8))
+    num_shards = int(config.get("shards", 8))
+    shard_rows = int(config.get("shard_rows", 12288))
+    shard_dir = tempfile.mkdtemp(prefix="bench_ingest_")
+    warm = max(warmup, 3)   # pipelined schedule: 2-step compile warmup
+    blocks = 3
+    try:
+        stream_lib.write_synthetic_shards(
+            shard_dir, num_shards=num_shards, rows_per_shard=shard_rows,
+            fmt="tsv", seed=config.get("seed", 0))
+        features, coll, trainer, mapper = build(cfg, mesh)
+
+        def make_stream(epochs):
+            return stream_lib.ShardStream(
+                shard_dir, batch_size=batch, readers=readers,
+                ring_batches=ring, epochs=epochs,
+                num_buckets=cfg["vocab"],
+                transform=(mapper.fuse_batch if mapper is not None
+                           else None),
+                add_linear=mapper is None, name="bench_ingest")
+
+        def drive(state, nxt_fn, cur, n):
+            """n lookahead-fed steps from ``cur``; returns (state, last
+            batch) — the cur/next identity pattern fit would use."""
+            for _ in range(n):
+                nxt = nxt_fn()
+                state, m = trainer.train_step(state, cur,
+                                              next_batch=nxt)
+                cur = nxt
+            jax.block_until_ready(m["loss"])
+            return state, cur
+
+        # -- arm A: in-memory (one epoch materialized through the SAME
+        # parse path, then cycled as ready dicts)
+        s0 = make_stream(epochs=1)
+        try:
+            mem = list(s0)
+        finally:
+            s0.close()
+        if len(mem) < 2:
+            raise RuntimeError(
+                f"ingest bench needs >= 2 batches/epoch, got {len(mem)} "
+                f"({num_shards}x{shard_rows} rows at batch {batch})")
+        mi = {"i": 0}
+
+        def next_mem():
+            mi["i"] += 1
+            return mem[mi["i"] % len(mem)]
+
+        state = trainer.init(jax.random.PRNGKey(0),
+                             trainer.shard_batch(mem[0]))
+        state, cur = drive(state, next_mem, mem[0], warm)
+        mem_eps = []
+        for _ in range(blocks):
+            t0 = time.perf_counter()
+            state, cur = drive(state, next_mem, cur, steps)
+            mem_eps.append(steps * batch / (time.perf_counter() - t0))
+        del state
+        gc.collect()
+
+        # -- arm B: streamed live from disk (infinite epochs; every
+        # batch re-parsed + re-hashed on the reader pool)
+        features, coll, trainer, mapper = build(cfg, mesh)
+        live = make_stream(epochs=None)
+        try:
+            it = iter(live)
+            first = next(it)
+            state = trainer.init(jax.random.PRNGKey(0),
+                                 trainer.shard_batch(first))
+            obs.GLOBAL.reset()
+            state, cur = drive(state, lambda: next(it), first, warm)
+            live.reset_stall_stats()   # measured window excludes warmup
+            stream_eps = []
+            for _ in range(blocks):
+                t0 = time.perf_counter()
+                state, cur = drive(state, lambda: next(it), cur, steps)
+                stream_eps.append(steps * batch
+                                  / (time.perf_counter() - t0))
+            stalls = live.stall_summary()
+            primes = obs.GLOBAL.snapshot().get(
+                "pipeline_primes", {}).get("count", 0.0)
+            bad = live.bad_rows()
+            ring_stats = live.memory_stats()
+        finally:
+            live.close()
+        del state
+    finally:
+        shutil.rmtree(shard_dir, ignore_errors=True)
+    eps = _median(stream_eps)
+    eps_mem = _median(mem_eps)
+    return {
+        "metric": f"{name}_examples_per_sec_{platform}{n_dev}",
+        "value": round(eps, 1),
+        "unit": "examples/s",
+        "vs_baseline": round(eps / n_dev / REF_PER_CHIP, 3),
+        "per_chip": round(eps / n_dev, 1),
+        "eps_min": round(min(stream_eps), 1),
+        "eps_max": round(max(stream_eps), 1),
+        "mem_eps": round(eps_mem, 1),
+        "stream_vs_mem": round(eps / eps_mem, 3),
+        "ingest": {
+            "stall_p95_ms": round(stalls["p95_ms"], 4),
+            "stall_p99_ms": round(stalls["p99_ms"], 4),
+            "stall_max_ms": round(stalls["max_ms"], 4),
+            "stalled_pops": int(stalls["stalled"]),
+            "pops": int(stalls["pops"]),
+            "bad_rows": int(bad),
+            "pipeline_primes": int(primes),
+            "readers": readers,
+            "ring_batches": int(ring_stats["ring_capacity_batches"]),
+            "rows_read": int(ring_stats["rows_read"]),
+        },
+        **_hbm_stats(),
+        "config": dict(config),
+    }
+
+
 def run_plane_parity(name, config, *, steps, warmup):
     """Cross-plane AUC/loss parity: a2a, psum, hybrid (sparse_as_dense),
     and offload planes trained on IDENTICAL data + seeds must agree — the
@@ -1457,6 +1605,16 @@ CONFIGS = {
                                    "model": "deepfm", "dim": 64,
                                    "vocab": 1 << 18, "batch": 4096,
                                    "zipf": True},
+    # streaming-ingest A/B (data/stream.py): the headline shape trained
+    # from generated on-disk TSV shards through the parallel reader
+    # pool vs the same rows pre-materialized in memory, pipelined
+    # plane + lookahead both arms; value = streamed eps, plus the
+    # stream_vs_mem ratio and post-warmup stall evidence (cpu-window
+    # acceptance: >= 0.9x and stall p95 == 0)
+    "deepfm_dim9_ingest_ab": {"kind": "ingest_ab", "model": "deepfm",
+                              "dim": 9, "vocab": 1 << 20, "batch": 4096,
+                              "readers": 2, "shards": 8,
+                              "shard_rows": 12288},
     # checkpoint timing on a deliberately small table: the bench link
     # (tunneled chip) moves ~10 MB/s device->host, so GB-scale dumps are
     # link-bound; the per-GB rate extrapolates
@@ -1544,6 +1702,7 @@ HEADLINE = "deepfm_dim9"
 RUNNERS = {"offload": run_offload, "offload_sweep": run_offload_sweep,
            "cache_ab": run_cache_ab, "pipelined_ab": run_pipelined_ab,
            "compressed_ab": run_compressed_ab,
+           "ingest_ab": run_ingest_ab,
            "hash_probe": run_hash_probe,
            "auc": run_auc_criteo, "ckpt_local": run_ckpt_local,
            "ckpt_delta_ab": run_ckpt_delta_ab,
@@ -1682,7 +1841,13 @@ def wait_device_healthy(retry_for_s, interval_s, probe_timeout_s=300):
 # backend — faster, no HBM pollution, and a wedged tunnel cannot erase
 # them (their metric name records the platform)
 DEVICELESS = frozenset({"serving_lookup", "ckpt_local_2gb", "auc_criteo",
-                        "plane_parity", "ckpt_delta_ab"})
+                        "plane_parity", "ckpt_delta_ab",
+                        # the ingest A/B's claim is the cpu-window
+                        # stream/mem ratio + stall evidence (ROADMAP
+                        # item 5 names the cpu8 lane); it must survive
+                        # a wedged device tunnel like the other
+                        # platform-independent values
+                        "deepfm_dim9_ingest_ab"})
 
 
 def run_suite_isolated(names, steps, timeout_s=3600, profile=""):

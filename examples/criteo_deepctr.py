@@ -14,6 +14,7 @@ self-contained (the reference ships train100.csv for the same reason).
 """
 
 import argparse
+import itertools
 import sys
 import time
 
@@ -28,6 +29,14 @@ def parse_args(argv=None):
                    "empty = synthetic stream")
     p.add_argument("--format", default="csv",
                    choices=["csv", "tsv", "tfrecord"])
+    p.add_argument("--readers", type=int, default=0, metavar="N",
+                   help="stream --data through the parallel shard "
+                        "reader pool (data/stream.py: N reader "
+                        "threads, bounded prefetch ring, worker-side "
+                        "hashing, per-step stall accounting). --data "
+                        "may be a shard DIRECTORY (*.tsv / tf-part.*) "
+                        "or one file; tsv/tfrecord only. 0 = the "
+                        "single-threaded portable readers")
     p.add_argument("--batch_size", type=int, default=4096)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--eval_steps", type=int, default=0)
@@ -172,8 +181,30 @@ def main(argv=None):
     trainer = Trainer(model, coll, optax.adam(args.dense_lr),
                       sparse_as_dense=dense_specs or None)
 
+    streams = []   # open ShardStreams; closed after each consuming loop
+
+    def close_streams():
+        while streams:
+            streams.pop().close()
+
     def batches(limit):
         if args.data:
+            if args.readers > 0 and args.format in ("tsv", "tfrecord"):
+                # parallel shard reader pool: parse + hash on worker
+                # threads, bounded ring, identity-stable batches (the
+                # pipelined plane's lookahead contract), stall-accounted
+                from openembedding_tpu.data import stream as stream_lib
+                reader = stream_lib.ShardStream(
+                    args.data, batch_size=args.batch_size,
+                    fmt=args.format, num_buckets=args.num_buckets,
+                    readers=args.readers,
+                    add_linear=mapper is None,
+                    transform=(mapper.fuse_batch if mapper is not None
+                               else None))
+                streams.append(reader)
+                if limit:
+                    return itertools.islice(reader, limit)
+                return reader
             if args.format == "tsv":
                 reader = criteo.read_criteo_tsv(
                     args.data, args.batch_size,
@@ -181,7 +212,6 @@ def main(argv=None):
             elif args.format == "tfrecord":
                 # the reference's TFRecord benchmark layout
                 # (test/benchmark/criteo_tfrecord.py), read without TF
-                import itertools
                 from openembedding_tpu.data import tfrecord
                 reader = tfrecord.read_criteo_tfrecord(
                     args.data, args.batch_size)
@@ -239,7 +269,10 @@ def main(argv=None):
     n = 0
     guard = None
     try:
-        for i, b in enumerate([first] + list(it)):
+        # chain, never list(it): materializing the tail up front would
+        # defeat the streaming path (--readers) — the reader pool's
+        # bounded ring only bounds host memory if the loop pulls lazily
+        for i, b in enumerate(itertools.chain([first], it)):
             if i >= args.steps:
                 break
             with vtimer("train_step"):
@@ -262,6 +295,7 @@ def main(argv=None):
         # leak guard (an abandoned guard would count compiles forever)
         if guard is not None:
             guard.__exit__(None, None, None)
+        close_streams()
     if guard is not None:
         print(f"retrace guard: {guard.compiles} post-warmup XLA "
               f"compilation(s) (budget {args.retrace_budget})")
@@ -272,9 +306,12 @@ def main(argv=None):
 
     if args.eval_steps:
         auc = StreamingAUC()
-        for i, b in enumerate(batches(args.eval_steps)):
-            scores = trainer.eval_step(state, b)
-            auc.update(b["label"], np.asarray(scores))
+        try:
+            for i, b in enumerate(batches(args.eval_steps)):
+                scores = trainer.eval_step(state, b)
+                auc.update(b["label"], np.asarray(scores))
+        finally:
+            close_streams()
         print(f"eval AUC over {args.eval_steps} batches: {auc.result():.4f}")
 
     if args.save:

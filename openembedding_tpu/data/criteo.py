@@ -25,7 +25,8 @@ loader (ops/native); this module is its portable reference implementation.
 from __future__ import annotations
 
 import collections
-from typing import Dict, Iterable, Iterator, Optional
+import warnings
+from typing import Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -33,6 +34,18 @@ NUM_DENSE = 13
 NUM_SPARSE = 26
 DENSE_NAMES = tuple(f"I{i}" for i in range(1, NUM_DENSE + 1))
 SPARSE_NAMES = tuple(f"C{i}" for i in range(1, NUM_SPARSE + 1))
+
+# bad-row tolerance of the TSV path: rows that cannot be parsed (short/
+# long field count, non-hex categorical, non-numeric count) are SKIPPED
+# and counted (`ingest_bad_rows`); once the bad fraction of a stream
+# exceeds this — with at least MIN_BAD_ROWS_FOR_WARNING seen, so one
+# mangled line in a ten-row fixture doesn't cry wolf — a loud
+# RuntimeWarning names the file. Raw Criteo-1TB has occasional mangled
+# lines; a reader that crashes the whole epoch on row 2.1e9 (the old
+# behavior: ValueError out of `int(v, 16)`) or silently drops half the
+# file (a format mismatch) are both failure modes this guards.
+BAD_ROW_WARN_FRACTION = 0.01
+MIN_BAD_ROWS_FOR_WARNING = 32
 
 
 from ..utils.hashing import mix64  # noqa: E402 — re-export (public here)
@@ -51,23 +64,80 @@ def _squash_dense(cols: np.ndarray) -> np.ndarray:
     return np.log1p(np.maximum(cols.astype(np.float32), 0.0))
 
 
+def parse_tsv_row(line: str) -> Optional[Tuple[float, list, list]]:
+    """One raw Criteo TSV row -> ``(label, dense ints, sparse ints)``,
+    or None for a row that cannot be parsed (wrong field count, a
+    non-hex categorical, a non-numeric count) — the caller skips and
+    counts it (:func:`note_bad_rows`). Missing fields parse as 0;
+    categoricals get +1 so a present ``0`` id stays distinct from a
+    missing one."""
+    parts = line.rstrip("\n").split("\t")
+    if len(parts) != 1 + NUM_DENSE + NUM_SPARSE:
+        return None
+    try:
+        label = float(parts[0] or 0)
+        dense = [int(v) if v else 0 for v in parts[1:1 + NUM_DENSE]]
+        sparse = [int(v, 16) + 1 if v else 0
+                  for v in parts[1 + NUM_DENSE:]]
+    except ValueError:
+        return None
+    return label, dense, sparse
+
+
+def note_bad_rows(n_new: int, n_bad: int, n_total: int, source: str,
+                  warned: list, *,
+                  threshold: float = BAD_ROW_WARN_FRACTION) -> None:
+    """Account ``n_new`` newly skipped rows: bumps the global
+    ``ingest_bad_rows`` counter and — once per ``warned`` box, when the
+    CUMULATIVE bad fraction ``n_bad / n_total`` crosses ``threshold``
+    with at least :data:`MIN_BAD_ROWS_FOR_WARNING` bad rows seen —
+    emits a loud RuntimeWarning naming ``source``. ``warned`` is a
+    caller-held mutable box (``[]`` = not yet warned) so one stream
+    warns once, not per batch."""
+    if not n_new:
+        return
+    from ..utils import observability
+    observability.GLOBAL.add("ingest_bad_rows", float(n_new))
+    if not warned and n_bad >= MIN_BAD_ROWS_FOR_WARNING \
+            and n_bad > threshold * max(1, n_total):
+        warned.append(True)
+        warnings.warn(
+            f"{source}: skipped {n_bad} unparseable row(s) of "
+            f"{n_total} ({n_bad / max(1, n_total):.1%} > "
+            f"{threshold:.1%} threshold) — wrong column count or "
+            "non-hex categoricals; is this really raw Criteo TSV "
+            "(label \\t 13 ints \\t 26 hex)?", RuntimeWarning,
+            stacklevel=3)
+
+
 def read_criteo_tsv(path: str, batch_size: int, *,
                     num_buckets: int = 1 << 25,
                     max_batches: Optional[int] = None,
                     drop_remainder: bool = True) -> Iterator[Dict]:
-    """Stream batches from a raw Criteo TSV (label \\t 13 ints \\t 26 hex)."""
+    """Stream batches from a raw Criteo TSV (label \\t 13 ints \\t 26 hex).
+
+    Unparseable rows are SKIPPED and counted (``ingest_bad_rows``
+    global counter; loud RuntimeWarning past
+    :data:`BAD_ROW_WARN_FRACTION`) — a single mangled line must not
+    crash an epoch 2 billion rows in. The parallel shard-pool fast path
+    is ``data.stream.ShardStream``; this is its portable single-file
+    reference (same row semantics, same bad-row accounting).
+    """
     labels, dense, sparse = [], [], []
     produced = 0
+    n_bad = n_total = 0
+    warned: list = []
     with open(path, "r") as f:
         for line in f:
-            parts = line.rstrip("\n").split("\t")
-            if len(parts) != 1 + NUM_DENSE + NUM_SPARSE:
+            n_total += 1
+            row = parse_tsv_row(line)
+            if row is None:
+                n_bad += 1
+                note_bad_rows(1, n_bad, n_total, path, warned)
                 continue
-            labels.append(float(parts[0] or 0))
-            dense.append([int(v) if v else 0
-                          for v in parts[1:1 + NUM_DENSE]])
-            sparse.append([int(v, 16) + 1 if v else 0
-                           for v in parts[1 + NUM_DENSE:]])
+            labels.append(row[0])
+            dense.append(row[1])
+            sparse.append(row[2])
             if len(labels) == batch_size:
                 yield _emit(labels, dense, sparse, num_buckets)
                 labels, dense, sparse = [], [], []

@@ -188,8 +188,19 @@ class EmbeddingCollection:
                     push_precision=spec.push_precision)
 
     # --- dirty tracking (delta checkpoints, checkpoint.py mode="delta") ----
-    def enable_dirty_tracking(self, *, target_chunks: int = 1024) -> None:
+    def enable_dirty_tracking(self, *, target_chunks: int = 1024,
+                              names=None) -> None:
         """Arm chunk-level dirty bitmaps for every variable (idempotent).
+
+        ``names``: restrict tracking to a subset of variables. ONLY for
+        variables whose rows persist through their own path — the
+        offload tier's ``ShardedOffloadedTable.persist`` is the case
+        this exists for (its TrainState entry is a transient HBM cache;
+        delta-chaining the cache would checkpoint residency noise, not
+        the model). A delta save writes chunks for TRACKED variables
+        only: an untracked variable that trains between the base and a
+        restore silently reverts to its base rows — never exclude a
+        variable something else doesn't durably own.
 
         Required before ``checkpoint.save_checkpoint(mode="delta")``:
         pushes mark chunks (the Trainer feeds every stepped batch's ids
@@ -208,8 +219,19 @@ class EmbeddingCollection:
         to the base.
         """
         from .dirty import make_array_tracker, make_hash_tracker
+        if names is not None:
+            unknown = set(names) - set(self.specs)
+            if unknown:
+                # a typo here would silently leave a variable untracked
+                # and its trained rows reverting to base on a delta
+                # restore — exactly the corruption mode above
+                raise ValueError(
+                    f"enable_dirty_tracking: unknown variable(s) "
+                    f"{sorted(unknown)}; known: {sorted(self.specs)}")
         for name, spec in self.specs.items():
             if name in self._dirty_trackers:
+                continue
+            if names is not None and name not in names:
                 continue
             if spec.use_hash:
                 self._dirty_trackers[name] = make_hash_tracker(

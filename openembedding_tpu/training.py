@@ -20,6 +20,7 @@ tf.Variables (exb.py:100-104, README "Cache" mode).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from functools import partial
 from typing import Any, Callable, Dict, Optional
@@ -630,6 +631,23 @@ class Trainer:
         ShardedOffloadedTable with ``overflow_check_every_n_batches=N``
         to bound detection to N steps (one amortized device read per N).
 
+        Ingest stall accounting: the loop is ingest-aware — each step's
+        window refill (``next(batches)`` on the host critical path) is
+        timed and recorded via ``observability.record_ingest_stall``
+        (``ingest_stall_ms`` histogram + ``ingest_stall`` timer), so a
+        data source that cannot keep step rate shows up as a measured
+        per-step stall instead of an unexplained eps drop. Sources that
+        account their own waits (``data.stream.ShardStream``, marked
+        ``ingest_accounted``) are not double-counted — detected through
+        ANY iterator wrapper (``itertools.chain``/``islice`` hide the
+        marker attribute, so the loop also skips its own record
+        whenever the refill's ``next()`` calls recorded ingest-stall
+        entries themselves); the pre-loop window prime is warmup and
+        never recorded. The identity-keyed
+        lookahead contract holds for any iterator that yields each
+        batch object once (generators and ``ShardStream`` both do) —
+        see :meth:`train_step`.
+
         ``persist_dir``: incremental-persist offloaded tables whenever they
         signal ``should_persist`` — the reference's AutoPersist callback
         (test/benchmark/criteo_deepctr.py:113-124 polling
@@ -640,18 +658,28 @@ class Trainer:
         """
         last = None
         it = iter(batches)
+        # a source that records its own ring waits (ShardStream) must
+        # not have the same stall counted twice by the loop's timer;
+        # the attribute is only the fast path — a wrapped stream
+        # (itertools.chain/islice) hides it, so each refill ALSO
+        # checks whether its next() calls recorded their own entries
+        self_accounted = bool(
+            getattr(batches, "ingest_accounted", False)
+            or getattr(it, "ingest_accounted", False))
         # the lookahead window holds the NEXT pipeline_depth batches; the
         # head of the window is the batch about to step
         window: deque = deque()
 
-        def refill():
+        def refill() -> float:
+            t0 = time.perf_counter()
             while len(window) <= self.pipeline_depth:
                 nxt = next(it, None)
                 if nxt is None:
-                    return
+                    break
                 window.append(nxt)
+            return time.perf_counter() - t0
 
-        refill()
+        refill()   # window prime: warmup, deliberately unrecorded
         i = 0
         guard = None
         try:
@@ -665,7 +693,12 @@ class Trainer:
                         if not self._prep_started(b):
                             self._start_host_prepare(b)
                 batch = window.popleft()
-                refill()
+                pops0 = (None if self_accounted
+                         else observability.ingest_stall_records())
+                stall_s = refill()
+                if not self_accounted \
+                        and observability.ingest_stall_records() == pops0:
+                    observability.record_ingest_stall(stall_s)
                 state, metrics = self.train_step(
                     state, batch,
                     next_batch=window[0] if window else None)

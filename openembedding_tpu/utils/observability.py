@@ -59,6 +59,11 @@ class Accumulator:
             self._times[name] += seconds
             self._calls[name] += 1
 
+    def calls(self, name: str) -> int:
+        """How many times ``add_time(name, ...)`` has run (cheap read)."""
+        with self._lock:
+            return self._calls.get(name, 0)
+
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             out = {name: {"count": v} for name, v in self._counts.items()}
@@ -117,6 +122,34 @@ def record_batch_stats(sparse: Dict[str, np.ndarray],
                                      _uniq.size / arr.size, table=name)
             scope.HISTOGRAMS.observe("pull_key_skew",
                                      counts.max() / arr.size, table=name)
+
+
+def record_ingest_stall(seconds: float, *,
+                        accumulator: Optional[Accumulator] = None,
+                        **labels) -> None:
+    """Per-step ingest stall accounting: the time one step's batch pull
+    BLOCKED on data (``data/stream.py`` ring waits, or — any plain
+    iterator — the ``Trainer.fit`` window-refill wall). Feeds the
+    ``ingest_stall`` timer and the ``ingest_stall_ms`` histogram; a
+    step that found its batch ready records exactly ``0.0``, so "the
+    step never blocks on data after warmup" is checkable as a p95 of
+    literally zero. Always on — one perf_counter pair per step. The
+    ``ShardStream`` records its own pops (it marks itself
+    ``ingest_accounted`` so ``fit`` doesn't double-count the same
+    wait)."""
+    acc = accumulator or GLOBAL
+    acc.add_time("ingest_stall", seconds)
+    scope.HISTOGRAMS.observe("ingest_stall_ms", seconds * 1e3, **labels)
+
+
+def ingest_stall_records(accumulator: Optional[Accumulator] = None) -> int:
+    """Number of ``ingest_stall`` entries recorded so far. The fit loop
+    reads this before/after each window refill to detect — through ANY
+    iterator wrapper — that the source accounted its own waits (a
+    ``ShardStream`` behind ``itertools.chain`` loses its
+    ``ingest_accounted`` attribute but still records per pop), so the
+    same stall is never counted twice."""
+    return (accumulator or GLOBAL).calls("ingest_stall")
 
 
 def record_serving_lookup(name: str, size: float,

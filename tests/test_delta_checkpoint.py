@@ -100,6 +100,19 @@ def test_dirty_tracker_unit():
     assert set(kt.dirty_chunks()) == {5, 11}
 
 
+def test_dirty_tracking_names_subset_rejects_unknown(devices8):
+    """A typo'd `names=` entry must raise at arm time — silently
+    skipping it would leave the intended variable untracked and its
+    trained rows reverting to base on a delta restore."""
+    mesh = create_mesh(2, 4, devices8)
+    coll = make_coll(mesh, track=False)
+    with pytest.raises(ValueError, match="unknown variable.*'hshh'"):
+        coll.enable_dirty_tracking(names={"arr", "hshh"})
+    # valid subset arms only the named variable
+    coll.enable_dirty_tracking(names={"arr"})
+    assert set(coll._dirty_trackers) == {"arr"}
+
+
 def test_delta_requires_tracking_and_matching_optimizer(devices8, tmp_path):
     mesh = create_mesh(2, 4, devices8)
     coll = make_coll(mesh, track=False)

@@ -131,6 +131,69 @@ def test_record_from_bench_conversion():
         {"metric": "m", "value": 1.0, "unit": "examples/s"}) is None
 
 
+def _ingest_entry():
+    return {"metric": "deepfm_dim9_ingest_ab_examples_per_sec_cpu8",
+            "value": 1800.0, "unit": "examples/s", "vs_baseline": 0.01,
+            "eps_min": 1700.0, "eps_max": 1900.0,
+            "stream_vs_mem": 0.97,
+            "ingest": {"stall_p95_ms": 0.0, "stall_p99_ms": 0.0,
+                       "bad_rows": 0, "pops": 15},
+            "config": {"kind": "ingest_ab", "batch": 4096, "dim": 9},
+            "ts": "2026-08-01T00:00:00+00:00"}
+
+
+def test_record_from_bench_ingest_kind():
+    """Ingest A/B entries convert to the synthetic `ingest` plane with
+    the stall/bad-row evidence attached and schema-validated."""
+    rec = gw.record_from_bench(_ingest_entry(), fingerprint=_FP,
+                               device=_DEV)
+    assert rec is not None and gw.validate_record(rec) == []
+    assert rec["plane"] == "ingest" and rec["eps"] == 1800.0
+    assert rec["ingest"]["stall_p95_ms"] == 0.0
+    assert rec["ingest"]["stream_vs_mem"] == 0.97
+    assert rec["ingest"]["bad_rows"] == 0
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (lambda i: i.__setitem__("stall_p95_ms", -1.0),
+     "ingest.stall_p95_ms"),
+    (lambda i: i.__setitem__("stall_p99_ms", "zero"),
+     "ingest.stall_p99_ms"),
+    (lambda i: i.__setitem__("bad_rows", -2), "ingest.bad_rows"),
+    (lambda i: i.__setitem__("bad_rows", 1.5), "ingest.bad_rows"),
+    (lambda i: i.__setitem__("pops", None), "ingest.pops"),
+    (lambda i: i.__setitem__("stream_vs_mem", 0.0),
+     "ingest.stream_vs_mem"),
+])
+def test_ingest_record_schema_lists_problems(mutate, fragment):
+    rec = gw.record_from_bench(_ingest_entry(), fingerprint=_FP,
+                               device=_DEV)
+    mutate(rec["ingest"])
+    problems = gw.validate_record(rec)
+    assert problems and any(fragment in p for p in problems), problems
+
+
+def test_ingest_record_missing_evidence_fails_loudly():
+    """A bench entry missing the A/B ratio or stall evidence must be
+    REJECTED, not defaulted to the perfect value the gate verifies
+    (stream_vs_mem=1.0 / stall_p95_ms=0.0 are exactly those)."""
+    e = _ingest_entry()
+    del e["stream_vs_mem"]
+    with pytest.raises(ValueError, match="stream_vs_mem"):
+        gw.record_from_bench(e, fingerprint=_FP, device=_DEV)
+    e = _ingest_entry()
+    del e["ingest"]["stall_p95_ms"]
+    with pytest.raises(ValueError, match="stall_p95_ms"):
+        gw.record_from_bench(e, fingerprint=_FP, device=_DEV)
+
+
+def test_ingest_record_non_dict_section_rejected():
+    rec = gw.record_from_bench(_ingest_entry(), fingerprint=_FP,
+                               device=_DEV)
+    rec["ingest"] = ["not", "a", "dict"]
+    assert any("ingest:" in p for p in gw.validate_record(rec))
+
+
 # --- the regression gate -----------------------------------------------------
 
 def _trajectory():

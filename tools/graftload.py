@@ -315,6 +315,142 @@ def scrape_batch_stats(endpoints) -> Dict[str, float]:
     return out
 
 
+def run_replica_sweep(args) -> int:
+    """Replica scale-out storm (ROADMAP item 4's remaining half): for
+    each count in ``--replica-sweep``, boot a fresh demo cluster, drive
+    it through :class:`ShardedRoutingClient` (ONE shard group of N
+    replicas — the client's per-request random replica start spreads
+    reads across the fleet, the production read-scale story) with a
+    knee sweep, and compare the sustained knees. On the 1-core cpu
+    window a single replica's capacity is bounded by its own bounded
+    batcher queue + flush cadence (idle wait windows), so additional
+    replica processes genuinely overlap — the scaling measured here is
+    the per-host-capacity story, stated honestly in the record notes.
+    Appends one ``serving`` record for the TOP count's knee (its own
+    baseline group: config carries ``replica_sweep``); exits nonzero
+    when scaling falls below ``--scale-floor`` or any storm errored.
+    """
+    import shutil
+    import tempfile
+    from openembedding_tpu.serving import ha
+    from tools import graftwatch
+
+    counts = sorted({int(x) for x in args.replica_sweep.split(",") if x})
+    if len(counts) < 2:
+        print("graftload: --replica-sweep needs >= 2 counts",
+              file=sys.stderr)
+        return 2
+    rates = ([float(x) for x in args.sweep.split(",") if x]
+             if args.sweep else [200.0, 400.0, 800.0, 1600.0, 2400.0])
+    tmp_dir = tempfile.mkdtemp(prefix="graftload_rsweep_")
+    knees: Dict[int, StormResult] = {}
+    errors = 0
+    try:
+        model_dir = build_demo_checkpoint(os.path.join(tmp_dir, "model"))
+        head = (f"{'replicas':>9}{'offered':>9}{'achieved':>10}"
+                f"{'calls':>7}{'err':>5}{'rej':>6}{'p50_ms':>9}"
+                f"{'p99_ms':>9}")
+        print("\n" + head + "\n" + "-" * len(head))
+        for n in counts:
+            endpoints, procs, _tr = boot_demo_cluster(
+                model_dir, n,
+                batch_rows=args.batch_rows if args.batched else 0,
+                batch_wait_us=args.batch_wait_us,
+                batch_queue_rows=args.batch_queue_rows)
+            client = ha.ShardedRoutingClient([endpoints],
+                                             timeout=args.timeout)
+            try:
+                results = []
+                for ri, rate in enumerate(rates):
+                    send = make_rest_sender(client, DEMO_SIGN, "emb",
+                                            DEMO_VOCAB, args.batch,
+                                            seed=ri)
+                    res = _storm_once(args, "rest", send, rate,
+                                      seed=300 + 10 * n + ri)
+                    results.append(res)
+                    s = res.summary()
+                    print(f"{n:>9}{s['offered_qps']:>9}"
+                          f"{s['achieved_qps']:>10}{s['calls']:>7}"
+                          f"{s['errors']:>5}{s['rejected']:>6}"
+                          f"{s['p50_ms']:>9}{s['p99_ms']:>9}",
+                          flush=True)
+                knee = find_knee(results)
+                if knee is None:
+                    # even the lowest rate saturated: the highest
+                    # achieved-QPS storm with zero errors is the
+                    # honest sustained number
+                    ok = [r for r in results if r.errors == 0]
+                    knee = max(ok, key=lambda r: r.achieved_qps) \
+                        if ok else results[0]
+                knees[n] = knee
+                # errors count against the sweep only at/below the
+                # knee: rates ABOVE it are saturation probes, where an
+                # overloaded single replica sheds load however it can
+                # (429s from the bounded queue, kernel accept-backlog
+                # overflow past that) — the never-error invariant is
+                # the capacity-bounded chaos lane's, not a promise
+                # about 8x overload probes (printed, not fatal)
+                errors += sum(r.errors for r in results
+                              if r.offered_qps <= knee.offered_qps)
+                sat_errors = sum(r.errors for r in results
+                                 if r.offered_qps > knee.offered_qps)
+                if sat_errors:
+                    print(f"  ({n} replica(s): {sat_errors} error(s) "
+                          "in saturation probes above the knee — "
+                          "reported, not gated)", flush=True)
+            finally:
+                client.close()
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    p.wait()
+    finally:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    lo_n, hi_n = counts[0], counts[-1]
+    lo, hi = knees[lo_n].achieved_qps, knees[hi_n].achieved_qps
+    scaling = hi / max(lo, 1e-9)
+    print(f"\nreplica scale-out: {lo_n} replica(s) sustained {lo:.1f} "
+          f"QPS -> {hi_n} replica(s) sustained {hi:.1f} QPS = "
+          f"{scaling:.2f}x (floor {args.scale_floor}x)")
+    rc = 0
+    if errors:
+        print(f"graftload: {errors} request error(s) at or below the "
+              "sustained knee — reads must not fail under capacity",
+              file=sys.stderr)
+        rc = 1
+    if args.scale_floor and scaling < args.scale_floor:
+        print(f"graftload: scaling {scaling:.2f}x below the "
+              f"{args.scale_floor}x floor", file=sys.stderr)
+        rc = 1
+    if args.trajectory and rc == 0:
+        knee = knees[hi_n]
+        config = {"source": "graftload", "replica_sweep": counts,
+                  "batch": args.batch, "workers": args.workers,
+                  "duration": args.duration, "path": "rest",
+                  "client": "sharded", "batched": bool(args.batched)}
+        rec = graftwatch.make_serving_record(
+            routes={"rest": knee.summary()},
+            offered_qps=knee.offered_qps,
+            achieved_qps=knee.achieved_qps, errors=errors,
+            replicas=hi_n, qps_band=knee.per_chunk_qps(),
+            rejected=sum(k.rejected for k in knees.values()),
+            config=config)
+        # per-run measurements ride the serving section, NOT config —
+        # config keys the gate's baseline group and must be stable
+        # across runs of the same sweep
+        rec["serving"]["scaling_vs_min_replicas"] = round(scaling, 3)
+        rec["serving"]["min_replicas_qps"] = round(lo, 1)
+        graftwatch.append_record(args.trajectory, rec)
+        print(f"graftload: appended replica-sweep serving record to "
+              f"{args.trajectory} ({hi_n} replicas, "
+              f"{knee.achieved_qps:.1f} QPS sustained)")
+    print("graftload: ok" if rc == 0 else "graftload: FAILED",
+          flush=True)
+    return rc
+
+
 # --- demo cluster ------------------------------------------------------------
 
 def build_demo_checkpoint(out_dir: str) -> str:
@@ -411,6 +547,21 @@ def main(argv=None) -> int:
                     help="boot a --replicas local cluster on a tiny "
                          "generated checkpoint, storm it, tear it down")
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--replica-sweep", default="",
+                    help="comma-separated replica counts (e.g. 1,3): "
+                         "boot a fresh demo cluster per count, drive it "
+                         "through ShardedRoutingClient with a per-count "
+                         "knee sweep (--sweep rates or a default "
+                         "ladder), report sustained-QPS scaling from "
+                         "the lowest to the highest count, and append "
+                         "ONE serving record for the top count's knee. "
+                         "Exit nonzero when scaling < --scale-floor. "
+                         "ROADMAP item 4's scale-out half; pair with "
+                         "--batched for the batched serving plane")
+    ap.add_argument("--scale-floor", type=float, default=1.6,
+                    help="minimum sustained-QPS scaling the "
+                         "--replica-sweep must show from its lowest to "
+                         "highest replica count (0 disables the gate)")
     ap.add_argument("--model-dir", default="",
                     help="checkpoint dir for --path native (implied by "
                          "--demo)")
@@ -470,6 +621,9 @@ def main(argv=None) -> int:
         args.batch_wait_us = envconfig.DEFAULT_BATCH_WAIT_US
     if args.batch_queue_rows is None:
         args.batch_queue_rows = envconfig.DEFAULT_BATCH_QUEUE_ROWS
+
+    if args.replica_sweep:
+        return run_replica_sweep(args)
 
     rc = 0
     procs: List[Any] = []

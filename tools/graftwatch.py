@@ -231,6 +231,28 @@ def validate_record(rec: Any) -> List[str]:
     mem = rec.get("memory")
     if mem is not None and not isinstance(mem, dict):
         p.append("memory: expected object or null")
+    ingest = rec.get("ingest")
+    if ingest is not None:
+        # streaming-ingest records (bench.py run_ingest_ab -> plane
+        # "ingest"): eps is the streamed examples/s the gate covers;
+        # this section carries the stall/bad-row evidence
+        if not isinstance(ingest, dict):
+            p.append("ingest: expected object or null")
+        else:
+            for k in ("stall_p95_ms", "stall_p99_ms"):
+                v = ingest.get(k)
+                if not isinstance(v, _NUM) or isinstance(v, bool) \
+                        or v < 0:
+                    p.append(f"ingest.{k}: expected number >= 0")
+            for k in ("bad_rows", "pops"):
+                v = ingest.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    p.append(f"ingest.{k}: expected int >= 0")
+            v = ingest.get("stream_vs_mem")
+            if not isinstance(v, _NUM) or isinstance(v, bool) or v <= 0:
+                p.append("ingest.stream_vs_mem: expected positive "
+                         "number")
     serving = rec.get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
@@ -403,6 +425,33 @@ def record_from_bench(result: Mapping[str, Any], *,
     if not all(isinstance(result.get(k), _NUM)
                for k in ("value", "eps_min", "eps_max")):
         return None
+    if isinstance(result.get("ingest"), dict):
+        # streaming-ingest A/B entries land under the synthetic
+        # "ingest" plane (their own baseline group, like "ckpt" and
+        # "serving") with the stall/bad-row evidence attached; the
+        # gate covers the streamed eps exactly like step throughput
+        ing = result["ingest"]
+        rec = make_record(
+            plane="ingest", config=cfg,
+            eps=result["value"], eps_min=result["eps_min"],
+            eps_max=result["eps_max"], fingerprint=fingerprint,
+            device=device, ts=result.get("ts"))
+        # NO defaults: a missing stall/bad-row/A-B measurement must
+        # fail schema validation below, not masquerade as a perfect one
+        # (stall_p95_ms=0.0 or stream_vs_mem=1.0 are exactly the values
+        # the gate exists to verify)
+        rec["ingest"] = {
+            "stall_p95_ms": ing.get("stall_p95_ms"),
+            "stall_p99_ms": ing.get("stall_p99_ms"),
+            "bad_rows": ing.get("bad_rows"),
+            "pops": ing.get("pops"),
+            "stream_vs_mem": result.get("stream_vs_mem"),
+        }
+        bad = validate_record(rec)
+        if bad:
+            raise ValueError(
+                f"assembled ingest record is schema-invalid: {bad}")
+        return rec
     return make_record(
         plane=str(cfg.get("plane", "a2a")), config=cfg,
         eps=result["value"], eps_min=result["eps_min"],
