@@ -42,11 +42,15 @@ implementation (``tests/test_graftproto_replay.py``,
 
 Scope and honesty — what is NOT modeled:
 
-* whole-process trainer crash + reload (the writer-THREAD crash mid-save
-  is; a restarted trainer re-deriving its content version from a load is
-  the multi-host/elastic design ROADMAP item 3 must model first);
+* multi-HOST elastic training (several trainers sharing one chain).
+  Whole-process trainer crash + resume IS modeled now: the
+  :func:`delta_chain` ``trainer_restart`` role (the graftchaos round)
+  covers autosave -> SIGKILL -> ``fit(resume_from=)`` -> continue, with
+  the resumed stream cursor re-derived from the committed manifest
+  ``extra`` — closing the gap this section named since PR 11;
 * unarmed (manifest-less) checkpoint directories — plain full dumps have
-  no chain protocol to check;
+  no chain protocol to check (and the trainer_restart role accordingly
+  treats a crash mid-full-save, before the re-arm, as unresumable);
 * byte-level payload corruption beyond one torn tail per run (the
   ``tear`` budget), and chain/seq counts past the per-model bounds
   stated in each builder's docstring. Bounds are exhaustive WITHIN the
@@ -799,8 +803,11 @@ def ha_registry(*, atomic_commit: bool = True, kills: int = 1,
 def delta_chain(*, commit_order: str = "payload_first",
                 carry_seq_on_full: bool = True,
                 compact_content_seq: bool = True,
+                resume_cursor: str = "exact",
                 max_seq: int = 3, fulls: int = 1, crashes: int = 1,
-                tears: int = 1, loads: int = 1) -> Model:
+                tears: int = 1, loads: int = 1,
+                trainer_steps: int = 3,
+                trainer_crashes: int = 1) -> Model:
     """The ``checkpoint_delta.py`` chain protocol end to end.
 
     One variable whose base is TWO field files (weights + a slot — the
@@ -841,17 +848,47 @@ def delta_chain(*, commit_order: str = "payload_first",
       (``applied_seq``) equals the content it loaded (the serving
       hot-swap gate depends on this).
 
+    The ``trainer_restart`` role (the elastic-recovery round): the
+    trainer is the process every other role lives inside. It consumes
+    stream batches 1..``trainer_steps`` in order (``Trainer.fit``'s
+    loop; ``t_hi`` = the highest step whose rows its in-memory state
+    holds, ``t_next`` = the stream cursor), and every delta/full save
+    records the cursor at its commit (``save_delta(extra=...)`` — the
+    manifest channel ``fit(autosave_every=)`` writes). A whole-process
+    crash (``trainer_crashes`` budget, distinct from the thread-level
+    ``crashes``) kills the saver AND compactor mid-anything; restore
+    (``fit(resume_from=)`` -> ``load_checkpoint`` + ``ShardStream``
+    ``skip_batches``) re-derives both the state and the stream position
+    from the last COMMITTED manifest entry the load verifies — a torn
+    tail resumes one autosave earlier, exactly like the load does.
+
+    Invariant ``trainer_neither_reapplies_nor_skips_rows``: every batch
+    the (possibly resumed) trainer applies is the successor of its
+    in-memory content — it never re-applies a step whose rows the
+    restored checkpoint already holds and never skips one (the
+    bit-identical-resume contract).
+
     Mutations: ``commit_order="manifest_first"`` commits the manifest
     before the payload (seeded ``manifest_before_payload``);
     ``carry_seq_on_full=False`` re-arms full saves at ``last_seq=0``
     (seq reuse; pre-fix shipped behavior); ``compact_content_seq=False``
     drops the compacted manifest's content version (``applied_seq``
-    reports 0; also pre-fix shipped behavior).
+    reports 0; also pre-fix shipped behavior);
+    ``resume_cursor="zero"`` restores the model state but re-reads the
+    stream from position zero (the dead-reader/naive-restart behavior
+    the ``ShardStream.skip_batches`` contract exists to prevent —
+    seeded ``resume_cursor_from_zero``), ``resume_cursor="skip"``
+    resumes one batch past the cursor (an off-by-one skip — seeded
+    ``resume_cursor_skips_a_step``).
 
     Bounds: ``max_seq`` deltas, one full save, one crash, one tear, one
-    load (with one retry), compaction past 2 chain entries — exhaustive
-    within the budgets (~50k states at the defaults).
+    load (with one retry), ``trainer_steps`` stream batches, one
+    whole-process trainer crash, compaction past 2 chain entries —
+    exhaustive within the budgets (~130k states at the defaults).
     """
+    if resume_cursor not in ("exact", "zero", "skip"):
+        raise ValueError(f"resume_cursor must be exact|zero|skip, "
+                         f"got {resume_cursor!r}")
     init: State = {
         # manifest: None | (gen, last_seq, content_seq, chain tuple)
         "mf": (0, 0, 0, ()),
@@ -865,6 +902,13 @@ def delta_chain(*, commit_order: str = "payload_first",
         "truths": frozenset([0]),
         "crash_left": crashes, "tear_left": tears,
         "full_left": fulls, "load_left": loads, "retry_left": 1,
+        # trainer_restart role: program counter, in-memory content
+        # high-water step, stream cursor, committed-cursor bookkeeping
+        # (seq -> cursor pairs mirror the manifest ``extra`` channel;
+        # base_cursor is what a chainless manifest's base reflects)
+        "t_pc": "run", "t_hi": 0, "t_next": 1,
+        "t_crash_left": trainer_crashes, "t_flag": False,
+        "cursors": (), "base_cursor": 0,
     }
 
     def files_get(s, seq):
@@ -891,12 +935,21 @@ def delta_chain(*, commit_order: str = "payload_first",
         # the trainer's in-memory content = every committed delta
         return max(s["burned"], default=0)
 
+    def committed_cursor(s):
+        """Stream cursor the last committed manifest entry records
+        (the ``extra`` channel) — the base's when the chain is empty."""
+        return s["cursors"][-1][1] if s["cursors"] else s["base_cursor"]
+
     actions: List[Action] = []
 
     # -- delta save ---------------------------------------------------------
     def dw_guard(s):
+        # the saver is the trainer's own thread (fit's blocking
+        # autosave): no save from a dead process, and no empty delta —
+        # a save needs rows the last commit does not cover
         return s["mf"] is not None and s["saver"] == ("idle",) \
-            and s["comp"] == ("off",) and s["mf"][1] < max_seq
+            and s["comp"] == ("off",) and s["mf"][1] < max_seq \
+            and s["t_pc"] == "run" and s["t_hi"] > committed_cursor(s)
 
     def commit_seq(s, seq):
         gen, _last, cseq, chain = s["mf"]
@@ -905,6 +958,9 @@ def delta_chain(*, commit_order: str = "payload_first",
         s["burned"] = s["burned"] | {seq}
         s["mf"] = (gen, seq, cseq, chain + (seq,))
         s["truths"] = s["truths"] | {seq}
+        # the manifest entry's extra records the trainer cursor at the
+        # save (t_hi cannot move mid-save: fit's autosave is blocking)
+        s["cursors"] = s["cursors"] + ((seq, s["t_hi"]),)
 
     def write_branches(s, seq):
         """A payload lands whole, or — tear budget — torn: fs.open_atomic
@@ -973,14 +1029,18 @@ def delta_chain(*, commit_order: str = "payload_first",
     # -- full save ----------------------------------------------------------
     def fs_guard(s):
         return s["saver"] == ("idle",) and s["comp"] == ("off",) \
-            and s["full_left"] > 0 and s["mf"] is not None
+            and s["full_left"] > 0 and s["mf"] is not None \
+            and s["t_pc"] == "run"
 
     def fs_reset_apply(s):
         carried = s["mf"][1] if carry_seq_on_full else 0
         s["mf"] = None
         s["files"] = ()            # reset_chain GCs every delta file
+        s["cursors"] = ()          # the chain entries' extras go with it
         s["full_left"] -= 1
-        s["saver"] = ("fr", carried)
+        # the dump will hold every in-memory row: capture the cursor
+        # the re-armed manifest records (t_hi frozen — blocking save)
+        s["saver"] = ("fr", carried, s["t_hi"])
     actions.append(Action("full_reset_chain", "saver", fs_guard,
                           fs_reset_apply, syncs=("ckpt.full.reset",)))
 
@@ -989,7 +1049,7 @@ def delta_chain(*, commit_order: str = "payload_first",
 
     def fw0_apply(s):
         s["f0"] = live(s)
-        s["saver"] = ("f0", s["saver"][1])
+        s["saver"] = ("f0",) + s["saver"][1:]
     actions.append(Action("full_write_f0", "saver", fw0_guard, fw0_apply,
                           syncs=("ckpt.writer.run",)))
 
@@ -998,7 +1058,7 @@ def delta_chain(*, commit_order: str = "payload_first",
 
     def fw1_apply(s):
         s["f1"] = live(s)
-        s["saver"] = ("f1", s["saver"][1])
+        s["saver"] = ("f1",) + s["saver"][1:]
     actions.append(Action("full_write_f1", "saver", fw1_guard, fw1_apply,
                           syncs=("ckpt.writer.run",)))
 
@@ -1009,6 +1069,7 @@ def delta_chain(*, commit_order: str = "payload_first",
         carried = s["saver"][1]
         s["mf"] = (s["gen_next"], carried, carried, ())
         s["gen_next"] += 1
+        s["base_cursor"] = s["saver"][2]
         s["saver"] = ("idle",)
     actions.append(Action("full_arm", "saver", fa_guard, fa_apply,
                           syncs=("ckpt.full.arm",)))
@@ -1037,6 +1098,7 @@ def delta_chain(*, commit_order: str = "payload_first",
         # them; checkpoint_delta._compact_impl now aborts instead)
         chain = s["mf"][3] if s["mf"] is not None else ()
         return s["comp"] == ("off",) and s["saver"] == ("idle",) \
+            and s["t_pc"] == "run" \
             and len(chain) >= 2 and verified_tail(s) == chain[-1]
 
     def comp_start_apply(s):
@@ -1079,6 +1141,11 @@ def delta_chain(*, commit_order: str = "payload_first",
         cseq = folded if compact_content_seq else 0
         s["mf"] = (s["gen_next"], s["mf"][1], cseq, ())
         s["gen_next"] += 1
+        # the folded base now reflects the folded tail's cursor; the
+        # chain (and its per-entry extras) is gone
+        s["base_cursor"] = dict(s["cursors"]).get(folded,
+                                                  s["base_cursor"])
+        s["cursors"] = ()
         s["comp"] = ("gc",)
     actions.append(Action("compact_commit", "compactor",
                           comp_commit_guard, comp_commit_apply,
@@ -1103,6 +1170,72 @@ def delta_chain(*, commit_order: str = "payload_first",
         s["crash_left"] -= 1
     actions.append(Action("crash_compactor", "chaos", crash_comp_guard,
                           crash_comp_apply))
+
+    # -- trainer_restart role ----------------------------------------------
+    def t_step_guard(s):
+        # fit's loop: one batch at a time, never while its own blocking
+        # autosave is in flight
+        return s["t_pc"] == "run" and s["saver"] == ("idle",) \
+            and s["t_next"] <= trainer_steps
+
+    def t_step_apply(s):
+        k = s["t_next"]
+        if k <= s["t_hi"] or k > s["t_hi"] + 1:
+            # the batch is not the successor of the in-memory content:
+            # a re-applied committed step (k <= t_hi) or a skipped one
+            s["t_flag"] = True
+        s["t_hi"] = max(s["t_hi"], k)
+        s["t_next"] = k + 1
+    actions.append(Action("trainer_step", "trainer", t_step_guard,
+                          t_step_apply, syncs=("trainer.fit.step",)))
+
+    def t_crash_guard(s):
+        return s["t_pc"] == "run" and s["t_crash_left"] > 0
+
+    def t_crash_apply(s):
+        # whole-PROCESS death (SIGKILL at any sync point): the saver
+        # and the background compactor die with it — uncommitted
+        # payloads stay orphans, a mid-full-save dir stays unarmed, a
+        # mid-fold compactor leaves partially-folded fields under the
+        # old manifest. In-memory rows past the last commit are gone.
+        s["t_crash_left"] -= 1
+        s["t_pc"] = "dead"
+        s["saver"] = ("idle",)
+        s["comp"] = ("off",)
+    actions.append(Action("trainer_crash", "chaos", t_crash_guard,
+                          t_crash_apply))
+
+    def t_loadable(s):
+        # what load_checkpoint accepts: every non-final chain entry
+        # verifies (a bad FINAL is dropped whole, a bad middle raises)
+        chain = s["mf"][3]
+        return all(files_get(s, q) == "ok" for q in chain[:-1])
+
+    def t_restore_guard(s):
+        # fit(resume_from=): a committed manifest must exist and load —
+        # a crash mid-full-save (mf None) has nothing to resume from
+        # and the dead trainer is an accepted end state
+        return s["t_pc"] == "dead" and s["mf"] is not None \
+            and t_loadable(s)
+
+    def t_restore_apply(s):
+        # the restored content and the stream cursor BOTH come from the
+        # entry the load actually applies: a torn tail resumes one
+        # autosave earlier, exactly like the load recovers
+        tail = verified_tail(s)
+        cur = (dict(s["cursors"]).get(tail, s["base_cursor"])
+               if tail is not None else s["base_cursor"])
+        s["t_pc"] = "run"
+        s["t_hi"] = cur
+        if resume_cursor == "exact":
+            s["t_next"] = cur + 1
+        elif resume_cursor == "zero":
+            s["t_next"] = 1            # naive restart: stream from 0
+        else:
+            s["t_next"] = cur + 2      # off-by-one: skips a batch
+    actions.append(Action("trainer_restore", "trainer", t_restore_guard,
+                          t_restore_apply,
+                          syncs=("trainer.resume.restore",)))
 
     # -- loader -------------------------------------------------------------
     def lm_guard(s):
@@ -1224,7 +1357,13 @@ def delta_chain(*, commit_order: str = "payload_first",
         _pc, version, v0, _v1, _miss = s["loader"]
         return version == v0
 
+    def inv_trainer_rows(s):
+        return not s["t_flag"]
+
     def is_done(s):
+        # a dead trainer with nothing to resume from is an accepted end
+        # (the crash-and-never-restart run); everything else quiesces
+        # as before
         return s["saver"] == ("idle",) and s["comp"] == ("off",) \
             and s["loader"][0] in ("off", "done", "err")
 
@@ -1233,12 +1372,15 @@ def delta_chain(*, commit_order: str = "payload_first",
         [("load_is_committed_consistent", inv_consistent),
          ("no_silent_commit_loss", inv_no_silent_loss),
          ("seqs_never_reused", inv_no_reuse),
-         ("load_version_matches_content", inv_version)],
+         ("load_version_matches_content", inv_version),
+         ("trainer_neither_reapplies_nor_skips_rows", inv_trainer_rows)],
         is_done,
         notes="delta save -> atomic manifest commit, full-save chain "
               "reset, background compaction, crash/tear budgets, loads "
               "racing everything (checkpoint_delta.py + "
-              "checkpoint.load_checkpoint retry)")
+              "checkpoint.load_checkpoint retry) + trainer_restart: "
+              "autosave cursor extras, whole-process crash, "
+              "fit(resume_from=) cursor-exact resume")
 
 
 # ---------------------------------------------------------------------------

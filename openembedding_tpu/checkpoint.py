@@ -249,7 +249,9 @@ def save_checkpoint(path: str,
                     compress: str = "",
                     mode: str = "full",
                     step: int = 0,
-                    max_workers: Optional[int] = None) -> Dict[str, Any]:
+                    max_workers: Optional[int] = None,
+                    extra: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
     """Dump all embedding variables (+ optional dense pytree) under ``path``.
 
     Works single- or multi-host: with N > 1 processes each host streams its
@@ -282,6 +284,12 @@ def save_checkpoint(path: str,
     parallel per-shard writer threads (``OE_CKPT_WRITERS`` /
     ``max_workers``; 1 serializes). Returns an info dict
     (mode/bytes/seconds, plus seq/chain length for delta saves).
+
+    ``extra``: JSON-serializable bookkeeping committed with the save —
+    delta saves stamp their chain entry, full saves the re-armed
+    manifest base. ``load_checkpoint(info=...)`` returns it as
+    ``info["resume_extra"]`` resolved against what the load actually
+    applied (the ``Trainer.fit`` autosave/resume channel).
     """
     if mode not in ("full", "delta"):
         raise ValueError(f"unknown checkpoint mode {mode!r}; "
@@ -295,12 +303,14 @@ def save_checkpoint(path: str,
                 path, collection, states, step=step,
                 dense_state=dense_state,
                 include_optimizer=include_optimizer, compress=compress,
-                model_sign=model_sign, max_workers=max_workers)
+                model_sign=model_sign, max_workers=max_workers,
+                extra=extra)
         t0 = _time.perf_counter()
         nbytes = _save_checkpoint_impl(
             path, collection, states, dense_state=dense_state,
             include_optimizer=include_optimizer, model_sign=model_sign,
-            compress=compress, step=step, max_workers=max_workers)
+            compress=compress, step=step, max_workers=max_workers,
+            extra=extra)
         dt = _time.perf_counter() - t0
         observability.record_ckpt_save("full", nbytes, dt, chain_len=0)
         return {"mode": "full", "bytes": int(nbytes),
@@ -316,7 +326,8 @@ def _save_checkpoint_impl(path: str,
                           model_sign: str,
                           compress: str,
                           step: int = 0,
-                          max_workers: Optional[int] = None) -> int:
+                          max_workers: Optional[int] = None,
+                          extra: Optional[Dict[str, Any]] = None) -> int:
     """Full dump; returns the logical bytes written (table rows + slots,
     pre-compression — the rate the ``ckpt_write_gbps`` gauge reports)."""
     from . import checkpoint_delta as cd
@@ -465,7 +476,7 @@ def _save_checkpoint_impl(path: str,
         sync_point("ckpt.full.arm")
         cd.init_manifest(path, step=step,
                          include_optimizer=include_optimizer,
-                         last_seq=carried_seq)
+                         last_seq=carried_seq, extra=extra)
     _sync("ckpt_done")
     return nbytes
 
@@ -1163,7 +1174,10 @@ def load_checkpoint(path: str,
 
     ``info`` (a caller-supplied dict) receives ``applied_seq``: the
     chain version THIS load's states actually reflect, from the same
-    verify pass the replay used. Version-sensitive callers (the serving
+    verify pass the replay used — plus ``resume_extra``, the caller
+    bookkeeping committed with that exact version (the
+    ``Trainer.fit(resume_from=)`` channel; ``{}`` when the save carried
+    none). Version-sensitive callers (the serving
     registry's hot-swap gate) must use it instead of a separate
     ``checkpoint_delta.applied_seq`` read — against a directory a
     trainer is actively saving into, a second read can see a newer
@@ -1276,8 +1290,10 @@ def _load_checkpoint_impl(path: str,
                               dump_meta=dump_meta, info=info)
     elif info is not None:
         # chainless: the base bytes reflect content_seq (0 for plain
-        # full dumps and pre-content_seq manifests)
+        # full dumps and pre-content_seq manifests) and the manifest
+        # base's extra (what the full save was stamped with)
         info["applied_seq"] = cd.verified_seq(manifest, [])
+        info["resume_extra"] = cd.resume_extra(manifest, [])
     for name in out:
         # cached-plane variables come back with a fresh all-pad replica;
         # the first HotCacheManager refresh re-admits the hot set

@@ -95,7 +95,9 @@ def _write_manifest(path: str, manifest: Dict[str, Any]) -> None:
 
 def init_manifest(path: str, *, step: int, include_optimizer: bool,
                   last_seq: int = 0,
-                  content_seq: Optional[int] = None) -> Dict[str, Any]:
+                  content_seq: Optional[int] = None,
+                  extra: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
     """Arm a fresh chain over a just-written full base. ``last_seq``
     carries the version counter across a compaction AND across a full
     save over an armed dir (seqs are burned, never reused — the serving
@@ -106,7 +108,12 @@ def init_manifest(path: str, *, step: int, include_optimizer: bool,
     ``content_seq`` records the chain seq the BASE BYTES already
     reflect, so ``applied_seq`` of a chainless manifest reports the true
     version instead of 0 (a full save dumps the live state = everything
-    through ``last_seq``, hence the default)."""
+    through ``last_seq``, hence the default).
+
+    ``extra``: caller bookkeeping recorded WITH the commit — the elastic
+    resume channel (``Trainer.fit(autosave_every=)`` records its step/
+    epoch/ingest cursor here; ``resume_from`` restores from whatever
+    entry the load verifies). JSON-serializable dict."""
     manifest = {"format": DELTA_FORMAT,
                 "base_id": uuid.uuid4().hex,
                 "base_step": int(step),
@@ -114,6 +121,7 @@ def init_manifest(path: str, *, step: int, include_optimizer: bool,
                 "last_seq": int(last_seq),
                 "content_seq": int(last_seq if content_seq is None
                                    else content_seq),
+                "extra": dict(extra) if extra else {},
                 "chain": []}
     _write_manifest(path, manifest)
     return manifest
@@ -350,7 +358,8 @@ def save_delta(path: str, collection: EmbeddingCollection,
                compact_chain_len: int = COMPACT_CHAIN_LEN,
                compact_bytes_ratio: float = COMPACT_BYTES_RATIO,
                background_compact: bool = True,
-               return_payload: bool = False) -> Dict[str, Any]:
+               return_payload: bool = False,
+               extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One incremental save: dirty chunks since the last save -> one new
     chain entry. Forces a FULL save when no armed base exists (first
     save into a directory, or the previous dump predates dirty
@@ -361,6 +370,13 @@ def save_delta(path: str, collection: EmbeddingCollection,
     path for serving hot-swap. Prefer it over a post-save
     :func:`read_delta`: the background compactor may fold the chain
     (deleting the file) before a disk read lands.
+
+    ``extra``: JSON-serializable caller bookkeeping committed WITH this
+    entry (and carried into the manifest base when the save is forced
+    full) — the elastic-resume channel: ``fit(autosave_every=)`` records
+    ``{"fit": {step, epoch, cursor}}`` here and ``fit(resume_from=)``
+    restores from the entry the load actually verifies, so a torn tail
+    resumes one autosave earlier, never from a half-applied state.
     """
     from . import checkpoint as ckpt
     from .utils import compress as compress_lib
@@ -388,7 +404,8 @@ def save_delta(path: str, collection: EmbeddingCollection,
         nbytes = ckpt._save_checkpoint_impl(
             path, collection, states, dense_state=dense_state,
             include_optimizer=include_optimizer, model_sign=model_sign,
-            compress="", step=step, max_workers=max_workers)
+            compress="", step=step, max_workers=max_workers,
+            extra=extra)
         dt = time.perf_counter() - t0
         observability.record_ckpt_save("full", nbytes, dt, chain_len=0)
         return {"mode": "full", "forced_full": True, "bytes": int(nbytes),
@@ -460,6 +477,8 @@ def save_delta(path: str, collection: EmbeddingCollection,
                  "bytes": sum(i["bytes"] for i in results.values()),
                  "rows": sum(i["rows"] for i in results.values()),
                  "vars": results}
+        if extra:
+            entry["extra"] = dict(extra)
         manifest["chain"].append(entry)
         manifest["last_seq"] = seq
         # the commit point: before this rename readers replay the old
@@ -599,11 +618,14 @@ def replay_chain(path: str, collection: EmbeddingCollection,
     Payloads stream one ENTRY at a time (host memory bounded by one
     delta, never the whole chain — which the compaction budget allows
     to reach a large fraction of the base). ``info`` (when given) gets
-    ``applied_seq`` from the SAME verify pass the replay uses — the
-    version the loaded states actually reflect."""
+    ``applied_seq`` AND ``resume_extra`` from the SAME verify pass the
+    replay uses — the version (and the caller bookkeeping) the loaded
+    states actually reflect: a dropped torn tail's extra is never
+    surfaced."""
     verified, _dropped = verify_chain(path, manifest, keep_payloads=False)
     if info is not None:
         info["applied_seq"] = verified_seq(manifest, verified)
+        info["resume_extra"] = resume_extra(manifest, verified)
     for entry, _ in verified:
         payloads = {name: _entry_payload(path, entry, name)
                     for name in entry["vars"]}
@@ -628,6 +650,23 @@ def verified_seq(manifest: Optional[Dict[str, Any]],
     if verified:
         return int(verified[-1][0]["seq"])
     return int(manifest.get("content_seq", 0))
+
+
+def resume_extra(manifest: Optional[Dict[str, Any]],
+                 verified) -> Dict[str, Any]:
+    """The ``extra`` bookkeeping of an ALREADY-verified chain view: the
+    last verified entry's (the newest commit a load applies), else the
+    manifest base's (what the base bytes were saved with). Same
+    resolution discipline as :func:`verified_seq` — the extra a resume
+    restores must describe exactly the rows the load delivered, so a
+    dropped torn tail's extra (newer than the loaded content) is never
+    returned, and an OLDER entry's is never substituted (its cursor
+    would re-apply rows the newer content already holds)."""
+    if manifest is None:
+        return {}
+    if verified:
+        return dict(verified[-1][0].get("extra") or {})
+    return dict(manifest.get("extra") or {})
 
 
 def applied_seq(path: str) -> int:
@@ -1046,6 +1085,13 @@ def _compact_impl(path: str, *,
                     # compaction — graftproto compact_zero_version)
                     "content_seq": int(entries[-1]["seq"]) if entries
                     else int(manifest.get("content_seq", 0)),
+                    # the folded base absorbs the NEWEST folded entry's
+                    # resume extra (the model's comp_commit carrying
+                    # base_cursor forward) — dropping it would silently
+                    # rewind every elastic resume to cursor 0 after the
+                    # first compaction. Newest entry ONLY: an older
+                    # entry's cursor under newer content re-applies rows
+                    "extra": dict(entries[-1].get("extra") or {}),
                     "chain": []}
     sync_point("ckpt.compact.commit")
     _write_manifest(path, new_manifest)

@@ -177,6 +177,7 @@ class ShardStream:
         # removed at the deterministic point their queue drains
         self._order = list(range(self.readers))
         self._rr = 0
+        self._consumed = 0
         self._raised: Optional[BaseException] = None
         # ring memory ledger source (oe_mem_*{source="ingest/<name>"})
         observability.register_memory_source("ingest", self.name, self)
@@ -345,6 +346,7 @@ class ShardStream:
                     sync_point("ingest.ring.pop")
                     batch = q.popleft()
                     self._rr = (self._rr + 1) % len(self._order)
+                    self._consumed += 1
                     self._cv.notify_all()
                     self._note_stall_locked(stall, t_wait)
                     return batch
@@ -366,6 +368,40 @@ class ShardStream:
         if stall_s > 0.0 and t_wait is not None:
             scope.record_span("ingest.ring", t_wait, stall_s,
                               {"stream": self.name})
+
+    # --- resume positioning ------------------------------------------------
+    def cursor(self) -> int:
+        """Batches consumed so far. Because the batch sequence is a
+        deterministic function of (shard list, readers, batch_size),
+        this integer IS the stream position: a fresh stream built with
+        the same arguments and advanced by :meth:`skip_batches` to the
+        same cursor yields the identical remaining sequence. The
+        Trainer's autosave records this value in the checkpoint
+        manifest so an elastic resume restarts ingest exactly where the
+        committed step left it."""
+        with self._cv:
+            return self._consumed
+
+    def skip_batches(self, n: int) -> int:
+        """Advance the stream by exactly ``n`` batches and return the
+        new cursor. Skipped batches are produced and discarded — rows
+        are still parsed, so a resume pays O(cursor) skip work — but
+        positioning is EXACT: the next ``next()`` yields the same batch
+        the original stream would have yielded at that cursor. Raises
+        ValueError if the stream ends before ``n`` batches (a cursor
+        past the data means the manifest and shard set disagree)."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"skip_batches: n must be >= 0, got {n}")
+        for i in range(n):
+            try:
+                next(self)
+            except StopIteration:
+                raise ValueError(
+                    f"skip_batches({n}): stream exhausted after {i} "
+                    "batches — resume cursor is past the shard set "
+                    "(wrong shards, epochs, or batch_size?)") from None
+        return self.cursor()
 
     # --- accounting --------------------------------------------------------
     def stall_stats(self) -> np.ndarray:
@@ -406,6 +442,7 @@ class ShardStream:
             buffered = [b for q in self._queues for b in q]
             alive = sum(1 for d in self._done if not d)
             rows, bad, emitted = self._rows, self._bad, self._emitted
+            consumed = self._consumed
         nbytes = 0
         for b in buffered:
             for leaf in list(b.values()):
@@ -420,6 +457,7 @@ class ShardStream:
                 "rows_read": float(rows),
                 "bad_rows": float(bad),
                 "batches_emitted": float(emitted),
+                "batches_consumed": float(consumed),
                 "readers_alive": float(alive)}
 
     # --- lifecycle ---------------------------------------------------------

@@ -33,6 +33,7 @@ Capability parity with the reference's serving HA plane:
 
 from __future__ import annotations
 
+import dataclasses
 import http.client
 import io
 import json
@@ -109,7 +110,12 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     from ..parallel.mesh import create_mesh
     from ..utils.envconfig import EnvConfig
 
-    cfg = EnvConfig.load(path=args.config or None).serving
+    cfg_tree = EnvConfig.load(path=args.config or None)
+    cfg = cfg_tree.serving
+    plan = cfg_tree.apply_chaos()
+    if plan is not None:
+        print(f"replica: CHAOS armed ({len(plan.faults)} fault(s))",
+              flush=True)
     port = args.port if args.port is not None else cfg.port
     hash_capacity = (args.hash_capacity if args.hash_capacity is not None
                      else cfg.hash_capacity)
@@ -473,6 +479,51 @@ def wait_ready(endpoint: str, timeout: float = 120.0,
 
 # --- routing client ---------------------------------------------------------
 
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """ONE deadline-budgeted retry policy for every RoutingClient verb.
+
+    Replaces the ad-hoc per-verb behavior (lookups: one rotation then
+    raise; delta pushes: one attempt per endpoint, no retry) with a
+    shared budget: a logical request may spend ``deadline_s`` of wall
+    clock total, across however many fleet rotations fit, with
+    exponential backoff + jitter between rounds (decorrelated enough
+    that a thundering herd of clients doesn't re-storm a recovering
+    replica in lockstep). The deadline is a REQUEST property, not an
+    attempt property — the per-connection HTTP timeout stays separate
+    (``RoutingClient(timeout=)``) and bounds one socket wait.
+
+    Budget spending is observable: ``oe_serving_retry_rounds_total``
+    counts full-fleet rounds that failed and backed off,
+    ``oe_serving_retry_budget_exhausted_total`` counts requests that
+    died at the deadline, and the existing retry/failover counters keep
+    their per-attempt meaning.
+    """
+
+    deadline_s: float = 10.0
+    base_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5       # sleep *= uniform(1 - jitter, 1)
+
+    def __post_init__(self):
+        if self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        if self.base_backoff_s < 0 or self.max_backoff_s < 0:
+            raise ValueError("backoff bounds must be >= 0")
+        if self.multiplier < 1.0:
+            raise ValueError("multiplier must be >= 1")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError("jitter must be in [0, 1]")
+
+    def backoff(self, round_index: int) -> float:
+        """Jittered sleep before round ``round_index + 1`` (0-based:
+        backoff(0) follows the first failed round)."""
+        raw = min(self.max_backoff_s,
+                  self.base_backoff_s * self.multiplier ** round_index)
+        return raw * (1.0 - self.jitter * random.random())
+
 class _NoDelayHTTPConnection(http.client.HTTPConnection):
     """Persistent client connection with Nagle disabled.
 
@@ -506,12 +557,21 @@ class RoutingClient:
     """
 
     def __init__(self, endpoints: Sequence[str], timeout: float = 10.0,
-                 compress: str = ""):
+                 compress: str = "",
+                 policy: Optional[RetryPolicy] = None):
         if not endpoints:
             raise ValueError("need at least one replica endpoint")
         from ..utils import compress as compress_lib
         self.endpoints = list(endpoints)
         self.timeout = timeout
+        # the per-request budget defaults to the per-connection timeout:
+        # a caller that accepted waiting `timeout` on one socket accepts
+        # the same wall budget for the whole retry dance
+        self.policy = policy if policy is not None \
+            else RetryPolicy(deadline_s=timeout)
+        # last delta version each endpoint ACKed, per sign — feeds the
+        # degraded-replica staleness gauge (push_delta)
+        self._acked_versions: Dict[tuple, int] = {}
         # advertised to servers on binary lookups; responses from servers
         # configured with the same message_compress codec arrive packed
         self.compress = compress_lib.check(compress)
@@ -624,71 +684,99 @@ class RoutingClient:
         return json.loads(payload) if payload else None
 
     def _rotate(self, attempt) -> Any:
-        """Shared failover rotation: start at a random replica (load
-        spreading), rotate on dead/busy replicas, raise only when every
-        replica failed — the reference's pick_one_replica + retry.
-        Every attempt is recorded as a ``serving.rpc`` span labeled
-        with the replica and its outcome (ok / ok_failover / busy /
-        failover), carrying the active trace id — the router leg of the
-        request-scoped Perfetto story — and bumps the
-        ``serving_request_retries`` / ``serving_request_failovers``
-        counters on /metrics."""
+        """Shared failover rotation under the ONE retry policy: start at
+        a random replica (load spreading), rotate on dead/busy replicas
+        — the reference's pick_one_replica + retry — and when a whole
+        round fails, back off (exponential + jitter) and rotate again
+        until the per-request deadline is spent. Every attempt is
+        recorded as a ``serving.rpc`` span labeled with the replica and
+        its outcome (ok / ok_failover / busy / failover), carrying the
+        active trace id — the router leg of the request-scoped Perfetto
+        story — and bumps the ``serving_request_retries`` /
+        ``serving_request_failovers`` counters on /metrics; failed
+        rounds bump ``serving_retry_rounds`` and a request that dies at
+        the deadline bumps ``serving_retry_budget_exhausted``."""
+        policy = self.policy
+        deadline = time.monotonic() + policy.deadline_s
         order = list(self.endpoints)
         start = random.randrange(len(order))
         order = order[start:] + order[:start]
         last_err: Optional[Exception] = None
         busy429: Optional[Exception] = None
-        for i, ep in enumerate(order):
-            sync_point("routing.attempt")
-            t0 = time.perf_counter()
-            try:
-                out = attempt(ep)
-            # NOTE: HTTPError subclasses URLError — it must be caught first,
-            # else every 404 would read as a dead replica
-            except urllib.error.HTTPError as e:
-                dt = time.perf_counter() - t0
-                # 409/503: CREATING etc; 429: batcher queue full — THIS
-                # replica is oversubscribed, another may have headroom
-                if e.code in (409, 429, 503):  # busy: try another replica
+        rnd = 0
+        while True:
+            for i, ep in enumerate(order):
+                t0 = time.perf_counter()
+                try:
+                    # inside the try: an injected drop (chaos drop_net
+                    # at this marker) classifies as a dead replica and
+                    # rotates, exactly like a real connection loss
+                    sync_point("routing.attempt")
+                    out = attempt(ep)
+                # NOTE: HTTPError subclasses URLError — it must be caught
+                # first, else every 404 would read as a dead replica
+                except urllib.error.HTTPError as e:
+                    dt = time.perf_counter() - t0
+                    # 409/503: CREATING etc; 429: batcher queue full —
+                    # THIS replica is oversubscribed, another may have
+                    # headroom
+                    if e.code in (409, 429, 503):  # busy: try another
+                        scope.record_span(
+                            "serving.rpc", t0, dt,
+                            {"replica": ep, "outcome": "busy"},
+                            error=f"HTTP{e.code}")
+                        scope.HISTOGRAMS.inc("serving_request_retries")
+                        last_err = e
+                        if e.code == 429:
+                            busy429 = e
+                        continue
                     scope.record_span("serving.rpc", t0, dt,
-                                      {"replica": ep, "outcome": "busy"},
+                                      {"replica": ep, "outcome": "error"},
                                       error=f"HTTP{e.code}")
-                    scope.HISTOGRAMS.inc("serving_request_retries")
+                    raise
+                except (urllib.error.URLError, http.client.HTTPException,
+                        ConnectionError, OSError, TimeoutError) as e:
+                    # dead/unreachable replica — including one killed mid-
+                    # response (IncompleteRead/RemoteDisconnected): rotate
+                    scope.record_span("serving.rpc", t0,
+                                      time.perf_counter() - t0,
+                                      {"replica": ep,
+                                       "outcome": "failover"},
+                                      error=type(e).__name__)
+                    scope.HISTOGRAMS.inc("serving_request_failovers")
                     last_err = e
-                    if e.code == 429:
-                        busy429 = e
                     continue
-                scope.record_span("serving.rpc", t0, dt,
-                                  {"replica": ep, "outcome": "error"},
-                                  error=f"HTTP{e.code}")
-                raise
-            except (urllib.error.URLError, http.client.HTTPException,
-                    ConnectionError, OSError, TimeoutError) as e:
-                # dead/unreachable replica — including one killed mid-
-                # response (IncompleteRead/RemoteDisconnected): rotate
-                scope.record_span("serving.rpc", t0,
-                                  time.perf_counter() - t0,
-                                  {"replica": ep, "outcome": "failover"},
-                                  error=type(e).__name__)
-                scope.HISTOGRAMS.inc("serving_request_failovers")
-                last_err = e
-                continue
-            scope.record_span("serving.rpc", t0, time.perf_counter() - t0,
-                              {"replica": ep,
-                               "outcome": "ok" if i == 0 else "ok_failover"})
-            return out
-        if busy429 is not None:
-            # SOME replica rejected with batcher backpressure (even if
-            # the others were dead — the chaos + backpressure mix):
-            # surface the 429 itself, not a dead-replica error — the
-            # caller (graftload) must count a rejection, and a retrying
-            # client should back off, not failover-probe. Tracked on
-            # its own flag: last_err holds whichever replica failed
-            # LAST in rotation order, which under a mixed storm is a
-            # coin flip between the dead one and the busy one.
-            raise busy429
+                scope.record_span(
+                    "serving.rpc", t0, time.perf_counter() - t0,
+                    {"replica": ep,
+                     "outcome": "ok" if rnd == 0 and i == 0
+                     else "ok_failover"})
+                return out
+            if busy429 is not None:
+                # SOME replica rejected with batcher backpressure (even
+                # if the others were dead — the chaos + backpressure
+                # mix): surface the 429 itself NOW, without spending
+                # retry budget — backpressure is an ANSWER, not an
+                # outage, and the caller (graftload) must count a
+                # rejection promptly so overload propagates instead of
+                # amplifying into deadline-long client stalls. Tracked
+                # on its own flag: last_err holds whichever replica
+                # failed LAST in rotation order, which under a mixed
+                # storm is a coin flip between the dead and busy one.
+                raise busy429
+            # the whole fleet is DEAD this round: spend retry budget —
+            # a respawning replica (the kill-and-respawn chaos lane)
+            # rejoins within a backoff or two
+            sleep = policy.backoff(rnd)
+            rnd += 1
+            if time.monotonic() + sleep >= deadline:
+                scope.HISTOGRAMS.inc("serving_retry_budget_exhausted")
+                break
+            scope.HISTOGRAMS.inc("serving_retry_rounds")
+            time.sleep(sleep)
         raise ConnectionError(
-            f"no live replica among {self.endpoints}: {last_err}")
+            f"no live replica among {self.endpoints} within "
+            f"{policy.deadline_s:.3g}s ({rnd} round(s)): {last_err}")
 
     def _failover(self, method: str, path: str, body=None) -> Any:
         return self._rotate(
@@ -772,27 +860,79 @@ class RoutingClient:
             signs.append(out["model_sign"])
         return signs
 
+    def _push_one(self, ep: str, path: str, body: bytes,
+                  deadline: float) -> bytes:
+        """One endpoint's delta push under the shared retry policy:
+        connection-class failures retry with backoff until ``deadline``;
+        an HTTP status is a definite server answer and never retries
+        (delta applies are idempotent — a stale seq ACKs as a no-op —
+        so the retries themselves are safe)."""
+        rnd = 0
+        while True:
+            try:
+                return self._request_bin(ep, path, body)
+            except urllib.error.HTTPError:
+                raise
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, OSError, TimeoutError):
+                sleep = self.policy.backoff(rnd)
+                rnd += 1
+                if time.monotonic() + sleep >= deadline:
+                    scope.HISTOGRAMS.inc("serving_retry_budget_exhausted")
+                    raise
+                scope.HISTOGRAMS.inc("serving_request_retries")
+                time.sleep(sleep)
+
     def push_delta(self, sign: str, delta) -> List[Dict[str, Any]]:
         """BROADCAST a trainer-published delta to every replica (the
         streaming train->serve hot-swap, ``registry.apply_delta``) —
         unlike lookups this is not a failover pick: every replica must
         converge to the published version. ``delta`` is a
         ``checkpoint_delta.Delta`` or its ``encode_delta`` bytes.
-        Per-endpoint results carry ``error`` instead of raising, so one
-        dead replica does not stop the rest of the fleet from advancing
-        (it catches up at respawn via ``read_deltas_since`` or reload).
+
+        Runs under the same :class:`RetryPolicy` as lookups (each
+        endpoint retries connection failures with backoff inside the
+        request deadline). Per-endpoint results carry ``error`` instead
+        of raising — GRACEFUL DEGRADATION: a replica that misses the
+        push keeps serving its last-good version (it catches up at
+        respawn via ``read_deltas_since`` or reload), and the fleet's
+        worst version lag is exported as the
+        ``oe_serving_staleness_seq`` gauge (0 = every replica ACKed the
+        newest published seq) with each endpoint's lag in the returned
+        ``staleness`` field.
         """
         from .. import checkpoint_delta as cd
+        from ..utils import observability
         body = bytes(delta) if isinstance(delta, (bytes, bytearray)) \
             else cd.encode_delta(delta)
+        target = None if isinstance(delta, (bytes, bytearray)) \
+            else int(delta.seq)
+        deadline = time.monotonic() + self.policy.deadline_s
         out: List[Dict[str, Any]] = []
         for ep in self.endpoints:
             try:
-                raw = self._request_bin(ep, f"/models/{sign}/delta", body)
-                out.append({"endpoint": ep, **json.loads(raw)})
+                raw = self._push_one(ep, f"/models/{sign}/delta", body,
+                                     deadline)
+                res = {"endpoint": ep, **json.loads(raw)}
+                if "version" in res:
+                    self._acked_versions[(sign, ep)] = int(res["version"])
             except Exception as e:  # noqa: BLE001 — per-replica verdict
-                out.append({"endpoint": ep, "applied": False,
-                            "error": f"{type(e).__name__}: {e}"})
+                res = {"endpoint": ep, "applied": False,
+                       "error": f"{type(e).__name__}: {e}"}
+            out.append(res)
+        # staleness: lag of each replica behind the newest version any
+        # replica (or the delta itself) is known to carry
+        acked = [int(r["version"]) for r in out if "version" in r]
+        if target is None:
+            target = max(acked, default=None)
+        if target is not None:
+            worst = 0
+            for r in out:
+                last = int(r["version"]) if "version" in r else \
+                    self._acked_versions.get((sign, r["endpoint"]), 0)
+                r["staleness"] = max(0, target - last)
+                worst = max(worst, r["staleness"])
+            observability.set_gauge("serving_staleness_seq", float(worst))
         return out
 
     def nodes(self) -> List[Dict[str, Any]]:

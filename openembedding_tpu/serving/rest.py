@@ -175,8 +175,17 @@ def make_handler(registry: ModelRegistry, peers=None, compress: str = ""):
                 if self.path == "/health":
                     # liveness + model catalog: peers restore from this
                     # (the living-replica hand-off, EmbeddingRestoreOperator)
+                    # — each model carries its hot-swap "version", and
+                    # "applied_seq" summarizes the newest delta seq this
+                    # replica has applied across models, so a recovery
+                    # probe (graftload kill-and-respawn, graftchaos) can
+                    # judge catch-up from one liveness read
+                    models = registry.show_models()
                     return self._send(200, {
-                        "ok": True, "models": registry.show_models()})
+                        "ok": True, "models": models,
+                        "applied_seq": max(
+                            (int(m.get("version", 0)) for m in models),
+                            default=0)})
                 if self.path == "/cluster":
                     # cluster liveness through any replica's REST surface —
                     # the controller's node listing over the master registry

@@ -608,7 +608,10 @@ class Trainer:
 
     def fit(self, state: TrainState, batches, *, log_every: int = 0,
             log_fn=print, persist_dir: Optional[str] = None,
-            retrace_budget: Optional[int] = None):
+            retrace_budget: Optional[int] = None,
+            autosave_every: int = 0,
+            autosave_dir: Optional[str] = None,
+            resume_from: Optional[str] = None):
         """Simple host loop over an iterable of batches (model.fit analogue).
 
         Keeps up to ``pipeline_depth`` batches of offload host-prepare in
@@ -655,9 +658,67 @@ class Trainer:
         background thread (``blocking=False``) so the loop keeps training
         during the commit — the update_early_return overlap
         (EmbeddingStoreOperator.cpp:42-57).
+
+        Elastic autosave/resume (the graftproto ``delta_chain`` model's
+        ``trainer_restart`` role, made real):
+
+        * ``autosave_every=N`` with ``autosave_dir``: every N steps the
+          loop BLOCKS and writes a delta autosave of the full
+          TrainState (embedding states + dense params/opt_state) into
+          ``autosave_dir``, recording ``{"fit": {step, epoch, cursor}}``
+          in the manifest extra — ``cursor`` is the count of batches
+          TRAINED so far (epoch-absolute; batches prefetched into the
+          lookahead window but not yet stepped are deliberately NOT
+          counted). Blocking matters: the model's ``trainer_step`` is
+          gated on the saver being idle, so a kill at any sync point
+          can never interleave a step with a half-written autosave.
+        * ``resume_from=DIR``: before the loop, restore TrainState from
+          the newest committed version of the delta chain under DIR and
+          advance ``batches`` to the recorded cursor —
+          ``skip_batches(cursor)`` when the source supports exact
+          positioning (``data.stream.ShardStream``), else ``cursor``
+          plain ``next()`` discards (identical semantics for any
+          deterministic iterator). A missing or never-armed DIR starts
+          fresh at cursor 0, so the same invocation works for launch
+          and every relaunch. Because the restore only ever resumes
+          from a COMMITTED autosave boundary and the batch sequence is
+          deterministic, a killed-and-resumed fit trains bit-identically
+          to an uninterrupted one from that boundary.
+
+        Autosave/resume cover the jitted TrainState only; offloaded
+        tables persist through their own ``persist_dir`` lane, so
+        combining ``autosave_every`` with ``offload`` is refused.
         """
+        if autosave_every:
+            if not autosave_dir:
+                raise ValueError(
+                    "fit(autosave_every=) requires autosave_dir=")
+            if self.offload:
+                raise ValueError(
+                    "fit autosave covers the jitted TrainState only; "
+                    "offloaded tables persist via persist_dir= — don't "
+                    "combine autosave_every with offload")
+        if resume_from is not None and self.offload:
+            raise ValueError(
+                "fit(resume_from=) does not restore offloaded tables; "
+                "restore them via their own persist lane first")
         last = None
         it = iter(batches)
+        base_cursor = 0
+        if resume_from is not None:
+            state, base_cursor = self._restore_fit(state, resume_from)
+            if base_cursor:
+                skip = getattr(batches, "skip_batches", None)
+                if skip is not None:
+                    skip(base_cursor)
+                else:
+                    for k in range(base_cursor):
+                        if next(it, None) is None:
+                            raise ValueError(
+                                f"resume cursor {base_cursor} is past "
+                                f"the batch source (exhausted after "
+                                f"{k}) — wrong source for this "
+                                "checkpoint?")
         # a source that records its own ring waits (ShardStream) must
         # not have the same stall counted twice by the loop's timer;
         # the attribute is only the fast path — a wrapped stream
@@ -699,6 +760,10 @@ class Trainer:
                 if not self_accounted \
                         and observability.ingest_stall_records() == pops0:
                     observability.record_ingest_stall(stall_s)
+                # one step of the delta_chain model's trainer_step
+                # action — the chaos injection site for "kill the
+                # trainer between any two steps"
+                sync_point("trainer.fit.step")
                 state, metrics = self.train_step(
                     state, batch,
                     next_batch=window[0] if window else None)
@@ -720,6 +785,9 @@ class Trainer:
                                                  blocking=False)
                             if log_every:
                                 log_fn(f"persisted {name}: {info}")
+                if autosave_every and (i + 1) % autosave_every == 0:
+                    self._autosave_fit(state, autosave_dir,
+                                       base_cursor + i + 1)
                 if log_every and (i + 1) % log_every == 0:
                     log_fn(
                         f"step {i + 1}: loss={float(metrics['loss']):.5f}")
@@ -749,6 +817,56 @@ class Trainer:
         for table in self.offload.values():
             table.finish()
         return state, last
+
+    def _restore_fit(self, state: TrainState, path: str):
+        """Restore (TrainState, ingest cursor) from the delta chain at
+        ``path`` — fit's ``resume_from`` leg. Commitment is manifest-
+        gated, exactly like the model's ``trainer_restore`` guard: no
+        manifest means nothing was ever committed (fresh launch, or a
+        kill mid-full-save before the arm), and the caller's fresh
+        state at cursor 0 is the correct — bit-identical — restart. A
+        torn delta TAIL resumes one autosave earlier (the verified
+        tail's extra); a damaged chain MIDDLE raises."""
+        from . import checkpoint as ckpt_mod
+        from . import checkpoint_delta as cd
+        # an in-process restart (tests, notebook relaunch) may race the
+        # previous fit's background compactor — join it first; loads
+        # from a fresh process rely on the base_id retry instead
+        cd.join_compactor(path)
+        if cd.read_manifest(path) is None:
+            sync_point("trainer.resume.restore")
+            return state, 0
+        info: Dict[str, Any] = {}
+        states, dense = ckpt_mod.load_checkpoint(
+            path, self.collection,
+            dense_state_template=(state.params, state.opt_state),
+            info=info)
+        params, opt_state = dense
+        fit_extra = (info.get("resume_extra") or {}).get("fit") or {}
+        step = int(fit_extra.get("step", 0))
+        cursor = int(fit_extra.get("cursor", 0))
+        sync_point("trainer.resume.restore")
+        return state.replace(step=jnp.asarray(step, jnp.int32),
+                             params=params, opt_state=opt_state,
+                             emb=states, pipe=None), cursor
+
+    def _autosave_fit(self, state: TrainState, path: str,
+                      cursor: int) -> None:
+        """One BLOCKING delta autosave of the full TrainState with the
+        elastic-resume extra ``{"fit": {step, epoch, cursor}}`` in the
+        manifest. ``cursor`` is epoch-absolute (it spans epochs of the
+        deterministic batch sequence), so ``epoch`` is informational.
+        The first save into an empty dir is a forced full (no manifest
+        yet) — the extra rides the manifest either way."""
+        from . import checkpoint as ckpt_mod
+        step = int(jax.device_get(state.step))
+        extra = {"fit": {"step": step, "epoch": 0,
+                         "cursor": int(cursor)}}
+        with scope.span("trainer.autosave", step=str(step)):
+            ckpt_mod.save_checkpoint(
+                path, self.collection, state.emb,
+                dense_state=(state.params, state.opt_state),
+                mode="delta", step=step, extra=extra)
 
     def _drain_suppressed(self) -> None:
         """Unwind-path drain: join lookahead/persister threads and flush

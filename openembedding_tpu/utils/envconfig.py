@@ -222,9 +222,40 @@ class ReportConfig:
 
 _check(ReportConfig, "report_interval", lambda v: v >= 0, "must be >= 0")
 
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic fault injection (analysis/chaos.py; armed by
+    tools/graftchaos and the serving replica daemon at boot)."""
+
+    # FaultPlan as inline JSON ('{"faults": [{"point": ..., "hit": 1,
+    # "action": "raise"}]}') or a file ref ('@/path/plan.json'). Empty
+    # = chaos disarmed. Env: OE_CHAOS_PLAN.
+    plan: str = ""
+
+    def __post_init__(self):
+        _validate(self)
+
+
+def _plan_ok(v: str) -> bool:
+    if not v:
+        return True
+    if v.lstrip().startswith("@"):
+        return True            # file ref — existence checked at arm time
+    try:
+        from ..analysis import chaos
+        chaos.FaultPlan.from_json(json.loads(v))
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+_check(ChaosConfig, "plan", _plan_ok,
+       "must be empty, '@/path/plan.json', or inline FaultPlan JSON")
+
 _SECTIONS = {"a2a": A2AConfig, "exchange": ExchangeConfig,
              "offload": OffloadConfig, "serving": ServingConfig,
-             "report": ReportConfig}
+             "report": ReportConfig, "chaos": ChaosConfig}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -237,6 +268,7 @@ class EnvConfig:
     offload: OffloadConfig = dataclasses.field(default_factory=OffloadConfig)
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     report: ReportConfig = dataclasses.field(default_factory=ReportConfig)
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
 
     @classmethod
     def load(cls, config: Optional[Dict[str, Any]] = None,
@@ -312,3 +344,12 @@ class EnvConfig:
             return observability.Reporter(
                 self.report.report_interval).start()
         return None
+
+    def apply_chaos(self):
+        """Arm the configured chaos plan (analysis/chaos.py) when one is
+        set; returns the installed FaultPlan or None. Daemon entry
+        points call this so OE_CHAOS_PLAN reaches child processes."""
+        if not self.chaos.plan:
+            return None
+        from ..analysis import chaos
+        return chaos.install_plan(chaos.plan_from_text(self.chaos.plan))

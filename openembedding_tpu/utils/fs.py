@@ -94,6 +94,20 @@ def remove(path: str) -> None:
 
 ATOMIC_TMP_SUFFIX = ".tmp"
 
+# fault-injection seam (analysis/chaos.py torn_write): when set, every
+# LOCAL atomic commit offers the hook the (path, tmp, fileobj) triple
+# first; a True return means the hook performed the commit itself
+# (normally by tearing it). None in production — one global read per
+# commit, same cost model as the sync_point slot.
+_COMMIT_HOOK = None
+
+
+def set_commit_hook(hook) -> None:
+    """Install/clear (None) the atomic-commit interposer. Test/chaos
+    harness facility, not production state."""
+    global _COMMIT_HOOK
+    _COMMIT_HOOK = hook
+
 
 def open_atomic(path: str):
     """Open ``path`` for a crash-consistent whole-file write.
@@ -165,6 +179,9 @@ class _AtomicFile(_AtomicBase):
         os.remove(self._tmp)
 
     def _commit(self) -> None:
+        hook = _COMMIT_HOOK
+        if hook is not None and hook(self._path, self._tmp, self._f):
+            return
         self._f.flush()
         os.fsync(self._f.fileno())
         self._f.close()

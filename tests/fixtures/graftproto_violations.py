@@ -53,6 +53,18 @@ MUTATIONS = [
      "compacting without recording the folded content version: "
      "applied_seq reports 0, every later delta push is refused as a "
      "gap (pre-fix shipped behavior)"),
+    ("resume_cursor_from_zero", "delta_chain", {"resume_cursor": "zero"},
+     "trainer_neither_reapplies_nor_skips_rows",
+     "a resumed trainer that restores the checkpoint state but re-reads "
+     "the stream from position zero: batches already folded into the "
+     "committed checkpoint are applied a second time (the naive-restart "
+     "behavior ShardStream.skip_batches exists to prevent)"),
+    ("resume_cursor_skips_a_step", "delta_chain",
+     {"resume_cursor": "skip"},
+     "trainer_neither_reapplies_nor_skips_rows",
+     "a resume that seeks the stream one batch past the committed "
+     "cursor: the skipped batch's rows are in no checkpoint and no "
+     "replay — silently lost from the trained model"),
     ("normal_before_install", "ha_registry", {"atomic_commit": False},
      "normal_status_implies_model_installed",
      "publishing status=NORMAL before installing the model object: "

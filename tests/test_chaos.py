@@ -1,0 +1,301 @@
+"""graftchaos fault-injection plane (analysis/chaos.py + tools/graftchaos.py).
+
+Pure-host lanes: plan parsing/determinism, the one-shot firing protocol
+over the existing sync-point slot, torn-write crash semantics through
+``fs.open_atomic`` (old committed bytes must survive — the tmp+rename
+protocol's whole promise), env/EnvConfig arming, counter visibility, and
+the sweep tool's target map.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from openembedding_tpu.analysis import chaos
+from openembedding_tpu.analysis import concurrency
+from openembedding_tpu.analysis import scope
+from openembedding_tpu.utils import fs
+
+
+@pytest.fixture(autouse=True)
+def _clean_slot():
+    yield
+    chaos.clear_plan()
+    concurrency.clear_schedule()
+
+
+# --- plan parsing ------------------------------------------------------------
+
+def test_fault_spec_validates():
+    chaos.FaultSpec(point="ckpt.delta.commit", action="raise")
+    with pytest.raises(ValueError, match="action"):
+        chaos.FaultSpec(point="p.q", action="explode")
+    with pytest.raises(ValueError, match="hit"):
+        chaos.FaultSpec(point="p.q", action="raise", hit=0)
+    with pytest.raises(ValueError, match="point"):
+        chaos.FaultSpec(point="", action="raise")
+
+
+def test_plan_json_roundtrip():
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="a.b", action="delay_ms", hit=3, ms=5.0),
+         chaos.FaultSpec(point="c.d", action="kill_thread",
+                         thread="oe-ckpt-*")],
+        seed=7)
+    clone = chaos.FaultPlan.from_json(plan.to_json())
+    assert clone.to_json() == plan.to_json()
+    assert clone.seed == 7 and len(clone.faults) == 2
+
+
+def test_plan_json_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown"):
+        chaos.FaultPlan.from_json(
+            {"faults": [{"point": "a.b", "action": "raise",
+                         "blast_radius": 9}]})
+
+
+def test_plan_from_text_inline_and_file(tmp_path):
+    spec = {"faults": [{"point": "a.b", "action": "raise"}], "seed": 1}
+    inline = chaos.plan_from_text(json.dumps(spec))
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(spec))
+    from_file = chaos.plan_from_text(f"@{p}")
+    assert inline.to_json() == from_file.to_json()
+
+
+# --- firing protocol ---------------------------------------------------------
+
+def test_fires_on_nth_arrival_once():
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="raise", hit=2)])
+    with chaos.active_plan(plan):
+        concurrency.sync_point("x.y")          # arrival 1: pass
+        with pytest.raises(chaos.ChaosError):
+            concurrency.sync_point("x.y")      # arrival 2: fire
+        concurrency.sync_point("x.y")          # one-shot: pass again
+    assert len(plan.injected) == 1
+    assert plan.injected[0]["point"] == "x.y"
+    assert plan.injected[0]["hit"] == 2
+
+
+def test_other_points_and_threads_unaffected():
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="raise",
+                         thread="worker-*")])
+    errs = []
+
+    def arrive(name):
+        try:
+            concurrency.sync_point("x.y")
+        except chaos.ChaosError as e:
+            errs.append(name)
+
+    with chaos.active_plan(plan):
+        concurrency.sync_point("x.other")      # different point: pass
+        arrive("main")                         # thread filter: pass
+        t = threading.Thread(target=lambda: arrive("w"),
+                             name="worker-0")
+        t.start()
+        t.join()
+    assert errs == ["w"]
+
+
+def test_deterministic_injection_sequence():
+    def run():
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec(point="a.b", action="raise", hit=2),
+             chaos.FaultSpec(point="c.d", action="delay_ms", ms=0.0)],
+            seed=3)
+        with chaos.active_plan(plan):
+            for _ in range(3):
+                try:
+                    concurrency.sync_point("a.b")
+                except chaos.ChaosError:
+                    pass
+                concurrency.sync_point("c.d")
+        return [(i["point"], i["action"], i["hit"])
+                for i in plan.injected]
+
+    assert run() == run()
+
+
+def test_kill_thread_unwinds_past_except_exception():
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="kill_thread")])
+    with chaos.active_plan(plan):
+        with pytest.raises(chaos.ChaosKill):
+            try:
+                concurrency.sync_point("x.y")
+            except Exception:  # noqa: BLE001 — must NOT swallow the kill
+                pytest.fail("ChaosKill was caught by except Exception")
+    assert not isinstance(chaos.ChaosKill("x"), Exception)
+
+
+def test_drop_net_is_a_connection_error():
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="drop_net")])
+    with chaos.active_plan(plan):
+        with pytest.raises(ConnectionError):
+            concurrency.sync_point("x.y")
+
+
+def test_injection_counted_and_rendered():
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="ctr.pt", action="raise")])
+    before = scope.HISTOGRAMS.counter(chaos.COUNTER, point="ctr.pt",
+                                      action="raise")
+    with chaos.active_plan(plan):
+        with pytest.raises(chaos.ChaosError):
+            concurrency.sync_point("ctr.pt")
+    after = scope.HISTOGRAMS.counter(chaos.COUNTER, point="ctr.pt",
+                                     action="raise")
+    assert after == before + 1
+    lines = "\n".join(scope.HISTOGRAMS.prometheus_lines())
+    assert 'oe_chaos_injected_total{action="raise",point="ctr.pt"}' \
+        in lines
+
+
+def test_plan_nests_inner_schedule():
+    seen = []
+
+    class Rec:
+        def sync(self, key, point):
+            seen.append(point)
+
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="raise")], inner=Rec())
+    with chaos.active_plan(plan):
+        concurrency.sync_point("a.b")
+    # non-firing arrivals still flow into the nested schedule
+    assert seen == ["a.b"]
+
+
+# --- torn_write through the real atomic writer -------------------------------
+
+def test_torn_write_keeps_old_committed_file(tmp_path):
+    """The crash model: the armed thread's next atomic commit truncates
+    its tmp and dies BEFORE the rename — the old committed bytes survive
+    whole, the half-written tmp stays as debris."""
+    target = str(tmp_path / "manifest.json")
+    with fs.open_atomic(target) as f:
+        f.write(b"OLD-COMMITTED-CONTENT")
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="torn_write")])
+    with chaos.active_plan(plan):
+        concurrency.sync_point("x.y")          # arms, does not raise
+        with pytest.raises(chaos.ChaosKill, match="rename never ran"):
+            with fs.open_atomic(target) as f:
+                f.write(b"NEW-CONTENT-THAT-NEVER-LANDS!")
+        with open(target, "rb") as f:
+            assert f.read() == b"OLD-COMMITTED-CONTENT"
+        debris = [n for n in os.listdir(tmp_path)
+                  if fs.ATOMIC_TMP_SUFFIX in n]
+        assert debris, "expected the torn tmp file as debris"
+        # the tear is consumed: the next commit goes through clean
+        with fs.open_atomic(target) as f:
+            f.write(b"SECOND-TRY")
+        with open(target, "rb") as f:
+            assert f.read() == b"SECOND-TRY"
+    assert [i["action"] for i in plan.injected] == ["torn_write"]
+
+
+def test_torn_write_is_per_thread(tmp_path):
+    """A tear armed on one thread must not fire another thread's
+    commit."""
+    target = str(tmp_path / "f.bin")
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="torn_write")])
+    ok = []
+
+    def other_commit():
+        with fs.open_atomic(target) as f:
+            f.write(b"bystander")
+        ok.append(True)
+
+    with chaos.active_plan(plan):
+        concurrency.sync_point("x.y")          # arms THIS thread
+        t = threading.Thread(target=other_commit)
+        t.start()
+        t.join()
+        assert ok == [True]
+        with open(target, "rb") as f:
+            assert f.read() == b"bystander"
+
+
+def test_commit_hook_cleared_with_plan(tmp_path):
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="x.y", action="torn_write")])
+    chaos.install_plan(plan)
+    chaos.clear_plan()
+    target = str(tmp_path / "f.bin")
+    with fs.open_atomic(target) as f:
+        f.write(b"clean")
+    with open(target, "rb") as f:
+        assert f.read() == b"clean"
+    assert chaos.current_plan() is None
+
+
+# --- arming from the environment --------------------------------------------
+
+def test_install_from_env_inline():
+    env = {"OE_CHAOS_PLAN": json.dumps(
+        {"faults": [{"point": "x.y", "action": "raise"}]})}
+    plan = chaos.install_from_env(env)
+    try:
+        assert plan is not None
+        with pytest.raises(chaos.ChaosError):
+            concurrency.sync_point("x.y")
+    finally:
+        chaos.clear_plan()
+    assert chaos.install_from_env({}) is None
+
+
+def test_envconfig_chaos_section_arms(tmp_path):
+    from openembedding_tpu.utils.envconfig import EnvConfig
+    spec = {"faults": [{"point": "x.y", "action": "raise"}]}
+    cfg = EnvConfig.load(env={"OE_CHAOS_PLAN": json.dumps(spec)})
+    assert cfg.chaos.plan
+    plan = cfg.apply_chaos()
+    try:
+        assert plan is not None and len(plan.faults) == 1
+        assert chaos.current_plan() is plan
+    finally:
+        chaos.clear_plan()
+    # empty section is a no-op
+    assert EnvConfig.load(env={}).apply_chaos() is None
+
+
+def test_envconfig_rejects_malformed_plan():
+    from openembedding_tpu.utils.envconfig import EnvConfig
+    with pytest.raises(ValueError, match="ChaosConfig.plan"):
+        EnvConfig.load(env={"OE_CHAOS_PLAN": "{not json"})
+
+
+# --- the sweep tool's target map --------------------------------------------
+
+def test_discovery_finds_the_load_bearing_points():
+    points = chaos.discover_sync_points()
+    for p in ("ckpt.delta.commit", "trainer.fit.step",
+              "trainer.resume.restore", "ingest.ring.put",
+              "routing.attempt", "registry.swap.commit"):
+        assert p in points
+    # dotted lower_snake names only — never doc-text artifacts
+    assert all("." in p and " " not in p for p in points)
+
+
+def test_sweep_targets_cover_every_swept_point():
+    from tools import graftchaos as gc
+    targets = gc.sweep_targets(["ckpt", "ingest", "serving"], "", None)
+    covered = {p for p, _a, _s in targets}
+    expect = {p for p in chaos.discover_sync_points()
+              if chaos.subsystem_of(p) in ("ckpt", "ingest", "serving")}
+    assert covered == expect
+    # torn_write only where an atomic commit is downstream; drop_net
+    # only where the failover client classifies network errors
+    for p, a, _s in targets:
+        if a == "torn_write":
+            assert chaos.subsystem_of(p) == "ckpt"
+        if a == "drop_net":
+            assert p == "routing.attempt"

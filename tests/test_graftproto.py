@@ -118,7 +118,7 @@ def test_freeze_rejects_unhashable_state_values():
 
 # --- shipped models ----------------------------------------------------------
 
-SHIPPED_MIN_STATES = {"delta_chain": 10_000, "hot_swap": 40,
+SHIPPED_MIN_STATES = {"delta_chain": 100_000, "hot_swap": 40,
                       "dirty_tracker": 100, "ha_registry": 200,
                       "serving_batcher": 2_000}
 

@@ -115,8 +115,14 @@ def test_manifest_before_payload_replay_loses_commit(devices8, tmp_path):
     but a load silently recovers WITHOUT it — and the checker's exported
     schedule is exactly the order the real code traversed."""
     sched = _mutation_schedule("manifest_before_payload")
-    assert sched == ["ckpt.delta.commit", "registry.load.start",
-                     "registry.load.commit"]
+    # the trainer_restart role gates every delta save on new trained
+    # content (t_hi > committed cursor), so the minimal counterexample
+    # leads with the step that produced the rows being saved; the
+    # replay realizes that step with the low-level train() helper
+    # (which does not route through Trainer.fit, hence no sync point)
+    # and pins the writer/load suffix order against the gate below
+    assert sched == ["trainer.fit.step", "ckpt.delta.commit",
+                     "registry.load.start", "registry.load.commit"]
     coll, states, path, ids = _setup(devices8, tmp_path, steps=1)
     states, _ = train(coll, states, seed=7,
                       arr_ids=np.arange(64, 72, dtype=np.int32))
@@ -150,8 +156,11 @@ def test_manifest_before_payload_replay_loses_commit(devices8, tmp_path):
         sign = reg.create_model(path, block=True)
     model = reg.find_model(sign)
     assert model.version == 1
-    # the real code traversed the exported schedule's exact order
-    assert _subsequence(sched, gate.seen), gate.seen
+    # the real code traversed the exported schedule's writer/load
+    # suffix in exact order (the leading trainer.fit.step is the
+    # train() call above — content production, not part of the
+    # commit-order crash window this mutation targets)
+    assert _subsequence(sched[1:], gate.seen), gate.seen
     clear_schedule()
 
 
@@ -339,3 +348,82 @@ def test_registry_version_coheres_with_replayed_chain(devices8, tmp_path,
                                 read_only=True)["arr"])
     np.testing.assert_array_equal(
         want, np.asarray(model.lookup("arr", jnp.asarray(ids2))))
+
+
+# --- mutation replay: elastic resume re-reads the stream from zero -----------
+
+@pytest.mark.slow
+def test_resume_cursor_from_zero_replay(devices8, tmp_path, monkeypatch):
+    """The ``resume_cursor_from_zero`` counterexample executed against
+    the REAL ``Trainer.fit`` resume path: train -> delta autosave
+    commits (cursor rides the manifest extra) -> process dies ->
+    restore. With the one-line mutation (the restored cursor forced to
+    0 — the naive restart ``skip_batches`` exists to prevent), batches
+    already folded into the committed checkpoint apply a SECOND time
+    and the model diverges from the uninterrupted baseline every run —
+    the modeled ``trainer_neither_reapplies_nor_skips_rows`` failure.
+    The unmutated code under identical schedule pressure is
+    bit-identical, and the real code traverses the checker's exported
+    sync-point order exactly."""
+    from test_trainer_elastic import (_assert_identical, _build_trainer,
+                                      _fingerprint, _synthetic_batches)
+    from openembedding_tpu.training import Trainer
+
+    sched = _mutation_schedule("resume_cursor_from_zero")
+    assert sched == ["trainer.fit.step", "ckpt.delta.write",
+                     "ckpt.delta.commit", "trainer.resume.restore",
+                     "trainer.fit.step"]
+
+    mesh = create_mesh(2, 4, devices8)
+    batches = _synthetic_batches(4)
+
+    tr0 = _build_trainer(mesh)
+    s0 = tr0.init(jax.random.PRNGKey(0), tr0.shard_batch(batches[0]))
+    sA, _ = tr0.fit(s0, list(batches))
+    baseline = _fingerprint(tr0, sA)
+
+    # interrupted run, recording the schedule points the real code hits
+    ck = str(tmp_path / "auto")
+    rec = RecordingGate([])            # record-only, nothing gated
+    install_schedule(rec)
+    tr1 = _build_trainer(mesh)
+    s1 = tr1.init(jax.random.PRNGKey(0), tr1.shard_batch(batches[0]))
+    tr1.fit(s1, list(batches[:2]), autosave_every=1, autosave_dir=ck)
+    clear_schedule()
+
+    # MUTATED resume: the one line the model removes — the restored
+    # stream cursor — zeroed, state restore left intact
+    real_restore = Trainer._restore_fit
+
+    def zero_cursor_restore(self, state, path):
+        st, _cursor = real_restore(self, state, path)
+        return st, 0
+
+    monkeypatch.setattr(Trainer, "_restore_fit", zero_cursor_restore)
+    tr2 = _build_trainer(mesh)
+    s2 = tr2.init(jax.random.PRNGKey(0), tr2.shard_batch(batches[0]))
+    s2b, _ = tr2.fit(s2, list(batches), resume_from=ck,
+                     autosave_every=0)
+    monkeypatch.undo()
+    mutated = _fingerprint(tr2, s2b)
+    # batches 0..1 applied twice: the step counter alone betrays it,
+    # and the trained rows drift — the modeled silent re-application
+    assert int(mutated[0]) == len(batches) + 2
+    assert any(x.shape != y.shape or not np.array_equal(x, y)
+               for x, y in zip(baseline, mutated))
+
+    # CONTROL: the unmutated resume under the same schedule pressure
+    # neither reapplies nor skips — bit-identical to the baseline
+    rec2 = RecordingGate([])
+    install_schedule(rec2)
+    tr3 = _build_trainer(mesh)
+    s3 = tr3.init(jax.random.PRNGKey(0), tr3.shard_batch(batches[0]))
+    s3b, _ = tr3.fit(s3, list(batches), resume_from=ck,
+                     autosave_every=1, autosave_dir=ck)
+    clear_schedule()
+    _assert_identical(baseline, _fingerprint(tr3, s3b))
+
+    # the exported counterexample schedule is exactly the order the
+    # real interrupted-run + resume traversed
+    assert _subsequence(sched, rec.seen + rec2.seen), \
+        (sched, rec.seen, rec2.seen)

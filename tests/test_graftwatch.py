@@ -273,3 +273,70 @@ def test_committed_trajectory_gates_clean():
     assert records, "committed trajectory is missing or empty"
     failures, lines = gw.gate(records)
     assert failures == 0, lines
+
+
+# --- recovery records (graftload --respawn / chaos_smoke lanes) --------------
+
+_RECOVERY_CFG = {"lane": "kill-mid-fit", "autosave_every": 2,
+                 "source": "chaos_smoke"}
+
+
+def _recovery_record(ts: str, mttr_s: float):
+    return gw.make_recovery_record(
+        mttr_s=mttr_s, steps_lost=1, bytes_replayed=4096,
+        config=_RECOVERY_CFG, fingerprint=_FP, device=_DEV, ts=ts)
+
+
+def test_recovery_record_schema_roundtrip():
+    rec = _recovery_record("2026-08-01T00:00:00+00:00", 2.5)
+    assert gw.validate_record(rec) == []
+    assert rec["plane"] == "recovery"
+    # eps is recoveries/s so the throughput gate reads MTTR directly
+    assert rec["eps"] == pytest.approx(1.0 / 2.5)
+    assert rec["recovery"]["mttr_s"] == 2.5
+    assert gw.validate_record(json.loads(json.dumps(rec))) == []
+
+
+def test_make_recovery_record_rejects_nonpositive_mttr():
+    for bad in (0.0, -1.0):
+        with pytest.raises(ValueError, match="mttr_s"):
+            gw.make_recovery_record(
+                mttr_s=bad, steps_lost=0, bytes_replayed=0,
+                config=_RECOVERY_CFG, fingerprint=_FP, device=_DEV)
+
+
+@pytest.mark.parametrize("mutate, fragment", [
+    (lambda r: r["recovery"].__setitem__("mttr_s", 0.0),
+     "recovery.mttr_s"),
+    (lambda r: r["recovery"].__setitem__("mttr_s", "fast"),
+     "recovery.mttr_s"),
+    (lambda r: r["recovery"].__setitem__("mttr_s", True),
+     "recovery.mttr_s"),
+    (lambda r: r["recovery"].__setitem__("steps_lost", -1),
+     "recovery.steps_lost"),
+    (lambda r: r["recovery"].__setitem__("steps_lost", 1.5),
+     "recovery.steps_lost"),
+    (lambda r: r["recovery"].__setitem__("bytes_replayed", None),
+     "recovery.bytes_replayed"),
+    (lambda r: r.__setitem__("recovery", ["not", "a", "dict"]),
+     "recovery:"),
+])
+def test_recovery_record_schema_lists_problems(mutate, fragment):
+    rec = _recovery_record("2026-08-01T00:00:00+00:00", 2.5)
+    mutate(rec)
+    problems = gw.validate_record(rec)
+    assert problems and any(fragment in p for p in problems), problems
+
+
+def test_gate_catches_slower_recovery():
+    """eps = 1/MTTR by construction, so a 2x-slower respawn trips the
+    SAME rolling gate as a throughput regression — no recovery-specific
+    gate code to rot."""
+    records = [_recovery_record(f"2026-08-0{i + 1}T00:00:00+00:00", m)
+               for i, m in enumerate((2.0, 2.1, 1.9))]
+    failures, _ = gw.gate(records)
+    assert failures == 0
+    records.append(_recovery_record("2026-08-04T00:00:00+00:00", 4.0))
+    failures, lines = gw.gate(records)
+    assert failures >= 1, lines
+    assert any("recovery" in ln for ln in lines)

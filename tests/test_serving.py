@@ -128,3 +128,44 @@ def test_rest_controller(dumped_model):
         assert code == 404
     finally:
         srv.stop()
+
+
+@pytest.mark.slow
+def test_health_reports_applied_seq(dumped_model):
+    """/health carries ``applied_seq`` — the newest delta seq this
+    replica has applied across models — so one liveness read is enough
+    for a recovery probe (graftload --respawn, graftchaos) to judge
+    catch-up after a kill."""
+    from openembedding_tpu.checkpoint_delta import Delta
+    mesh, path, _idx, _expected = dumped_model
+    reg = ModelRegistry(mesh, default_hash_capacity=256)
+    srv = ControllerServer(reg, port=0).start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+
+        def health():
+            c.request("GET", "/health")
+            r = c.getresponse()
+            return r.status, json.loads(r.read())
+
+        code, obj = health()
+        assert code == 200 and obj["ok"] is True
+        assert obj["models"] == [] and obj["applied_seq"] == 0
+        reg.create_model(path, block=True)
+        code, obj = health()
+        assert code == 200 and obj["applied_seq"] == 0
+        payload = {
+            "weights": np.full((VOCAB, DIM), 2.0, np.float32),
+            "chunks": np.array([0], np.int64),
+            "rows_per_chunk": np.array(VOCAB, np.int64),
+            "vocab": np.array(VOCAB, np.int64),
+        }
+        out = reg.apply_delta(
+            "uuid-3", Delta(seq=1, step=1, vars={"arr": payload}))
+        assert out["applied"] and out["version"] == 1
+        code, obj = health()
+        assert code == 200 and obj["applied_seq"] == 1
+        assert [m["version"] for m in obj["models"]] == [1]
+    finally:
+        srv.stop()
+        reg.close()

@@ -275,3 +275,60 @@ def test_peer_row_restore_wide_keys(tmp_path, devices8):
                                    rtol=1e-6)
     finally:
         _cleanup(procs)
+
+
+# --- RetryPolicy: the ONE deadline-budgeted policy for every verb ------------
+
+def test_retry_policy_validates():
+    ha.RetryPolicy()  # defaults are valid
+    with pytest.raises(ValueError, match="deadline_s"):
+        ha.RetryPolicy(deadline_s=0.0)
+    with pytest.raises(ValueError, match="backoff"):
+        ha.RetryPolicy(base_backoff_s=-0.1)
+    with pytest.raises(ValueError, match="backoff"):
+        ha.RetryPolicy(max_backoff_s=-1.0)
+    with pytest.raises(ValueError, match="multiplier"):
+        ha.RetryPolicy(multiplier=0.5)
+    with pytest.raises(ValueError, match="jitter"):
+        ha.RetryPolicy(jitter=1.5)
+
+
+def test_retry_policy_backoff_bounds():
+    """Exponential growth, hard cap, jitter only ever SHORTENS the
+    sleep (never lengthens past the raw exponential — a herd must not
+    drift later and later)."""
+    p = ha.RetryPolicy(base_backoff_s=0.1, max_backoff_s=1.0,
+                       multiplier=2.0, jitter=0.5)
+    for rnd in range(8):
+        raw = min(1.0, 0.1 * 2.0 ** rnd)
+        for _ in range(25):
+            s = p.backoff(rnd)
+            assert raw * (1.0 - 0.5) <= s <= raw, (rnd, s, raw)
+    # zero jitter is exactly the exponential, capped
+    p0 = ha.RetryPolicy(base_backoff_s=0.1, max_backoff_s=1.0,
+                        multiplier=2.0, jitter=0.0)
+    assert p0.backoff(0) == pytest.approx(0.1)
+    assert p0.backoff(1) == pytest.approx(0.2)
+    assert p0.backoff(10) == pytest.approx(1.0)
+
+
+def test_retry_budget_exhausts_at_deadline():
+    """A dead fleet burns the per-REQUEST deadline, not one socket
+    timeout per attempt — then surfaces ConnectionError and bumps the
+    budget-exhausted counter."""
+    from openembedding_tpu.analysis import scope
+    dead = f"127.0.0.1:{_free_port()}"   # bound-then-closed: refused
+    client = ha.RoutingClient(
+        [dead], timeout=5.0,
+        policy=ha.RetryPolicy(deadline_s=0.3, base_backoff_s=0.02,
+                              max_backoff_s=0.05))
+    exhausted0 = scope.HISTOGRAMS.counter("serving_retry_budget_exhausted")
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError, match="round"):
+        client.lookup(SIGN, "emb", [1])
+    dt = time.monotonic() - t0
+    client.close()
+    # well under the 5 s per-connection timeout: the deadline governs
+    assert dt < 4.0, dt
+    assert scope.HISTOGRAMS.counter("serving_retry_budget_exhausted") \
+        == exhausted0 + 1

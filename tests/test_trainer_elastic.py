@@ -1,0 +1,325 @@
+"""Elastic trainer recovery: ``fit(autosave_every=, resume_from=)``.
+
+The acceptance lane for the graftchaos tentpole's elasticity leg: a
+trainer killed mid-``fit`` resumes from the delta chain BIT-IDENTICAL
+to the uninterrupted run — for an in-memory batch list AND a live
+``ShardStream`` (whose ``skip_batches`` provides the exact-positioning
+cursor the manifest extra records). Identity is compared through the
+logical id space (full-vocab pulls) plus the dense params/opt leaves
+and the step counter; physical padding rows re-init from a fresh rng
+stream on load and are not comparable.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from openembedding_tpu.analysis import chaos
+
+FEATURES = ("c0", "c1", "c2")
+VOCAB, DIM, B = 48, 4, 8
+N_BATCHES, INTERRUPT, AUTOSAVE = 6, 4, 2
+
+
+def _synthetic_batches(n, seed=0):
+    from openembedding_tpu.models import deepctr
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        sparse, raw = {}, {}
+        for f in FEATURES:
+            ids = rng.randint(0, VOCAB, size=B).astype(np.int32)
+            raw[f] = ids
+            sparse[f] = ids
+            sparse[f + deepctr.LINEAR_SUFFIX] = ids
+        label = ((raw["c0"] + raw["c1"]) % 2).astype(np.float32)
+        dense = rng.randn(B, 4).astype(np.float32)
+        out.append({"label": label, "dense": dense, "sparse": sparse})
+    return out
+
+
+def _build_trainer(mesh):
+    import optax
+    from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.models import deepctr
+    specs = deepctr.make_feature_specs(FEATURES, VOCAB, DIM)
+    coll = EmbeddingCollection(
+        specs, mesh,
+        default_optimizer={"category": "adagrad", "learning_rate": 0.1})
+    coll.enable_dirty_tracking(target_chunks=8)
+    model = deepctr.build_model("deepfm", FEATURES)
+    return Trainer(model, coll, optax.adam(1e-2))
+
+
+def _fingerprint(tr, state):
+    out = [np.asarray(int(state.step))]
+    for leaf in jax.tree.leaves((state.params, state.opt_state)):
+        out.append(np.asarray(jax.device_get(leaf)))
+    allv = np.arange(VOCAB, dtype=np.int32)
+    names = list(tr.collection.specs)
+    pulls = tr.collection.pull(state.emb, {n: allv for n in names},
+                               batch_sharded=False)
+    for n in names:
+        out.append(np.asarray(pulls[n]))
+    return out
+
+
+def _assert_identical(a, b):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        np.testing.assert_array_equal(x, y, err_msg=f"leaf {i}")
+
+
+@pytest.fixture(scope="module")
+def world(devices8):
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    batches = _synthetic_batches(N_BATCHES)
+    tr = _build_trainer(mesh)
+    s0 = tr.init(jax.random.PRNGKey(0), tr.shard_batch(batches[0]))
+    s1, _ = tr.fit(s0, list(batches))
+    return {"mesh": mesh, "batches": batches,
+            "baseline": _fingerprint(tr, s1)}
+
+
+@pytest.mark.slow
+def test_interrupted_fit_resumes_bit_identical(world, tmp_path):
+    """Stop after INTERRUPT batches (autosaving every AUTOSAVE), then a
+    FRESH trainer resumes over the full list and must land exactly on
+    the uninterrupted baseline."""
+    ck = str(tmp_path / "auto")
+    tr1 = _build_trainer(world["mesh"])
+    s1 = tr1.init(jax.random.PRNGKey(0),
+                  tr1.shard_batch(world["batches"][0]))
+    tr1.fit(s1, list(world["batches"][:INTERRUPT]),
+            autosave_every=AUTOSAVE, autosave_dir=ck)
+
+    tr2 = _build_trainer(world["mesh"])
+    s2 = tr2.init(jax.random.PRNGKey(0),
+                  tr2.shard_batch(world["batches"][0]))
+    s2b, _ = tr2.fit(s2, list(world["batches"]), resume_from=ck,
+                     autosave_every=AUTOSAVE, autosave_dir=ck)
+    _assert_identical(world["baseline"], _fingerprint(tr2, s2b))
+
+
+@pytest.mark.slow
+def test_resume_from_missing_dir_is_a_fresh_start(world, tmp_path):
+    """``resume_from`` a path with no manifest trains from scratch —
+    the same invocation works for launch and relaunch (elastic
+    restart loop)."""
+    ck = str(tmp_path / "never-written")
+    tr = _build_trainer(world["mesh"])
+    s0 = tr.init(jax.random.PRNGKey(0),
+                 tr.shard_batch(world["batches"][0]))
+    s1, _ = tr.fit(s0, list(world["batches"]), resume_from=ck,
+                   autosave_every=0)
+    _assert_identical(world["baseline"], _fingerprint(tr, s1))
+
+
+@pytest.mark.slow
+def test_chaos_kill_mid_fit_then_resume(world, tmp_path):
+    """The headline robustness round: a ChaosKill (the in-process
+    SIGKILL analogue) lands at the trainer.fit.step sync point mid-run;
+    a fresh trainer resumes from whatever the chain committed and is
+    bit-identical to the uninterrupted baseline."""
+    ck = str(tmp_path / "auto")
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="trainer.fit.step",
+                         action="kill_thread", hit=INTERRUPT)])
+    tr1 = _build_trainer(world["mesh"])
+    s1 = tr1.init(jax.random.PRNGKey(0),
+                  tr1.shard_batch(world["batches"][0]))
+    with chaos.active_plan(plan):
+        with pytest.raises(chaos.ChaosKill):
+            tr1.fit(s1, list(world["batches"]),
+                    autosave_every=AUTOSAVE, autosave_dir=ck)
+    assert plan.injected, "the kill must actually have fired"
+
+    tr2 = _build_trainer(world["mesh"])
+    s2 = tr2.init(jax.random.PRNGKey(0),
+                  tr2.shard_batch(world["batches"][0]))
+    s2b, _ = tr2.fit(s2, list(world["batches"]), resume_from=ck,
+                     autosave_every=AUTOSAVE, autosave_dir=ck)
+    _assert_identical(world["baseline"], _fingerprint(tr2, s2b))
+
+
+def test_autosave_records_trained_cursor(world, tmp_path):
+    """The manifest extra holds the count of batches whose gradients
+    the committed state contains — the exact stream position a resume
+    seeks to (graftproto ``trainer_restart``: neither reapply nor
+    skip)."""
+    from openembedding_tpu import checkpoint_delta as cd
+    ck = str(tmp_path / "auto")
+    tr1 = _build_trainer(world["mesh"])
+    s1 = tr1.init(jax.random.PRNGKey(0),
+                  tr1.shard_batch(world["batches"][0]))
+    tr1.fit(s1, list(world["batches"][:INTERRUPT]),
+            autosave_every=AUTOSAVE, autosave_dir=ck)
+    cd.join_compactor(ck)
+    manifest = cd.read_manifest(ck)
+    verified, _dropped = cd.verify_chain(ck, manifest)
+    extra = cd.resume_extra(manifest, verified)
+    fit = extra["fit"]
+    assert fit["cursor"] == INTERRUPT
+    assert fit["step"] >= INTERRUPT
+
+
+# --- streamed source: cursor exactness through ShardStream -------------------
+
+STREAM_FEATURES = ("C1", "C2", "C3")
+STREAM_VOCAB = 1 << 10
+STREAM_BATCH = 64
+
+
+def _prune(batch):
+    keep = set(STREAM_FEATURES) | {f + ":linear"
+                                   for f in STREAM_FEATURES}
+    return {**batch,
+            "sparse": {k: v for k, v in batch["sparse"].items()
+                       if k in keep}}
+
+
+def _build_stream_trainer(mesh):
+    import optax
+    from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.models import deepctr
+    specs = deepctr.make_feature_specs(STREAM_FEATURES, STREAM_VOCAB, 4)
+    coll = EmbeddingCollection(
+        specs, mesh,
+        default_optimizer={"category": "adagrad",
+                           "learning_rate": 0.05})
+    coll.enable_dirty_tracking(target_chunks=16)
+    model = deepctr.build_model("deepfm", STREAM_FEATURES)
+    return Trainer(model, coll, optax.adam(1e-2))
+
+
+def _open_stream(shard_dir):
+    from openembedding_tpu.data import stream
+    return stream.ShardStream(shard_dir, batch_size=STREAM_BATCH,
+                              readers=2, epochs=1,
+                              num_buckets=STREAM_VOCAB,
+                              add_linear=True, transform=_prune)
+
+
+@pytest.mark.slow
+def test_streamed_resume_skips_exactly_the_trained_batches(
+        devices8, tmp_path):
+    """Kill mid-fit over a LIVE ShardStream, resume over a FRESH stream
+    of the same shards: ``fit`` must seek via ``skip_batches`` to the
+    committed cursor (no re-apply, no skip) and land bit-identical on
+    the uninterrupted streamed baseline."""
+    from openembedding_tpu.data import stream
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(2, 4, devices8)
+    shard_dir = str(tmp_path / "shards")
+    stream.write_synthetic_shards(shard_dir, num_shards=2,
+                                  rows_per_shard=256, seed=5)
+
+    # uninterrupted streamed baseline
+    src = _open_stream(shard_dir)
+    try:
+        it = iter(src)
+        first = next(it)
+        tr = _build_stream_trainer(mesh)
+        s0 = tr.init(jax.random.PRNGKey(0), tr.shard_batch(first))
+        s1, _ = tr.fit(s0, _chain(first, it))
+        total = src.cursor()
+    finally:
+        src.close()
+    baseline = _fingerprint_stream(tr, s1)
+    assert total == (2 * 256) // STREAM_BATCH
+
+    # interrupted run: chaos kill mid-stream, autosaving every 2
+    ck = str(tmp_path / "auto")
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point="trainer.fit.step", action="kill_thread",
+                         hit=5)])
+    src = _open_stream(shard_dir)
+    try:
+        it = iter(src)
+        first = next(it)
+        tr1 = _build_stream_trainer(mesh)
+        s0 = tr1.init(jax.random.PRNGKey(0), tr1.shard_batch(first))
+        with chaos.active_plan(plan):
+            with pytest.raises(chaos.ChaosKill):
+                tr1.fit(s0, _chain(first, it), autosave_every=2,
+                        autosave_dir=ck)
+        assert plan.injected
+    finally:
+        src.close()
+
+    # resume over a FRESH stream of the same shards
+    src = _open_stream(shard_dir)
+    try:
+        tr2 = _build_stream_trainer(mesh)
+        it = iter(src)
+        first = next(it)
+        s0 = tr2.init(jax.random.PRNGKey(0), tr2.shard_batch(first))
+        # init consumed batch 0 for shapes only; rewind the accounting
+        # by handing fit the reconstructed full stream
+        s2, _ = tr2.fit(s0, _chain(first, it), resume_from=ck,
+                        autosave_every=2, autosave_dir=ck)
+        assert src.cursor() == total
+    finally:
+        src.close()
+    _assert_identical(baseline, _fingerprint_stream(tr2, s2))
+
+
+def test_shardstream_skip_batches_is_exact(tmp_path):
+    """Cursor satellite: ``skip_batches(n)`` advances the stream to
+    exactly the batch a fresh stream reaches after n pops — same ids,
+    same order, and ``cursor()`` counts delivered batches."""
+    from openembedding_tpu.data import stream
+    shard_dir = str(tmp_path / "shards")
+    stream.write_synthetic_shards(shard_dir, num_shards=2,
+                                  rows_per_shard=128, seed=3)
+
+    def open_s():
+        return stream.ShardStream(shard_dir, batch_size=32, readers=2,
+                                  epochs=1, num_buckets=256)
+
+    a = open_s()
+    try:
+        popped = [next(iter(a)) for _ in range(3)]
+        assert a.cursor() == 3
+        rest_a = [b for b in a]
+    finally:
+        a.close()
+
+    b = open_s()
+    try:
+        assert b.skip_batches(3) == 3
+        assert b.cursor() == 3
+        rest_b = [x for x in b]
+        assert b.cursor() == 3 + len(rest_b)
+    finally:
+        b.close()
+
+    assert len(rest_a) == len(rest_b) > 0
+    for x, y in zip(rest_a, rest_b):
+        np.testing.assert_array_equal(x["label"], y["label"])
+        for k in x["sparse"]:
+            np.testing.assert_array_equal(x["sparse"][k],
+                                          y["sparse"][k])
+    del popped
+
+
+def _chain(first, it):
+    import itertools
+    return itertools.chain([first], it)
+
+
+def _fingerprint_stream(tr, state):
+    out = [np.asarray(int(state.step))]
+    for leaf in jax.tree.leaves((state.params, state.opt_state)):
+        out.append(np.asarray(jax.device_get(leaf)))
+    allv = np.arange(STREAM_VOCAB, dtype=np.int32)
+    names = list(tr.collection.specs)
+    pulls = tr.collection.pull(state.emb, {n: allv for n in names},
+                               batch_sharded=False)
+    for n in names:
+        out.append(np.asarray(pulls[n]))
+    return out

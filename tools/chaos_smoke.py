@@ -1,0 +1,214 @@
+"""Chaos smoke for CI: one REAL kill-mid-fit -> resume -> bit-identity
+check, plus a budgeted sync-point sweep subset.
+
+The kill lane is cross-process end-to-end: a CHILD python process arms
+a ``kill_thread`` fault at ``trainer.fit.step`` from ``OE_CHAOS_PLAN``
+(the production wire — exactly how a replica daemon or trainer job
+would be armed), trains with delta autosaves, and DIES mid-fit. The
+parent then resumes a fresh trainer from the orphaned autosave
+directory and requires bit-identity with the uninterrupted baseline —
+the elastic-trainer contract (graftproto ``trainer_restart``: neither
+reapply nor skip). MTTR, steps lost past the last committed cursor,
+and chain bytes replayed are measured and assembled into a graftwatch
+``recovery`` record (``eps = 1/MTTR`` so the rolling gate treats a
+slower recovery like a throughput regression).
+
+The sweep lane reuses ``tools.graftchaos.run_sweep`` on a small
+(point-glob x action) subset — the full matrix is the offline
+``graftchaos --sweep``; CI keeps a canary within the tier-1 window.
+
+Exits nonzero if the child survives, the chain does not commit, resume
+diverges, or the sweep reports a violation. Writes a JSON summary (CI
+artifact) with --out; --trajectory optionally appends the recovery
+record to a trajectory file.
+
+    python -m tools.chaos_smoke --out /tmp/chaos_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+if __package__ in (None, ""):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+KILL_HIT = 5          # fit dies training batch 5 of N_BATCHES
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for dp, _dn, fn in os.walk(path):
+        total += sum(os.path.getsize(os.path.join(dp, f)) for f in fn)
+    return total
+
+
+def _run_child(autosave_dir: str) -> subprocess.CompletedProcess:
+    """Spawn the doomed trainer with the fault armed over the env —
+    the cross-process OE_CHAOS_PLAN wire, not an in-process plan."""
+    from tools.graftchaos import N_BATCHES  # noqa: F401 — doc anchor
+    env = dict(os.environ)
+    env["OE_CHAOS_PLAN"] = json.dumps({
+        "faults": [{"point": "trainer.fit.step",
+                    "action": "kill_thread", "hit": KILL_HIT}],
+        "seed": 0})
+    return subprocess.run(
+        [sys.executable, "-m", "tools.chaos_smoke", "--child",
+         "--dir", autosave_dir],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _child_main(autosave_dir: str) -> int:
+    """The doomed trainer: arm from env, fit with autosaves, die."""
+    from openembedding_tpu.analysis import chaos
+    import jax
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from tools.graftchaos import (AUTOSAVE, N_BATCHES, _build_trainer,
+                                  _synthetic_batches)
+    plan = chaos.install_from_env()
+    if plan is None:
+        print("chaos_smoke --child: OE_CHAOS_PLAN not set",
+              file=sys.stderr)
+        return 3
+    mesh = create_mesh(2, 4, jax.devices())
+    batches = _synthetic_batches(N_BATCHES)
+    tr = _build_trainer(mesh)
+    s0 = tr.init(jax.random.PRNGKey(0), tr.shard_batch(batches[0]))
+    tr.fit(s0, batches, autosave_every=AUTOSAVE,
+           autosave_dir=autosave_dir)
+    # reachable only if the armed kill never fired
+    print("chaos_smoke --child: fit SURVIVED the armed kill",
+          file=sys.stderr)
+    return 4
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="", help="JSON summary path")
+    ap.add_argument("--trajectory", default="",
+                    help="append the recovery record here (JSONL)")
+    ap.add_argument("--sweep-points", default="trainer.*",
+                    help="fnmatch glob for the sweep-subset lane")
+    ap.add_argument("--sweep-actions", default="raise,kill_thread",
+                    help="comma list of fault classes for the subset")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--dir", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return _child_main(args.dir)
+
+    import jax
+    from openembedding_tpu import checkpoint_delta as cd
+    from tools import graftchaos as gc
+    from tools import graftwatch as gw
+
+    summary = {"ok": False, "kill": {}, "resume": {}, "sweep": {}}
+    failures = []
+
+    with tempfile.TemporaryDirectory(prefix="chaos-smoke-") as d:
+        ck = os.path.join(d, "auto")
+
+        # --- lane 1: cross-process kill-mid-fit ------------------------
+        t0 = time.perf_counter()
+        child = _run_child(ck)
+        child_s = time.perf_counter() - t0
+        killed = child.returncode != 0 and "ChaosKill" in child.stderr
+        summary["kill"] = {"returncode": child.returncode,
+                           "killed_by_chaos": killed,
+                           "duration_s": round(child_s, 3)}
+        if not killed:
+            failures.append(
+                f"child was not killed by the armed fault "
+                f"(rc={child.returncode}): {child.stderr[-800:]}")
+        manifest = cd.read_manifest(ck) if os.path.isdir(ck) else None
+        if manifest is None:
+            failures.append("no committed delta manifest after kill")
+            cursor = 0
+        else:
+            verified, _dropped = cd.verify_chain(ck, manifest)
+            cursor = int(cd.resume_extra(manifest, verified)
+                         ["fit"]["cursor"])
+        summary["kill"]["committed_cursor"] = cursor
+
+        # --- lane 2: resume -> bit-identity + MTTR ---------------------
+        if manifest is not None:
+            w = gc.WORLD.ensure_trainer()
+            bytes_replayed = _dir_bytes(ck)
+            t0 = time.perf_counter()
+            tr = gc._build_trainer(w.mesh)
+            s0 = tr.init(jax.random.PRNGKey(0),
+                         tr.shard_batch(w.batches[0]))
+            s1, fit_info = tr.fit(s0, list(w.batches), resume_from=ck,
+                                  autosave_every=gc.AUTOSAVE,
+                                  autosave_dir=ck)
+            mttr_s = time.perf_counter() - t0
+            diff = gc._fingerprint_diff(w.baseline,
+                                        gc._fingerprint(tr, s1))
+            steps_lost = max(0, KILL_HIT - 1 - cursor)
+            summary["resume"] = {
+                "mttr_s": round(mttr_s, 3),
+                "steps_lost": steps_lost,
+                "bytes_replayed": bytes_replayed,
+                "bit_identical": diff == "",
+            }
+            if diff:
+                failures.append(f"resume diverged from baseline: {diff}")
+            else:
+                rec = gw.make_recovery_record(
+                    mttr_s=mttr_s, steps_lost=steps_lost,
+                    bytes_replayed=bytes_replayed,
+                    config={"source": "chaos_smoke",
+                            "lane": "kill-mid-fit",
+                            "autosave_every": gc.AUTOSAVE,
+                            "batches": gc.N_BATCHES})
+                summary["resume"]["record"] = rec
+                if args.trajectory:
+                    gw.append_record(args.trajectory, rec)
+
+    # --- lane 3: sweep subset ------------------------------------------
+    actions = [a.strip() for a in args.sweep_actions.split(",")
+               if a.strip()]
+    report = gc.run_sweep(["ckpt", "ingest", "serving"],
+                          args.sweep_points, actions, args.seed,
+                          progress=True)
+    summary["sweep"] = report
+    if report["counts"]["violation"]:
+        failures.append(
+            f"sweep subset found {report['counts']['violation']} "
+            f"violation(s)")
+
+    summary["ok"] = not failures
+    summary["failures"] = failures
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+            f.write("\n")
+    print(json.dumps({"ok": summary["ok"],
+                      "killed_by_chaos":
+                          summary["kill"].get("killed_by_chaos"),
+                      "committed_cursor":
+                          summary["kill"].get("committed_cursor"),
+                      "mttr_s": summary["resume"].get("mttr_s"),
+                      "bit_identical":
+                          summary["resume"].get("bit_identical"),
+                      "sweep": summary["sweep"].get("counts"),
+                      "failures": failures}, indent=1))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,752 @@
+"""graftchaos — walk every sync point, inject every fault class, assert
+the published recovery invariants hold.
+
+The chaos plane (``analysis/chaos.py``) turns each named sync point into
+a deterministic fault site. This tool is the harness around it:
+
+``--list``
+    Print every ``sync_point`` marker in the package, grouped by
+    subsystem, with the fault classes the sweep would inject there.
+
+``--plan``
+    Validate a plan (inline JSON or ``@file``) and echo its canonical
+    form — the pre-flight for ``OE_CHAOS_PLAN``.
+
+``--sweep``
+    For each (point, action) pair, run the subsystem's scenario with a
+    one-shot :class:`FaultPlan` armed, then clear the plan and assert
+    the subsystem's published invariant:
+
+    * **ckpt** — a trainer fits with delta autosaves while the fault
+      lands anywhere in the save/compact/restore pipeline; afterwards a
+      FRESH trainer must resume from the directory to the bit-identical
+      uninterrupted baseline (loads recover to a committed version;
+      ``torn_write`` must never surface a half-written commit). One
+      carve-out, straight from the checkpoint contract: a fault landing
+      INSIDE the delta-save window (``ckpt.delta.write`` /
+      ``ckpt.delta.commit``) may leave the dense file one save ahead of
+      the chain — the documented last-writer-wins divergence (chain
+      guarantees cover the sparse tables). There the invariant is that
+      recovery replays cleanly to the full step count and the resulting
+      chain round-trips bit-identically, not baseline identity.
+    * **ingest** — a ShardStream is consumed under the fault; the
+      consumer must either finish or fail LOUDLY within a deadline
+      (rings never hang — a dead reader surfaces at ``__next__``).
+    * **serving** — an in-process registry + REST replica + routing
+      client runs load/lookup/hot-swap/peer-restore under the fault;
+      afterwards lookups must succeed and every response must be a
+      single committed version, never a mix of old and new rows.
+
+    Every fired injection must also be visible on /metrics as
+    ``oe_chaos_injected_total{point=,action=}`` — an uncounted fault is
+    itself a violation. Faults whose scenario never reaches the point
+    report ``skipped`` (no_fire). Exit status is nonzero iff any
+    violation was found.
+
+Scenarios run the REAL code paths (Trainer.fit autosave/resume,
+checkpoint_delta save/compact/replay, ModelRegistry hot-swap, the HTTP
+serving stack) on tiny models over the in-process CPU mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional
+
+if __package__ in (None, ""):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+from openembedding_tpu.analysis import chaos  # noqa: E402
+from openembedding_tpu.analysis import scope  # noqa: E402
+
+
+# --- scenario scale (tiny on purpose: the sweep is O(points x actions)) -----
+
+FEATURES = ("c0", "c1", "c2")
+VOCAB, DIM, B = 48, 4, 8
+N_BATCHES, INTERRUPT, AUTOSAVE = 8, 5, 2
+SERVE_VOCAB, SERVE_DIM = 32, 4
+SERVE_SIGN = "chaos-serve"
+HANG_DEADLINE_S = 60.0
+
+# fault classes the sweep injects per subsystem: torn_write needs an
+# atomic-commit site downstream (checkpoint writes), drop_net needs a
+# network classifier upstream (the routing client's failover)
+_BASE_ACTIONS = ("raise", "delay_ms", "kill_thread")
+
+# faults that abort save_delta between its dense-file commit and the
+# manifest commit leave dense one save AHEAD of the chain — the
+# checkpoint contract's documented last-writer-wins divergence (chain
+# guarantees cover the sparse tables), so recovery from that mixed
+# state is not baseline-identical by design
+_DENSE_AHEAD_POINTS = frozenset({"ckpt.delta.write",
+                                 "ckpt.delta.commit"})
+
+
+def actions_for(point: str) -> List[str]:
+    acts = list(_BASE_ACTIONS)
+    if chaos.subsystem_of(point) == "ckpt":
+        acts.append("torn_write")
+    if point == "routing.attempt":
+        acts.append("drop_net")
+    return acts
+
+
+def _result(point: str, action: str, status: str, detail: str = "",
+            fired: int = 0, dt: float = 0.0) -> Dict[str, Any]:
+    return {"point": point, "action": action,
+            "subsystem": chaos.subsystem_of(point), "status": status,
+            "detail": detail, "fired": int(fired),
+            "duration_s": round(dt, 3)}
+
+
+def _staged(errors: List[str], stage: str, fn: Callable[[], Any]) -> Any:
+    """Run one scenario stage under an armed plan. Any exception —
+    including ChaosKill — is the fault surfacing, which is expected;
+    record it and keep going so later stages still execute."""
+    try:
+        return fn()
+    except BaseException as e:  # noqa: BLE001 — chaos is the point
+        errors.append(f"{stage}: {type(e).__name__}: {e}")
+        return None
+
+
+# --- shared lazy world (mesh + batches + baseline are chaos-free) -----------
+
+class _World:
+    def __init__(self) -> None:
+        self.mesh = None
+        self.batches: Optional[List[Dict[str, Any]]] = None
+        self.baseline: Optional[List[Any]] = None
+        self.serve_dir: Optional[str] = None
+        self._tmp: Optional[tempfile.TemporaryDirectory] = None
+
+    def ensure_trainer(self):
+        import jax
+        if self.mesh is None:
+            from openembedding_tpu.parallel.mesh import create_mesh
+            self.mesh = create_mesh(2, 4, jax.devices())
+        if self.batches is None:
+            self.batches = _synthetic_batches(N_BATCHES)
+        if self.baseline is None:
+            tr = _build_trainer(self.mesh)
+            s0 = tr.init(jax.random.PRNGKey(0),
+                         tr.shard_batch(self.batches[0]))
+            s1, _ = tr.fit(s0, list(self.batches))
+            self.baseline = _fingerprint(tr, s1)
+        return self
+
+    def ensure_serving(self) -> str:
+        """A tiny served checkpoint dir (one bounded var ``emb``)."""
+        import jax
+        import numpy as np
+        self.ensure_trainer()
+        if self.serve_dir is None:
+            from openembedding_tpu import (EmbeddingCollection,
+                                           EmbeddingSpec)
+            from openembedding_tpu import checkpoint as ckpt
+            self._tmp = tempfile.TemporaryDirectory(prefix="graftchaos-")
+            d = os.path.join(self._tmp.name, "model")
+            specs = (EmbeddingSpec(name="emb", input_dim=SERVE_VOCAB,
+                                   output_dim=SERVE_DIM),)
+            coll = EmbeddingCollection(specs, self.mesh)
+            states = coll.init(jax.random.PRNGKey(7))
+            ckpt.save_checkpoint(d, coll, states, model_sign=SERVE_SIGN,
+                                 include_optimizer=False)
+            self.serve_dir = d
+        return self.serve_dir
+
+
+WORLD = _World()
+
+
+def _synthetic_batches(n: int, seed: int = 0) -> List[Dict[str, Any]]:
+    import numpy as np
+    from openembedding_tpu.models import deepctr
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        sparse: Dict[str, Any] = {}
+        raw: Dict[str, Any] = {}
+        for f in FEATURES:
+            ids = rng.randint(0, VOCAB, size=B).astype(np.int32)
+            raw[f] = ids
+            sparse[f] = ids
+            sparse[f + deepctr.LINEAR_SUFFIX] = ids
+        label = ((raw["c0"] + raw["c1"]) % 2).astype(np.float32)
+        dense = rng.randn(B, 4).astype(np.float32)
+        out.append({"label": label, "dense": dense, "sparse": sparse})
+    return out
+
+
+def _build_trainer(mesh):
+    import optax
+    from openembedding_tpu import EmbeddingCollection, Trainer
+    from openembedding_tpu.models import deepctr
+    specs = deepctr.make_feature_specs(FEATURES, VOCAB, DIM)
+    coll = EmbeddingCollection(
+        specs, mesh,
+        default_optimizer={"category": "adagrad", "learning_rate": 0.1})
+    coll.enable_dirty_tracking(target_chunks=8)
+    model = deepctr.build_model("deepfm", FEATURES)
+    return Trainer(model, coll, optax.adam(1e-2))
+
+
+def _fingerprint(tr, state) -> List[Any]:
+    """Bit-exact identity through the LOGICAL id space: step + dense
+    params/opt leaves + a full-vocab pull per embedding var (physical
+    padding rows re-init from a fresh rng stream on load and are not
+    comparable)."""
+    import jax
+    import numpy as np
+    out = [np.asarray(int(state.step))]
+    for leaf in jax.tree.leaves((state.params, state.opt_state)):
+        out.append(np.asarray(jax.device_get(leaf)))
+    allv = np.arange(VOCAB, dtype=np.int32)
+    names = list(tr.collection.specs)
+    pulls = tr.collection.pull(state.emb, {n: allv for n in names},
+                               batch_sharded=False)
+    for n in names:
+        out.append(np.asarray(pulls[n]))
+    return out
+
+
+def _fingerprint_diff(a: List[Any], b: List[Any]) -> str:
+    import numpy as np
+    if len(a) != len(b):
+        return f"leaf count {len(a)} != {len(b)}"
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x.shape != y.shape:
+            return f"leaf {i}: shape {x.shape} != {y.shape}"
+        if not np.array_equal(x, y):
+            return (f"leaf {i}: max abs diff "
+                    f"{float(np.max(np.abs(x - y)))}")
+    return ""
+
+
+# --- ckpt scenario ----------------------------------------------------------
+
+def run_ckpt_scenario(point: str, action: str, seed: int
+                      ) -> Dict[str, Any]:
+    import jax
+    from openembedding_tpu import checkpoint as ckpt
+    from openembedding_tpu import checkpoint_delta as cd
+    t0 = time.perf_counter()
+    w = WORLD.ensure_trainer()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point=point, action=action)], seed=seed)
+    c0 = scope.HISTOGRAMS.counter(chaos.COUNTER, point=point,
+                                  action=action)
+    errors: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="graftchaos-ckpt-") as d:
+        ck = os.path.join(d, "auto")
+        full = os.path.join(d, "full")
+        with warnings.catch_warnings():
+            # torn-tail discards on resume warn by design
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with chaos.active_plan(plan):
+                # 1. interrupted fit with delta autosaves
+                tr1 = _build_trainer(w.mesh)
+                s1 = tr1.init(jax.random.PRNGKey(0),
+                              tr1.shard_batch(w.batches[0]))
+
+                def _fit1():
+                    return tr1.fit(s1, list(w.batches[:INTERRUPT]),
+                                   autosave_every=AUTOSAVE,
+                                   autosave_dir=ck)
+                fit_out = _staged(errors, "fit", _fit1)
+                # 2. foreground compaction of whatever chain committed
+                # (join the autosave's background compactor first — two
+                # compactors racing over one dir is not a scenario)
+                if os.path.isdir(ck):
+                    _staged(errors, "compact.join",
+                            lambda: cd.join_compactor(ck))
+                    _staged(errors, "compact", lambda: cd.compact(ck))
+                # 3. full saves (arm + reset paths, writer pool); the
+                # fit DONATES its input buffers, so save the returned
+                # state — and when fit died mid-run (donated AND gone),
+                # re-init so the full-save path still runs under plan
+                if fit_out:
+                    emb_states = fit_out[0].emb
+                else:
+                    emb_states = tr1.init(
+                        jax.random.PRNGKey(0),
+                        tr1.shard_batch(w.batches[0])).emb
+                _staged(errors, "fullsave", lambda: ckpt.save_checkpoint(
+                    full, tr1.collection, emb_states,
+                    model_sign="chaos-f", include_optimizer=False))
+                _staged(errors, "fullsave2", lambda: ckpt.save_checkpoint(
+                    full, tr1.collection, emb_states,
+                    model_sign="chaos-f", include_optimizer=False))
+                # 4. resume attempt UNDER the plan (restore-side points)
+                tr2 = _build_trainer(w.mesh)
+                s2 = tr2.init(jax.random.PRNGKey(0),
+                              tr2.shard_batch(w.batches[0]))
+
+                def _fit2():
+                    tr2.fit(s2, list(w.batches), resume_from=ck,
+                            autosave_every=AUTOSAVE, autosave_dir=ck)
+                _staged(errors, "resume", _fit2)
+            # the plan is cleared: simulate the process restart — drain
+            # any background thread the kill left poisoned
+            try:
+                cd.join_compactor(ck)
+            except BaseException:  # noqa: BLE001 — poisoned by design
+                pass
+            dt = time.perf_counter() - t0
+            if not plan.injected:
+                return _result(point, action, "skipped", "no_fire",
+                               dt=dt)
+            fired = len(plan.injected)
+            c1 = scope.HISTOGRAMS.counter(chaos.COUNTER, point=point,
+                                          action=action)
+            if c1 <= c0:
+                return _result(point, action, "violation",
+                               "fault fired but oe_chaos_injected_total "
+                               "did not move", fired, dt)
+            # RECOVERY INVARIANT: a fresh trainer resumes from whatever
+            # the faulted run committed and lands bit-identical on the
+            # uninterrupted baseline. Carve-out: at _DENSE_AHEAD_POINTS
+            # the dense file may be one save ahead of the chain, so the
+            # check there is clean replay to the full step count plus a
+            # bit-identical restore round-trip of the recovered chain.
+            note = ""
+            tr3 = _build_trainer(w.mesh)
+            s3 = tr3.init(jax.random.PRNGKey(0),
+                          tr3.shard_batch(w.batches[0]))
+            try:
+                s3b, _ = tr3.fit(s3, list(w.batches), resume_from=ck,
+                                 autosave_every=AUTOSAVE,
+                                 autosave_dir=ck)
+            except BaseException as e:  # noqa: BLE001 — any raise fails
+                return _result(
+                    point, action, "violation",
+                    f"recovery resume failed: {type(e).__name__}: {e} "
+                    f"(faulted stages: {errors})", fired,
+                    time.perf_counter() - t0)
+            fp3 = _fingerprint(tr3, s3b)
+            bad = _fingerprint_diff(w.baseline, fp3)
+            if bad and point in _DENSE_AHEAD_POINTS:
+                if int(fp3[0]) != int(w.baseline[0]):
+                    return _result(
+                        point, action, "violation",
+                        f"recovery replayed to step {int(fp3[0])}, "
+                        f"expected {int(w.baseline[0])} — batches were "
+                        f"skipped or reapplied (faulted stages: "
+                        f"{errors})", fired, time.perf_counter() - t0)
+                tr4 = _build_trainer(w.mesh)
+                s4 = tr4.init(jax.random.PRNGKey(0),
+                              tr4.shard_batch(w.batches[0]))
+                try:
+                    s4b, _ = tr4.fit(s4, list(w.batches),
+                                     resume_from=ck,
+                                     autosave_every=AUTOSAVE,
+                                     autosave_dir=ck)
+                except BaseException as e:  # noqa: BLE001
+                    return _result(
+                        point, action, "violation",
+                        f"post-recovery restore failed: "
+                        f"{type(e).__name__}: {e}", fired,
+                        time.perf_counter() - t0)
+                bad2 = _fingerprint_diff(fp3, _fingerprint(tr4, s4b))
+                if bad2:
+                    return _result(
+                        point, action, "violation",
+                        f"post-recovery restore did not round-trip: "
+                        f"{bad2}", fired, time.perf_counter() - t0)
+                bad = ""
+                note = ("recovered to committed chain version; dense "
+                        "file rode one save ahead (documented "
+                        "last-writer-wins divergence)")
+    dt = time.perf_counter() - t0
+    if bad:
+        return _result(point, action, "violation",
+                       f"recovery diverged from baseline: {bad} "
+                       f"(faulted stages: {errors})", fired, dt)
+    detail = "; ".join(errors) if errors else "fault absorbed"
+    if note:
+        detail = f"{note}; {detail}" if errors else note
+    return _result(point, action, "ok", detail, fired, dt)
+
+
+# --- ingest scenario --------------------------------------------------------
+
+def run_ingest_scenario(point: str, action: str, seed: int
+                        ) -> Dict[str, Any]:
+    from openembedding_tpu.data import stream as stream_lib
+    t0 = time.perf_counter()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point=point, action=action)], seed=seed)
+    c0 = scope.HISTOGRAMS.counter(chaos.COUNTER, point=point,
+                                  action=action)
+    with tempfile.TemporaryDirectory(prefix="graftchaos-ingest-") as d:
+        stream_lib.write_synthetic_shards(d, num_shards=4,
+                                          rows_per_shard=64)
+        done: List[str] = []
+        err: List[BaseException] = []
+
+        def _consume():
+            try:
+                s = stream_lib.ShardStream(d, batch_size=16, readers=2,
+                                           epochs=1)
+                n = 0
+                try:
+                    for _ in s:
+                        n += 1
+                finally:
+                    s.close()
+                done.append(f"consumed {n} batches")
+            except BaseException as e:  # noqa: BLE001 — loud is fine
+                err.append(e)
+
+        with chaos.active_plan(plan):
+            worker = threading.Thread(target=_consume, daemon=True,
+                                      name="chaos-ingest-consumer")
+            worker.start()
+            worker.join(HANG_DEADLINE_S)
+            hung = worker.is_alive()
+        dt = time.perf_counter() - t0
+        if hung:
+            # leave the daemon thread behind; the ring is hung, which is
+            # exactly the violation
+            return _result(point, action, "violation",
+                           f"ring hung: consumer still alive after "
+                           f"{HANG_DEADLINE_S:.0f}s", len(plan.injected),
+                           dt)
+        if not plan.injected:
+            return _result(point, action, "skipped", "no_fire", dt=dt)
+        c1 = scope.HISTOGRAMS.counter(chaos.COUNTER, point=point,
+                                      action=action)
+        if c1 <= c0:
+            return _result(point, action, "violation",
+                           "fault fired but oe_chaos_injected_total "
+                           "did not move", len(plan.injected), dt)
+        outcome = done[0] if done else \
+            f"failed loudly: {type(err[0]).__name__}: {err[0]}"
+        return _result(point, action, "ok", outcome,
+                       len(plan.injected), dt)
+
+
+# --- serving scenario -------------------------------------------------------
+
+def _constant_delta(seq: int, value: float):
+    """A full-vocab constant delta for ``emb`` in the chunked array
+    payload form ``apply_delta`` expects (one chunk spanning the whole
+    table)."""
+    import numpy as np
+    from openembedding_tpu.checkpoint_delta import Delta
+    payload = {
+        "weights": np.full((SERVE_VOCAB, SERVE_DIM), value, np.float32),
+        "chunks": np.array([0], np.int64),
+        "rows_per_chunk": np.array(SERVE_VOCAB, np.int64),
+        "vocab": np.array(SERVE_VOCAB, np.int64),
+    }
+    return Delta(seq=seq, step=seq, vars={"emb": payload})
+
+
+def _classify_rows(rows, new_value: float) -> str:
+    """'old' / 'new' / 'mixed' for one lookup response under the
+    constant-delta scheme (baseline rows are random init floats that are
+    never exactly ``new_value``)."""
+    import numpy as np
+    rows = np.asarray(rows)
+    is_new = rows == new_value
+    if bool(np.all(is_new)):
+        return "new"
+    if not bool(np.any(is_new)):
+        return "old"
+    return "mixed"
+
+
+def run_serving_scenario(point: str, action: str, seed: int
+                        ) -> Dict[str, Any]:
+    import numpy as np
+    from openembedding_tpu.parallel.mesh import create_mesh
+    from openembedding_tpu.serving import ha
+    from openembedding_tpu.serving.registry import ModelRegistry
+    from openembedding_tpu.serving.rest import ControllerServer
+    import jax
+
+    t0 = time.perf_counter()
+    model_dir = WORLD.ensure_serving()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(point=point, action=action)], seed=seed)
+    c0 = scope.HISTOGRAMS.counter(chaos.COUNTER, point=point,
+                                  action=action)
+    errors: List[str] = []
+    NEW = 2.0
+    mesh = create_mesh(1, len(jax.devices()))
+    registry = ModelRegistry(mesh, default_hash_capacity=1024)
+    if point.startswith("serving.batch."):
+        registry.enable_batching(max_batch_rows=64, max_wait_us=200)
+    server = ControllerServer(registry, port=0)
+    server.start()
+    ep = f"127.0.0.1:{server.port}"
+    client = ha.RoutingClient(
+        [ep], timeout=10.0,
+        policy=ha.RetryPolicy(deadline_s=8.0, base_backoff_s=0.02,
+                              max_backoff_s=0.2))
+    ids = np.arange(SERVE_VOCAB, dtype=np.int64)
+    mixed: List[str] = []
+    try:
+        with chaos.active_plan(plan):
+            # 1. load (registry.load.*, registry.find)
+            _staged(errors, "create_model",
+                    lambda: registry.create_model(
+                        model_dir, model_sign=SERVE_SIGN, block=True))
+            # kill_thread mid-load strands the status row in CREATING —
+            # the in-process stand-in for a replica dying mid-boot; the
+            # operator move is delete + reload, still under the plan
+            if SERVE_SIGN not in registry._models:
+                _staged(errors, "reload.delete",
+                        lambda: registry.delete_model(SERVE_SIGN))
+                _staged(errors, "reload",
+                        lambda: registry.create_model(
+                            model_dir, model_sign=SERVE_SIGN,
+                            block=True))
+            # 2. lookups through the full HTTP + routing path
+            for i in range(3):
+                rows = _staged(errors, f"lookup{i}",
+                               lambda: client.lookup(SERVE_SIGN, "emb",
+                                                     ids))
+                if rows is not None:
+                    mixed.append(_classify_rows(rows, NEW))
+            # 3. hot-swap a constant delta (registry.swap.*), racing a
+            # concurrent reader thread against the swap
+            reader_rows: List[Any] = []
+
+            def _reader():
+                try:
+                    for _ in range(4):
+                        reader_rows.append(
+                            registry.lookup(SERVE_SIGN, "emb", ids))
+                except Exception:  # noqa: BLE001 — chaos may break it
+                    pass
+            rt = threading.Thread(target=_reader, daemon=True,
+                                  name="chaos-serving-reader")
+            rt.start()
+            _staged(errors, "push_delta",
+                    lambda: client.push_delta(SERVE_SIGN,
+                                              _constant_delta(1, NEW)))
+            rt.join(HANG_DEADLINE_S)
+            if rt.is_alive():
+                return _result(point, action, "violation",
+                               "reader hung against hot-swap",
+                               len(plan.injected),
+                               time.perf_counter() - t0)
+            for rows in reader_rows:
+                mixed.append(_classify_rows(rows, NEW))
+            # 4. peer restore (ha.restore.*): a second registry
+            # reconstructs the catalog from the live replica
+            if point.startswith("ha."):
+                reg2 = ModelRegistry(mesh, default_hash_capacity=1024)
+                _staged(errors, "restore_from_peers",
+                        lambda: ha.restore_from_peers(reg2, [ep],
+                                                      wait=5.0))
+                reg2.close()
+        # plan cleared — RECOVERY INVARIANTS
+        dt = time.perf_counter() - t0
+        if not plan.injected:
+            return _result(point, action, "skipped", "no_fire", dt=dt)
+        fired = len(plan.injected)
+        c1 = scope.HISTOGRAMS.counter(chaos.COUNTER, point=point,
+                                      action=action)
+        if c1 <= c0:
+            return _result(point, action, "violation",
+                           "fault fired but oe_chaos_injected_total "
+                           "did not move", fired, dt)
+        if "mixed" in mixed:
+            return _result(point, action, "violation",
+                           f"lookup saw a MIXED version: {mixed} "
+                           f"(faulted stages: {errors})", fired, dt)
+        # the fleet must converge: load if the faulted load never
+        # committed, re-push the delta (idempotent), then lookups must
+        # answer with one whole committed version
+        if SERVE_SIGN not in registry._models:
+            try:
+                registry.delete_model(SERVE_SIGN)
+            except Exception:  # noqa: BLE001 — absent is fine
+                pass
+            try:
+                registry.create_model(model_dir, model_sign=SERVE_SIGN,
+                                      block=True)
+            except BaseException as e:  # noqa: BLE001
+                return _result(point, action, "violation",
+                               f"recovery load failed: "
+                               f"{type(e).__name__}: {e}", fired,
+                               time.perf_counter() - t0)
+        try:
+            client.push_delta(SERVE_SIGN, _constant_delta(1, NEW))
+            rows = client.lookup(SERVE_SIGN, "emb", ids)
+        except BaseException as e:  # noqa: BLE001
+            return _result(point, action, "violation",
+                           f"recovery lookup failed: "
+                           f"{type(e).__name__}: {e} "
+                           f"(faulted stages: {errors})", fired,
+                           time.perf_counter() - t0)
+        kind = _classify_rows(rows, NEW)
+        dt = time.perf_counter() - t0
+        if kind != "new":
+            return _result(point, action, "violation",
+                           f"recovery lookup returned {kind!r} rows, "
+                           f"expected the committed delta version",
+                           fired, dt)
+        return _result(point, action, "ok",
+                       "; ".join(errors) if errors else "fault absorbed",
+                       fired, dt)
+    finally:
+        chaos.clear_plan()
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001
+            pass
+        server.stop()
+        registry.close()
+
+
+_SCENARIOS: Dict[str, Callable[[str, str, int], Dict[str, Any]]] = {
+    "ckpt": run_ckpt_scenario,
+    "ingest": run_ingest_scenario,
+    "serving": run_serving_scenario,
+}
+
+
+# --- sweep driver -----------------------------------------------------------
+
+def sweep_targets(subsystems: List[str], points_glob: str,
+                  actions: Optional[List[str]]) -> List[tuple]:
+    targets = []
+    for point in chaos.discover_sync_points():
+        sub = chaos.subsystem_of(point)
+        if sub not in subsystems or sub not in _SCENARIOS:
+            continue
+        if points_glob and not fnmatch.fnmatch(point, points_glob):
+            continue
+        for action in actions_for(point):
+            if actions and action not in actions:
+                continue
+            targets.append((point, action, sub))
+    return targets
+
+
+def run_sweep(subsystems: List[str], points_glob: str,
+              actions: Optional[List[str]], seed: int,
+              progress: bool = True) -> Dict[str, Any]:
+    targets = sweep_targets(subsystems, points_glob, actions)
+    results: List[Dict[str, Any]] = []
+    for i, (point, action, sub) in enumerate(targets):
+        if progress:
+            print(f"[{i + 1}/{len(targets)}] {sub}: {point} x {action} "
+                  "...", flush=True)
+        try:
+            res = _SCENARIOS[sub](point, action, seed)
+        except BaseException as e:  # noqa: BLE001 — harness crash
+            res = _result(point, action, "violation",
+                          f"scenario harness crashed: "
+                          f"{type(e).__name__}: {e}")
+        finally:
+            chaos.clear_plan()
+        if progress:
+            print(f"    -> {res['status']}"
+                  + (f" ({res['detail']})" if res["detail"] else ""),
+                  flush=True)
+        results.append(res)
+    counts = {"ok": 0, "skipped": 0, "violation": 0}
+    for r in results:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+    return {
+        "seed": seed,
+        "subsystems": subsystems,
+        "targets": len(targets),
+        "counts": counts,
+        "injected_total": int(sum(r["fired"] for r in results)),
+        "results": results,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftchaos",
+        description="deterministic sync-point fault injection: list "
+                    "points, validate plans, sweep fault classes")
+    ap.add_argument("--list", action="store_true",
+                    help="print every sync point grouped by subsystem")
+    ap.add_argument("--plan", metavar="JSON_OR_@FILE",
+                    help="validate a fault plan and echo canonical JSON")
+    ap.add_argument("--sweep", action="store_true",
+                    help="inject every fault class at every swept point "
+                         "and assert recovery invariants")
+    ap.add_argument("--subsystems", default="ckpt,ingest,serving",
+                    help="comma list of subsystems to sweep "
+                         "(default: ckpt,ingest,serving)")
+    ap.add_argument("--points", default="",
+                    help="fnmatch glob filtering swept points "
+                         "(e.g. 'ckpt.*')")
+    ap.add_argument("--actions", default="",
+                    help="comma list restricting injected fault classes")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="",
+                    help="write the sweep report JSON here")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.plan:
+        plan = chaos.plan_from_text(args.plan)
+        print(json.dumps(plan.to_json(), indent=2))
+        return 0
+
+    if args.list:
+        by_sub: Dict[str, List[str]] = {}
+        for p in chaos.discover_sync_points():
+            by_sub.setdefault(chaos.subsystem_of(p), []).append(p)
+        for sub in sorted(by_sub):
+            swept = "swept" if sub in _SCENARIOS else "not swept"
+            print(f"{sub} ({len(by_sub[sub])} points, {swept}):")
+            for p in by_sub[sub]:
+                print(f"  {p}  [{', '.join(actions_for(p))}]")
+        return 0
+
+    if not args.sweep:
+        ap.print_help()
+        return 2
+
+    subsystems = [s.strip() for s in args.subsystems.split(",")
+                  if s.strip()]
+    actions = [a.strip() for a in args.actions.split(",") if a.strip()] \
+        or None
+    for a in actions or []:
+        if a not in chaos.ACTIONS:
+            ap.error(f"unknown action {a!r} (one of {chaos.ACTIONS})")
+    report = run_sweep(subsystems, args.points, actions, args.seed,
+                       progress=not args.quiet)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    counts = report["counts"]
+    print(f"graftchaos sweep: {report['targets']} target(s), "
+          f"{counts['ok']} ok, {counts['skipped']} skipped (no_fire), "
+          f"{counts['violation']} violation(s), "
+          f"{report['injected_total']} fault(s) injected")
+    for r in report["results"]:
+        if r["status"] == "violation":
+            print(f"  VIOLATION {r['point']} x {r['action']}: "
+                  f"{r['detail']}")
+    return 1 if counts["violation"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

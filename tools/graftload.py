@@ -570,6 +570,13 @@ def main(argv=None) -> int:
                          "storm (demo mode): reads must never error "
                          "while a replica lives, and the trace shows "
                          "the reroute")
+    ap.add_argument("--respawn", action="store_true",
+                    help="with --chaos: immediately respawn the killed "
+                         "replica with --peers pointing at the "
+                         "survivors and MEASURE the recovery time "
+                         "(kill -> /health NORMAL again); emits a "
+                         "'recovery' trajectory record when "
+                         "--trajectory is set")
     ap.add_argument("--batched", action="store_true",
                     help="arm each demo replica's micro-batching "
                          "lookup scheduler (serving/batcher.py) — the "
@@ -683,13 +690,49 @@ def main(argv=None) -> int:
                 f"{'p99_ms':>9}")
         print("\n" + head + "\n" + "-" * len(head))
 
+        recovery_info: Dict[str, Any] = {}
+        recovery_done = threading.Event()
+
+        def _kill_victim():
+            procs[-1].kill()
+            procs[-1].wait()
+
+        def _kill_and_respawn():
+            """The kill-AND-respawn chaos lane: SIGKILL a replica, boot
+            its replacement against the survivors (restore-from-peer),
+            and measure MTTR = kill -> /health NORMAL with the model."""
+            try:
+                t0 = time.perf_counter()
+                _kill_victim()
+                survivors = endpoints[:-1]
+                port = int(endpoints[-1].rsplit(":", 1)[1])
+                procs[-1] = ha.spawn_replica(
+                    port, peers=survivors,
+                    batch_rows=args.batch_rows if args.batched else 0,
+                    batch_wait_us=args.batch_wait_us,
+                    batch_queue_rows=args.batch_queue_rows)
+                ok = ha.wait_ready(endpoints[-1], sign=args.sign,
+                                   timeout=180.0)
+                recovery_info["mttr_s"] = time.perf_counter() - t0
+                recovery_info["ok"] = ok
+                h = ha.probe_health(endpoints[-1]) or {}
+                recovery_info["applied_seq"] = h.get("applied_seq", 0)
+            finally:
+                recovery_done.set()
+
         def run_and_print(route: str, send, rate: float,
                           seed: int) -> StormResult:
             kill_at = None
-            if args.chaos and route == "rest" and len(procs) > 1:
+            if args.chaos and route == "rest" and len(procs) > 1 \
+                    and not (args.respawn
+                             and recovery_info.get("started")):
+                # respawn measures ONE kill->recover cycle; the plain
+                # kill lane keeps its per-storm behavior (re-killing a
+                # dead process is a no-op)
+                recovery_info["started"] = True
                 kill_at = threading.Timer(
                     args.duration / 2.0,
-                    lambda: (procs[-1].kill(), procs[-1].wait()))
+                    _kill_and_respawn if args.respawn else _kill_victim)
                 kill_at.start()
             res = _storm_once(args, route, send, rate, seed)
             if kill_at is not None:
@@ -777,6 +820,43 @@ def main(argv=None) -> int:
                       f"unique/rows {dedup:.2f}")
         if rejected:
             print(f"  rejected (429 backpressure): {rejected}")
+
+        # --- kill-and-respawn recovery verdict -----------------------------
+        if args.respawn and recovery_info.get("started"):
+            # the respawn runs on the chaos timer's thread; the storm
+            # usually outlives it, but join explicitly before judging
+            if not recovery_done.wait(timeout=240.0):
+                print("graftload: respawned replica never recovered "
+                      "(timeout)", file=sys.stderr)
+                rc = 1
+            elif not recovery_info.get("ok"):
+                print("graftload: respawned replica came up without "
+                      f"the model (applied_seq "
+                      f"{recovery_info.get('applied_seq')})",
+                      file=sys.stderr)
+                rc = 1
+            else:
+                mttr = recovery_info["mttr_s"]
+                print(f"  CHAOS: killed + respawned 1 replica — "
+                      f"recovery {mttr:.2f}s, applied_seq "
+                      f"{recovery_info.get('applied_seq')}")
+                if args.trajectory:
+                    model_bytes = 0
+                    if model_dir and os.path.isdir(model_dir):
+                        for dp, _dn, fn in os.walk(model_dir):
+                            model_bytes += sum(
+                                os.path.getsize(os.path.join(dp, f))
+                                for f in fn)
+                    rec = graftwatch.make_recovery_record(
+                        mttr_s=mttr, steps_lost=0,
+                        bytes_replayed=model_bytes,
+                        config={"source": "graftload",
+                                "kind": "respawn",
+                                "replicas": args.replicas,
+                                "batched": bool(args.batched)})
+                    graftwatch.append_record(args.trajectory, rec)
+                    print(f"graftload: appended recovery record "
+                          f"(MTTR {mttr:.2f}s)")
 
         # --- artifacts -----------------------------------------------------
         if args.trace:

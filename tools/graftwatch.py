@@ -253,6 +253,22 @@ def validate_record(rec: Any) -> List[str]:
             if not isinstance(v, _NUM) or isinstance(v, bool) or v <= 0:
                 p.append("ingest.stream_vs_mem: expected positive "
                          "number")
+    recovery = rec.get("recovery")
+    if recovery is not None:
+        # fault-recovery records (graftload --respawn, chaos_smoke):
+        # eps is recoveries/s (1/MTTR) so the rolling gate catches
+        # recovery-time regressions; this section carries the evidence
+        if not isinstance(recovery, dict):
+            p.append("recovery: expected object or null")
+        else:
+            v = recovery.get("mttr_s")
+            if not isinstance(v, _NUM) or isinstance(v, bool) or v <= 0:
+                p.append("recovery.mttr_s: expected positive number")
+            for k in ("steps_lost", "bytes_replayed"):
+                v = recovery.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    p.append(f"recovery.{k}: expected int >= 0")
     serving = rec.get("serving")
     if serving is not None:
         if not isinstance(serving, dict):
@@ -513,6 +529,40 @@ def make_serving_record(*, routes: Mapping[str, Mapping[str, Any]],
     bad = validate_record(rec)
     if bad:
         raise ValueError(f"assembled serving record is schema-invalid: "
+                         f"{bad}")
+    return rec
+
+
+def make_recovery_record(*, mttr_s: float, steps_lost: int,
+                         bytes_replayed: int,
+                         config: Mapping[str, Any],
+                         fingerprint: Optional[str] = None,
+                         device: Optional[Mapping[str, Any]] = None,
+                         ts: Optional[str] = None) -> Dict[str, Any]:
+    """One ``recovery`` trajectory record (``tools/graftload.py
+    --respawn`` kill-and-respawn lane; ``tools/chaos_smoke.py``
+    kill-mid-fit + resume lane).
+
+    ``eps`` is recoveries/second (``1 / mttr_s``) so the rolling
+    baseline gate — including ``--strict`` — treats a slower recovery
+    exactly like a throughput regression. The ``recovery`` section
+    carries the evidence: ``mttr_s`` (kill to serving/trained-again),
+    ``steps_lost`` (training steps past the last autosave that had to
+    be retrained; 0 for serving respawns), ``bytes_replayed``
+    (checkpoint/delta-chain bytes re-read to rebuild the state). Raises
+    on a schema-invalid assembly."""
+    if mttr_s <= 0:
+        raise ValueError(f"mttr_s must be > 0, got {mttr_s}")
+    eps = 1.0 / float(mttr_s)
+    rec = make_record(plane="recovery", config=dict(config),
+                      eps=eps, eps_min=eps, eps_max=eps,
+                      fingerprint=fingerprint, device=device, ts=ts)
+    rec["recovery"] = {"mttr_s": round(float(mttr_s), 4),
+                       "steps_lost": int(steps_lost),
+                       "bytes_replayed": int(bytes_replayed)}
+    bad = validate_record(rec)
+    if bad:
+        raise ValueError(f"assembled recovery record is schema-invalid: "
                          f"{bad}")
     return rec
 
