@@ -985,6 +985,254 @@ _register(ProgramContract(
 _register(ProgramContract(plane="any", program="step", min_aliased=1))
 
 
+# --- the per-plane COST registry (graftplan) ---------------------------------
+
+# Every plane above also declares its cost terms here, next to its HLO
+# contract, so a new plane is automatically *plannable* the day it is
+# registered (ROADMAP item 5) instead of becoming hand-tuning folklore.
+# Two different kinds of number live in one PlaneSpec:
+#
+# * ``exchange_bytes`` — the per-device wire bytes of the COMPILED
+#   pull/push program as a closed form over the lowering params
+#   (global_batch, dim, itemsize, wire_itemsize, num_tables,
+#   dim_bucket). These are audited: ``tools.graftcheck``'s cost-audit
+#   section lowers every plane and fails if a declaration disagrees
+#   with ``exchange_collective_bytes`` of the real HLO by more than
+#   :data:`COST_MODEL_TOLERANCE`. The forms are calibrated in the
+#   contract-audit regime (batch >= 512; at smaller shapes XLA elides
+#   the residue/overflow legs and the small additive terms drift).
+# * planner-only terms — ``workload_factor`` (how observed
+#   unique_ratio / key_skew / cache hit-ratio scale the EFFECTIVE
+#   cost; the compiled program is static, the workload is not),
+#   ``launches`` (collective launch count per program — the per-launch
+#   overhead proxy), ``hbm_overhead_bytes`` (resident bytes the plane
+#   costs beyond the table shards). These feed ``analysis/plan.py``
+#   and are NOT HLO-auditable; they are documented estimates.
+#
+# ``wire_ops`` names which collective ops carry the plane's exchange:
+# the a2a family moves payload on all-to-all/all-gather (scalar
+# all-reduces excluded, as in the byte-halving audit); the psum
+# baseline's pull cost IS its all-reduce broadcast, so its spec widens
+# the op set — the audit then compares against the same accounting.
+
+COST_MODEL_TOLERANCE = 0.10
+PSUM_WIRE_OPS = ("all-to-all", "all-gather", "all-reduce")
+
+
+def _a2a_pull_bytes(p: Mapping[str, Any]) -> int:
+    # row re-assembly gather (batch * dim * itemsize) + two int32
+    # index/offset exchanges + residue-round scalars
+    return int(p["global_batch"] * (p["dim"] * p["itemsize"] + 8) + 256)
+
+
+def _a2a_push_bytes(p: Mapping[str, Any]) -> int:
+    # grad+count prereduce gather ((dim+1) words) + one int32 key
+    # exchange + residue scalars
+    return int(p["global_batch"]
+               * ((p["dim"] + 1) * p["itemsize"] + 4) + 256)
+
+
+def _compressed_pull_bytes(p: Mapping[str, Any]) -> int:
+    # rows cross at the wire width; ONE int32 index exchange (the key
+    # leg rides the compressed payload)
+    return int(p["global_batch"] * (p["dim"] * _wire(p) + 4) + 256)
+
+
+def _bf16_push_bytes(p: Mapping[str, Any]) -> int:
+    # bf16 grads + int32 keys on the gather, narrow a2a legs
+    return int(p["global_batch"] * (p["dim"] * _wire(p) + 6) + 256)
+
+
+def _int8_push_bytes(p: Mapping[str, Any]) -> int:
+    # int8 grads + per-row f32 scale + int32 keys (+8), plus the
+    # int8-width a2a leg (+wire)
+    return int(p["global_batch"]
+               * (p["dim"] * _wire(p) + 8 + _wire(p)) + 384)
+
+
+def _psum_pull_bytes(p: Mapping[str, Any]) -> int:
+    # the broadcast-style baseline: one O(batch * dim) all-reduce
+    return int(p["global_batch"] * p["dim"] * p["itemsize"])
+
+
+def _psum_push_bytes(p: Mapping[str, Any]) -> int:
+    # full global batch gathered to every shard — the O(global) cost
+    # the a2a plane exists to kill
+    return int(p["global_batch"] * (p["dim"] + 1) * p["itemsize"])
+
+
+def _grouped_pull_bytes(p: Mapping[str, Any]) -> int:
+    # concatenated stream: every member table at the padded bucket dim
+    return int(p["num_tables"] * p["global_batch"]
+               * (p["dim_bucket"] * p["itemsize"] + 4) + 384)
+
+
+def _grouped_push_bytes(p: Mapping[str, Any]) -> int:
+    return int(p["num_tables"] * p["global_batch"]
+               * ((p["dim_bucket"] + 1) * p["itemsize"] + 4) + 384)
+
+
+def _unit_factor(stats: Mapping[str, Any]) -> float:
+    # the compiled exchange moves the FULL index stream — dedup happens
+    # host-side on the serving path, not in the device program
+    return 1.0
+
+
+def _cache_factor(stats: Mapping[str, Any]) -> float:
+    # hot rows served from the replicated K-row cache skip the owner
+    # exchange payload; the index legs still cross. Floor keeps the
+    # model honest when the scraped hit ratio is noisy.
+    hit = float(stats.get("cache_hit_ratio", 0.0))
+    return max(0.15, 1.0 - hit)
+
+
+def _no_overhead(p: Mapping[str, Any]) -> int:
+    return 0
+
+
+def _cache_hbm(p: Mapping[str, Any]) -> int:
+    # K replicated hot rows + their grad-merge slot, per device
+    return int(p.get("cache_k", 128) * (p["dim"] + 1) * p["itemsize"])
+
+
+def _pipelined_hbm(p: Mapping[str, Any]) -> int:
+    # the prefetched double buffer: one extra pulled-row batch resident
+    return int(p["global_batch"] * p["dim"] * p["itemsize"])
+
+
+def _grouped_hbm(p: Mapping[str, Any]) -> int:
+    # bucket-padding waste across the concatenated stream
+    return int(p["num_tables"] * p["global_batch"]
+               * max(0, p["dim_bucket"] - p["dim"]) * p["itemsize"])
+
+
+@dataclasses.dataclass(frozen=True)
+class PlaneSpec:
+    """Declared cost model for one exchange plane (graftplan).
+
+    ``exchange_bytes`` maps program -> declared per-device wire bytes
+    (audited against compiled HLO by the graftcheck cost-audit);
+    ``launches`` maps program -> collective launch count at the audit
+    shape; ``workload_factor`` scales the effective exchange cost by
+    observed workload stats; ``hbm_overhead_bytes`` is the plane's
+    resident-memory overhead beyond the table shards;
+    ``host_step_units`` is a relative host-side CPU dispatch cost per
+    step (per-table program dispatches the host must issue).
+    """
+
+    plane: str
+    exchange_bytes: Mapping[str, Bound]
+    launches: Mapping[str, int]
+    wire_ops: Tuple[str, ...] = EXCHANGE_BYTE_OPS
+    workload_factor: Callable[[Mapping[str, Any]], float] = _unit_factor
+    hbm_overhead_bytes: Bound = _no_overhead
+    host_step_units: float = 1.0
+
+
+PLANE_SPECS: Dict[str, PlaneSpec] = {}
+
+
+def _register_spec(s: PlaneSpec) -> PlaneSpec:
+    PLANE_SPECS[s.plane] = s
+    return s
+
+
+_register_spec(PlaneSpec(
+    plane="a2a",
+    exchange_bytes={"pull": _a2a_pull_bytes, "push": _a2a_push_bytes},
+    launches={"pull": 7, "push": 5}))
+_register_spec(PlaneSpec(
+    plane="a2a+cache",
+    exchange_bytes={"pull": _a2a_pull_bytes, "push": _a2a_push_bytes},
+    launches={"pull": 7, "push": 7},
+    workload_factor=_cache_factor, hbm_overhead_bytes=_cache_hbm))
+_register_spec(PlaneSpec(
+    plane="a2a+grouped",
+    exchange_bytes={"pull": _grouped_pull_bytes,
+                    "push": _grouped_push_bytes},
+    # THE grouped claim priced in: launch count is per GROUP, so the
+    # per-step host dispatch cost stays ~one table's worth
+    launches={"pull": 7, "push": 5},
+    hbm_overhead_bytes=_grouped_hbm, host_step_units=0.5))
+_register_spec(PlaneSpec(
+    plane="a2a+pipelined",
+    exchange_bytes={"pull": _a2a_pull_bytes, "push": _a2a_push_bytes},
+    launches={"pull": 7, "push": 5},
+    hbm_overhead_bytes=_pipelined_hbm,
+    # the fused step hides exchange latency under the dense compute —
+    # modelled as a host/launch discount, not a byte discount
+    host_step_units=0.75))
+_register_spec(PlaneSpec(
+    plane="a2a+bf16",
+    exchange_bytes={"pull": _compressed_pull_bytes,
+                    "push": _bf16_push_bytes},
+    launches={"pull": 7, "push": 5}))
+_register_spec(PlaneSpec(
+    plane="a2a+int8",
+    exchange_bytes={"pull": _compressed_pull_bytes,
+                    "push": _int8_push_bytes},
+    launches={"pull": 7, "push": 6}))
+_register_spec(PlaneSpec(
+    plane="psum",
+    exchange_bytes={"pull": _psum_pull_bytes, "push": _psum_push_bytes},
+    launches={"pull": 1, "push": 2},
+    wire_ops=PSUM_WIRE_OPS))
+
+# completeness: every plane with a registered pull/push contract MUST
+# carry a cost declaration — a new plane that forgets one fails at
+# import, not at planning time
+for _plane, _prog in REGISTRY:
+    if _prog in ("pull", "push") and _plane not in PLANE_SPECS:
+        raise AssertionError(
+            f"plane {_plane!r} has a ProgramContract but no PlaneSpec "
+            "cost declaration — register one next to its contract so "
+            "graftplan can price it")
+
+
+def declared_exchange_bytes(plane: str, program: str,
+                            params: Mapping[str, Any]) -> int:
+    """The PlaneSpec-declared wire bytes of one (plane, program) at
+    ``params`` — the number the graftcheck cost-audit holds against
+    the compiled HLO."""
+    spec = PLANE_SPECS.get(plane)
+    if spec is None or program not in spec.exchange_bytes:
+        raise KeyError(f"no PlaneSpec cost declaration for "
+                       f"({plane!r}, {program!r}); known: "
+                       f"{sorted(PLANE_SPECS)}")
+    return int(spec.exchange_bytes[program](params))
+
+
+def check_cost_model(hlo_text: str, plane: str, program: str,
+                     params: Mapping[str, Any], *,
+                     tolerance: float = COST_MODEL_TOLERANCE,
+                     spec: Optional[PlaneSpec] = None
+                     ) -> Dict[str, Any]:
+    """Audit one plane's declared exchange bytes against its compiled
+    HLO: |declared - actual| must stay within ``tolerance`` of the
+    actual ``exchange_collective_bytes`` over the spec's wire ops.
+    ``spec`` overrides the registered one (the negative tests inject a
+    deliberately-wrong declaration). Returns the comparison; raises
+    :class:`ContractViolation` on disagreement."""
+    spec = spec if spec is not None else PLANE_SPECS.get(plane)
+    if spec is None or program not in spec.exchange_bytes:
+        raise KeyError(f"no PlaneSpec cost declaration for "
+                       f"({plane!r}, {program!r})")
+    declared = int(spec.exchange_bytes[program](params))
+    actual = exchange_collective_bytes(hlo_text, ops=spec.wire_ops)
+    scale = max(actual, 1)
+    err = abs(declared - actual) / scale
+    if err > tolerance:
+        raise ContractViolation(
+            f"{plane}/{program}: declared exchange cost {declared} B "
+            f"disagrees with compiled HLO {actual} B by "
+            f"{err * 100:.1f}% > {tolerance * 100:.0f}% "
+            f"(params {dict(params)}) — the PlaneSpec cost model is "
+            "stale; recalibrate the declaration next to the plane's "
+            "contract")
+    return {"plane": plane, "program": program, "declared": declared,
+            "actual": actual, "rel_err": err, "tolerance": tolerance}
+
+
 def check_program(hlo_text: str, plane: str, program: str,
                   **params) -> Dict[str, Tuple[int, int]]:
     """Audit one compiled program against its registered contract.

@@ -1,0 +1,644 @@
+"""graftplan core: observed stats window -> EnvConfig + rationale.
+
+The offline planner (``tools/graftplan``) replaces folklore tuning
+("use a2a+cache when the workload looks skewed, size batch_rows to a
+few x p99") with one deterministic function from OBSERVED numbers to
+an :class:`~openembedding_tpu.utils.envconfig.EnvConfig`:
+
+* a **stats window** (:func:`collect_window`, exported by
+  ``tools/graftscope --export-stats``) carries the per-table
+  ``pull_unique_ratio`` / ``pull_key_skew`` gauges, the
+  ``serving_lookup_rows`` histogram, cache hit counters and the ingest
+  stall accounting out of a live run;
+* **trajectory records** (``tools/graftwatch --record``) matching the
+  window's device fingerprint calibrate the two hardware constants of
+  the cost model — seconds per exchanged byte and seconds per
+  collective launch (:func:`calibrate`);
+* every registered plane's :class:`~.contracts.PlaneSpec` prices the
+  observed workload under that calibration (:func:`plane_costs`), and
+  the serving / ingest sections pick their knobs from the measured
+  distributions (:func:`build_plan`).
+
+Everything here is pure arithmetic over the window dict: no wall
+clock, no RNG, no environment reads — the same window + trajectory
+bytes always produce a byte-identical EnvConfig (asserted by
+``tests/test_graftplan.py``), so a plan can be reviewed in a PR diff.
+
+Honest caveat, printed in the rationale: a cpu-mesh calibration prices
+XLA's CPU collectives, not ICI. The RELATIVE plane ranking transfers
+(byte and launch counts are contract-audited per plane); the absolute
+seconds do not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import (Any, Dict, Iterable, List, Mapping, Optional,
+                    Sequence, Tuple)
+
+from ..utils import envconfig
+from . import contracts
+
+STATS_SCHEMA_VERSION = 1
+STATS_KIND = "stats_window"
+
+# calibration fallbacks when no fingerprint-matched trajectory record
+# exists: an effective 2 GB/s per-device exchange and 50us per
+# collective launch — the right ORDER for the cpu8 dev mesh, and only
+# the relative plane ranking is consumed anyway (see module docs)
+DEFAULT_PER_BYTE_S = 1.0 / 2e9
+DEFAULT_PER_LAUNCH_S = 50e-6
+
+# planning defaults where the window is silent
+DEFAULT_TRAIN_BATCH = 1024
+DEFAULT_DIM = 16
+ITEMSIZE = 4
+
+# cache-K ladder: observed top-key share of the pull stream -> the
+# replicated hot-row cache size worth paying HBM for (0 = no cache)
+CACHE_K_LADDER: Tuple[Tuple[float, int], ...] = (
+    (0.02, 0), (0.10, 64), (0.25, 128), (1.01, 256))
+
+# serving-knob sizing rules (README "graftplan"): coalesce ~4 p95
+# requests per flush, wait ~4 mean interarrivals, queue 8 flushes deep.
+# The flush width is deliberately conservative — it is sized from the
+# REQUEST-SHAPE window only (the planner cannot see saturation
+# dynamics in a request-size histogram); under sustained overload the
+# online tuner walks rows up toward the envelope ceiling (4x this)
+# where flush amortization peaks — that gap is exactly what the
+# tools/graftload --drift A/B measures.
+ROWS_PER_FLUSH_P95 = 4
+WAIT_INTERARRIVALS = 4
+QUEUE_FLUSHES = 8
+
+
+def _pow2ceil(v: float) -> int:
+    return 1 << max(0, math.ceil(math.log2(max(1.0, v))))
+
+
+def _clamp(v: int, lo: int, hi: int) -> int:
+    return max(lo, min(hi, v))
+
+
+# --- the stats window --------------------------------------------------------
+
+def collect_window(*, window_s: float, fingerprint: str = "unknown",
+                   device: Optional[Mapping[str, Any]] = None,
+                   table_dims: Optional[Mapping[str, int]] = None
+                   ) -> Dict[str, Any]:
+    """Snapshot the live observability state into one stats-window
+    dict (the schema :func:`build_plan` consumes and
+    ``tools/graftscope --export-stats`` serialises).
+
+    ``window_s`` is the wall duration the counters cover (the caller
+    measured it; this module never reads a clock). ``table_dims``
+    annotates embedding dims the metrics plane cannot see.
+    """
+    from ..utils import observability
+    from . import scope
+
+    dims = dict(table_dims or {})
+
+    # per-table workload gauges (always-on) + the gated pull histograms
+    tables: Dict[str, Dict[str, Any]] = {}
+    gauges = observability.labeled_gauges()
+
+    def _gauge(name: str, table: str) -> Optional[float]:
+        series = gauges.get(name, {})
+        return series.get((("table", table),))
+
+    names = set()
+    for key in gauges.get("pull_unique_ratio_last", {}):
+        labels = dict(key)
+        if "table" in labels:
+            names.add(labels["table"])
+    for name, labels in scope.HISTOGRAMS.series():
+        if name == "pull_rows" and "table" in labels:
+            names.add(labels["table"])
+    names.update(dims)
+    for t in sorted(names):
+        entry: Dict[str, Any] = {
+            "pull_unique_ratio": _gauge("pull_unique_ratio_last", t),
+            "pull_key_skew": _gauge("pull_key_skew_last", t),
+            "dim": int(dims[t]) if t in dims else None,
+        }
+        n = scope.HISTOGRAMS.count("pull_rows", table=t)
+        entry["pull_rows_count"] = n
+        entry["pull_rows_p50"] = (
+            scope.HISTOGRAMS.quantile("pull_rows", 0.5, table=t)
+            if n else None)
+        tables[t] = entry
+
+    # serving request-size distribution, pooled conservatively across
+    # table series (max over per-table quantiles — knob sizing wants
+    # the widest table, not the average)
+    lookup = {"count": 0, "p50": None, "p95": None, "p99": None,
+              "sum": 0.0}
+    for name, labels in scope.HISTOGRAMS.series():
+        if name != "serving_lookup_rows":
+            continue
+        n = scope.HISTOGRAMS.count(name, **labels)
+        lookup["count"] += n
+        lookup["sum"] += scope.HISTOGRAMS.sum(name, **labels)
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = scope.HISTOGRAMS.quantile(name, q, **labels)
+            if v == v:  # not NaN
+                cur = lookup[key]
+                lookup[key] = v if cur is None else max(cur, v)
+
+    stalls_n = scope.HISTOGRAMS.count("ingest_stall_ms")
+    ingest = {
+        "pops": stalls_n,
+        "stall_ms_sum": scope.HISTOGRAMS.sum("ingest_stall_ms"),
+        "stall_ms_p95": (
+            scope.HISTOGRAMS.quantile("ingest_stall_ms", 0.95)
+            if stalls_n else None),
+    }
+
+    return {
+        "schema_version": STATS_SCHEMA_VERSION,
+        "kind": STATS_KIND,
+        "fingerprint": fingerprint,
+        "device": dict(device) if device else None,
+        "window_s": float(window_s),
+        "tables": tables,
+        "serving": {"lookup_rows": lookup},
+        "cache": observability.cache_stats(),
+        "ingest": ingest,
+    }
+
+
+def validate_window(window: Any) -> List[str]:
+    """Schema problems with one stats window ([] == valid)."""
+    if not isinstance(window, Mapping):
+        return [f"window: expected a dict, got {type(window).__name__}"]
+    p: List[str] = []
+    if window.get("kind") != STATS_KIND:
+        p.append(f"kind: expected {STATS_KIND!r}, "
+                 f"got {window.get('kind')!r}")
+    if window.get("schema_version") != STATS_SCHEMA_VERSION:
+        p.append(f"schema_version: expected {STATS_SCHEMA_VERSION}, "
+                 f"got {window.get('schema_version')!r}")
+    if not isinstance(window.get("window_s"), (int, float)) \
+            or window.get("window_s", 0) <= 0:
+        p.append("window_s: must be a positive number")
+    if not isinstance(window.get("fingerprint"), str):
+        p.append("fingerprint: must be a string")
+    for key, typ in (("tables", Mapping), ("serving", Mapping),
+                     ("cache", Mapping), ("ingest", Mapping)):
+        if not isinstance(window.get(key), typ):
+            p.append(f"{key}: missing or not a mapping")
+    return p
+
+
+def load_window(path: str) -> Dict[str, Any]:
+    """Read + validate one stats-window JSON file."""
+    with open(path, "r", encoding="utf-8") as f:
+        window = json.load(f)
+    problems = validate_window(window)
+    if problems:
+        raise ValueError(
+            f"{path}: not a graftplan stats window:\n  "
+            + "\n  ".join(problems))
+    return window
+
+
+# --- hardware calibration ----------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Calibration:
+    """The cost model's two hardware constants + their provenance."""
+
+    per_byte_s: float
+    per_launch_s: float
+    n_records: int
+    source: str          # "trajectory" | "defaults"
+
+
+def _record_params(plane: str, batch: int, dim: int) -> Dict[str, Any]:
+    p: Dict[str, Any] = {"global_batch": batch, "dim": dim,
+                         "itemsize": ITEMSIZE}
+    if plane == "a2a+bf16":
+        p["wire_itemsize"] = 2
+    elif plane == "a2a+int8":
+        # pull rides the bf16 leg, push the int8 one; the int8 push
+        # form reads wire_itemsize=1
+        p["wire_itemsize"] = 1
+    if plane == "a2a+grouped":
+        # graftwatch --record benches the 3-table grouped collection
+        p.update(num_tables=3, dim_bucket=_pow2ceil(dim))
+    return p
+
+
+def _record_cost_terms(rec: Mapping[str, Any]
+                       ) -> Optional[Tuple[float, float, float]]:
+    """(bytes, launches, seconds_per_step) of one trajectory record
+    under its plane's declared cost model, or None when unusable."""
+    plane = rec.get("plane")
+    spec = contracts.PLANE_SPECS.get(plane)
+    cfg = rec.get("config") or {}
+    eps = rec.get("eps")
+    try:
+        batch, dim = int(cfg["batch"]), int(cfg["dim"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if spec is None or not isinstance(eps, (int, float)) or eps <= 0 \
+            or batch <= 0 or dim <= 0:
+        return None
+    params = _record_params(plane, batch, dim)
+    if plane == "a2a+int8":
+        pull = contracts.declared_exchange_bytes(
+            plane, "pull", dict(params, wire_itemsize=2))
+        push = contracts.declared_exchange_bytes(plane, "push", params)
+        nbytes = float(pull + push)
+    else:
+        nbytes = float(sum(
+            contracts.declared_exchange_bytes(plane, prog, params)
+            for prog in ("pull", "push")))
+    launches = float(spec.launches["pull"] + spec.launches["push"])
+    return nbytes, launches, batch / float(eps)
+
+
+def calibrate(records: Iterable[Mapping[str, Any]],
+              fingerprint: str) -> Calibration:
+    """Fit seconds = per_byte * bytes + per_launch * launches over the
+    fingerprint-matched trajectory records (least squares through the
+    declared byte/launch counts). Falls back to the documented
+    defaults when the trajectory has nothing usable for this hardware
+    — the planner stays deterministic either way.
+    """
+    rows: List[Tuple[float, float, float]] = []
+    for rec in records:
+        if not isinstance(rec, Mapping):
+            continue
+        if rec.get("fingerprint") != fingerprint:
+            continue
+        terms = _record_cost_terms(rec)
+        if terms is not None:
+            rows.append(terms)
+    if len(rows) < 2:
+        return Calibration(DEFAULT_PER_BYTE_S, DEFAULT_PER_LAUNCH_S,
+                           len(rows), "defaults")
+    # 2x2 normal equations for t ~ a*bytes + b*launches
+    sbb = sum(b * b for b, _, _ in rows)
+    sll = sum(l * l for _, l, _ in rows)
+    sbl = sum(b * l for b, l, _ in rows)
+    sbt = sum(b * t for b, _, t in rows)
+    slt = sum(l * t for _, l, t in rows)
+    det = sbb * sll - sbl * sbl
+    if det > 0:
+        a = (sbt * sll - slt * sbl) / det
+        b = (slt * sbb - sbt * sbl) / det
+        if a > 0 and b > 0:
+            return Calibration(a, b, len(rows), "trajectory")
+    # collinear or non-physical fit: pin the launch constant and take
+    # the median implied byte cost
+    implied = sorted(
+        max(0.0, (t - l * DEFAULT_PER_LAUNCH_S)) / nb
+        for nb, l, t in rows if nb > 0)
+    if implied and implied[len(implied) // 2] > 0:
+        return Calibration(implied[len(implied) // 2],
+                           DEFAULT_PER_LAUNCH_S, len(rows),
+                           "trajectory")
+    return Calibration(DEFAULT_PER_BYTE_S, DEFAULT_PER_LAUNCH_S,
+                       len(rows), "defaults")
+
+
+def load_trajectory(path: str) -> List[Dict[str, Any]]:
+    """Best-effort jsonl reader (missing file -> []); schema noise is
+    skipped record-wise by :func:`calibrate`."""
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        return []
+    return records
+
+
+# --- plane pricing -----------------------------------------------------------
+
+def _table_batch(entry: Mapping[str, Any]) -> int:
+    v = entry.get("pull_rows_p50")
+    if isinstance(v, (int, float)) and v > 0:
+        return max(1, int(round(v)))
+    return DEFAULT_TRAIN_BATCH
+
+
+def _table_dim(entry: Mapping[str, Any]) -> int:
+    v = entry.get("dim")
+    if isinstance(v, int) and v > 0:
+        return v
+    return DEFAULT_DIM
+
+
+def _mean(values: Sequence[float], default: float) -> float:
+    vals = [v for v in values if isinstance(v, (int, float))]
+    return sum(vals) / len(vals) if vals else default
+
+
+def choose_cache_k(key_skew: float) -> int:
+    """The cache-K ladder over the observed top-key share."""
+    for bound, k in CACHE_K_LADDER:
+        if key_skew < bound:
+            return k
+    return CACHE_K_LADDER[-1][1]
+
+
+def plane_costs(window: Mapping[str, Any], calib: Calibration
+                ) -> Dict[str, Dict[str, Any]]:
+    """Price every registered plane for the window's observed workload:
+    effective step seconds = workload_factor * wire bytes * per_byte
+    + launches * host_step_units * per_launch, plus the declared HBM
+    overhead (reported, not scored — it is a budget, not a latency).
+    Per-table planes dispatch one program pair per table; the grouped
+    plane dispatches one pair per GROUP.
+    """
+    tables = window.get("tables") or {}
+    entries = list(tables.values()) or [{}]
+    skew = _mean([e.get("pull_key_skew") for e in entries], 0.0)
+    uniq = _mean([e.get("pull_unique_ratio") for e in entries], 1.0)
+    cache = window.get("cache") or {}
+    hits = float(cache.get("cache_hits", 0) or 0)
+    misses = float(cache.get("cache_misses", 0) or 0)
+    cache_k = choose_cache_k(skew)
+    if hits + misses > 0:
+        hit_ratio = hits / (hits + misses)
+    elif cache_k > 0:
+        # prospective: a K-row cache on a skewed stream lands roughly
+        # a couple of top-key shares' worth of traffic
+        hit_ratio = min(0.8, 2.0 * skew)
+    else:
+        hit_ratio = 0.0
+    stats = {"unique_ratio": uniq, "key_skew": skew,
+             "cache_hit_ratio": hit_ratio}
+
+    dims = [_table_dim(e) for e in entries]
+    batches = [_table_batch(e) for e in entries]
+    bucket = _pow2ceil(max(dims))
+    out: Dict[str, Dict[str, Any]] = {}
+    for plane in sorted(contracts.PLANE_SPECS):
+        spec = contracts.PLANE_SPECS[plane]
+        if plane == "a2a+grouped":
+            params = {"global_batch": max(batches), "dim": max(dims),
+                      "itemsize": ITEMSIZE,
+                      "num_tables": len(entries), "dim_bucket": bucket,
+                      "cache_k": cache_k}
+            nbytes = sum(
+                int(spec.exchange_bytes[prog](params))
+                for prog in ("pull", "push"))
+            dispatches = 1
+            hbm = int(spec.hbm_overhead_bytes(params))
+        else:
+            nbytes, hbm = 0, 0
+            for dim, batch in zip(dims, batches):
+                params = {"global_batch": batch, "dim": dim,
+                          "itemsize": ITEMSIZE, "cache_k": cache_k,
+                          "wire_itemsize":
+                          1 if plane == "a2a+int8" else 2}
+                if plane == "a2a+int8":
+                    nbytes += int(spec.exchange_bytes["pull"](
+                        dict(params, wire_itemsize=2)))
+                    nbytes += int(spec.exchange_bytes["push"](params))
+                else:
+                    nbytes += sum(
+                        int(spec.exchange_bytes[prog](params))
+                        for prog in ("pull", "push"))
+                hbm += int(spec.hbm_overhead_bytes(params))
+            dispatches = len(entries)
+        launches = (spec.launches["pull"] + spec.launches["push"]) \
+            * dispatches
+        factor = spec.workload_factor(stats)
+        wire_s = factor * nbytes * calib.per_byte_s
+        launch_s = launches * spec.host_step_units * calib.per_launch_s
+        out[plane] = {
+            "bytes": nbytes, "launches": launches,
+            "workload_factor": round(factor, 4),
+            "hbm_overhead_bytes": hbm,
+            "wire_s": wire_s, "launch_s": launch_s,
+            "step_s": wire_s + launch_s,
+        }
+    # multi-table grouping needs >= 2 member tables to exist at all
+    if len(entries) < 2:
+        out.pop("a2a+grouped", None)
+    return out
+
+
+# --- the plan ----------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One planner choice, with the observed basis that drove it."""
+
+    knob: str
+    value: Any
+    basis: str
+    rationale: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    config: envconfig.EnvConfig
+    decisions: Tuple[Decision, ...]
+    scores: Mapping[str, Mapping[str, Any]]
+    calibration: Calibration
+
+
+_COMPRESSED_EXCHANGE = {
+    "a2a+bf16": ("bf16", "bf16"),
+    "a2a+int8": ("bf16", "int8_ef"),
+}
+
+
+def build_plan(window: Mapping[str, Any],
+               records: Iterable[Mapping[str, Any]] = (),
+               *, base: Optional[envconfig.EnvConfig] = None,
+               allow_compressed: bool = True) -> Plan:
+    """The planner proper: window + trajectory -> :class:`Plan`.
+
+    Pure and deterministic — see module docs. ``allow_compressed``
+    gates the bf16/int8 rungs out of plane selection for workloads
+    that cannot take the precision hit (they still appear, priced, in
+    the score table).
+    """
+    problems = validate_window(window)
+    if problems:
+        raise ValueError("invalid stats window:\n  "
+                         + "\n  ".join(problems))
+    base = base if base is not None else envconfig.EnvConfig()
+    calib = calibrate(records, str(window["fingerprint"]))
+    scores = plane_costs(window, calib)
+    decisions: List[Decision] = []
+
+    tables = window.get("tables") or {}
+    entries = list(tables.values())
+    skew = _mean([e.get("pull_key_skew") for e in entries], 0.0)
+    uniq = _mean([e.get("pull_unique_ratio") for e in entries], 1.0)
+
+    # 1. exchange plane (training): cheapest effective step
+    eligible = {p: s for p, s in scores.items()
+                if allow_compressed or p not in _COMPRESSED_EXCHANGE}
+    plane = min(sorted(eligible), key=lambda p: eligible[p]["step_s"])
+    s = eligible[plane]
+    decisions.append(Decision(
+        "plane", plane,
+        f"unique_ratio={uniq:.3f} key_skew={skew:.3f} "
+        f"tables={len(entries)}",
+        f"cheapest effective step {s['step_s'] * 1e3:.3f} ms "
+        f"({s['bytes']} B wire x{s['workload_factor']}, "
+        f"{s['launches']} launches) under {calib.source} "
+        "calibration"))
+
+    # 2. wire precision: only a compressed winner rewrites the
+    # exchange section (numerics are a policy choice, not a perf one)
+    exchange = base.exchange
+    if plane in _COMPRESSED_EXCHANGE:
+        prec, push_prec = _COMPRESSED_EXCHANGE[plane]
+        exchange = dataclasses.replace(
+            base.exchange, precision=prec, push_precision=push_prec)
+        decisions.append(Decision(
+            "exchange.precision", f"{prec}/{push_prec}",
+            f"plane={plane}",
+            "compressed rung won on wire bytes; spec-level override "
+            "still available per table"))
+
+    # 3. cache K (spec-level; the EnvConfig has no cache_k field, so
+    # this decision is advisory output for make_*_specs callers)
+    cache_k = choose_cache_k(skew)
+    decisions.append(Decision(
+        "cache_k", cache_k, f"key_skew={skew:.3f}",
+        "top-key share ladder "
+        + "/".join(f"<{b:g}->{k}" for b, k in CACHE_K_LADDER)))
+
+    # 4. serving batcher knobs from the measured request distribution
+    lookup = (window.get("serving") or {}).get("lookup_rows") or {}
+    count = int(lookup.get("count") or 0)
+    window_s = float(window["window_s"])
+    plan_cfg = base.plan
+    serving = base.serving
+    if count > 0 and lookup.get("p95"):
+        p95 = float(lookup["p95"])
+        p50 = float(lookup.get("p50") or p95)
+        clamp_lo, clamp_hi = plan_cfg.rows_floor, plan_cfg.rows_ceiling
+        rows = _clamp(_pow2ceil(ROWS_PER_FLUSH_P95 * p95),
+                      clamp_lo, clamp_hi)
+        rate = count / window_s
+        interarrival_us = 1e6 / rate
+        wait = _clamp(
+            int(round(WAIT_INTERARRIVALS * interarrival_us / 10.0))
+            * 10,
+            plan_cfg.wait_floor_us, plan_cfg.wait_ceiling_us)
+        queue = QUEUE_FLUSHES * rows
+        serving = dataclasses.replace(
+            base.serving, batch_rows=rows, batch_wait_us=wait,
+            batch_queue_rows=queue)
+        floor = _clamp(_pow2ceil(p50), 64, rows)
+        ceiling = _clamp(_pow2ceil(4 * rows), rows, 8192)
+        plan_cfg = dataclasses.replace(
+            plan_cfg, rows_floor=floor, rows_ceiling=ceiling)
+        decisions.append(Decision(
+            "serving.batch_rows", rows,
+            f"lookup_rows p95={p95:.0f} n={count}",
+            f"{ROWS_PER_FLUSH_P95} x p95 request rows, pow2, clamped "
+            f"to [{clamp_lo}, {clamp_hi}]"))
+        decisions.append(Decision(
+            "serving.batch_wait_us", wait,
+            f"arrival rate {rate:.1f}/s "
+            f"(interarrival {interarrival_us:.0f} us)",
+            f"{WAIT_INTERARRIVALS} x mean interarrival, clamped to "
+            f"[{plan_cfg.wait_floor_us}, {plan_cfg.wait_ceiling_us}]"))
+        decisions.append(Decision(
+            "serving.batch_queue_rows", queue,
+            f"batch_rows={rows}",
+            f"{QUEUE_FLUSHES} flushes of backlog before rejecting"))
+        decisions.append(Decision(
+            "plan.rows_envelope", f"[{floor}, {ceiling}]",
+            f"p50={p50:.0f} p95={p95:.0f}",
+            "adaptive batcher floor=pow2(p50), ceiling=4x the static "
+            "choice — the online tuner moves only inside this"))
+    else:
+        decisions.append(Decision(
+            "serving.batch_rows", serving.batch_rows,
+            "no serving_lookup_rows samples in the window",
+            "kept the base config; capture a window under real load "
+            "to size the batcher"))
+
+    # 5. ingest reader width from the stall accounting
+    ingest = window.get("ingest") or {}
+    pops = int(ingest.get("pops") or 0)
+    stall_p95 = ingest.get("stall_ms_p95")
+    readers = plan_cfg.readers
+    if pops > 0 and isinstance(stall_p95, (int, float)) \
+            and stall_p95 > 1.0:
+        readers = 4
+        plan_cfg = dataclasses.replace(plan_cfg, readers=readers)
+        decisions.append(Decision(
+            "plan.readers", readers,
+            f"ingest_stall_ms p95={stall_p95:.1f} over {pops} pops",
+            "steps block on data; widen the shard reader pool"))
+    else:
+        decisions.append(Decision(
+            "plan.readers", readers or "(stream default)",
+            f"ingest_stall_ms p95="
+            f"{stall_p95 if stall_p95 is not None else 'n/a'}",
+            "ingest keeps up; no reader-pool override"))
+
+    cfg = dataclasses.replace(base, exchange=exchange,
+                              serving=serving, plan=plan_cfg)
+    return Plan(config=cfg, decisions=tuple(decisions),
+                scores=scores, calibration=calib)
+
+
+# --- rendering ---------------------------------------------------------------
+
+def render_config(cfg: envconfig.EnvConfig) -> str:
+    """The EnvConfig as canonical JSON text — key-sorted, newline
+    terminated, byte-stable for identical plans."""
+    return json.dumps(cfg.to_json(), indent=2, sort_keys=True) + "\n"
+
+
+def format_rationale(plan: Plan) -> str:
+    """The per-decision rationale table + plane score table, one
+    deterministic string (printed by tools/graftplan, uploaded as a CI
+    artifact)."""
+    lines: List[str] = []
+    c = plan.calibration
+    lines.append("graftplan rationale")
+    lines.append(
+        f"calibration: {c.source} (n={c.n_records}) "
+        f"per_byte={c.per_byte_s:.3e} s/B "
+        f"per_launch={c.per_launch_s:.3e} s")
+    if c.source == "defaults":
+        lines.append(
+            "  (no fingerprint-matched trajectory records — absolute "
+            "costs are placeholders; the plane RANKING still follows "
+            "the audited byte/launch counts)")
+    lines.append("")
+    lines.append(f"{'plane':<14} {'wire B':>12} {'xfactor':>8} "
+                 f"{'launches':>8} {'hbm B':>12} {'step ms':>10}")
+    for plane in sorted(plan.scores,
+                        key=lambda p: plan.scores[p]["step_s"]):
+        s = plan.scores[plane]
+        lines.append(
+            f"{plane:<14} {s['bytes']:>12} "
+            f"{s['workload_factor']:>8} {s['launches']:>8} "
+            f"{s['hbm_overhead_bytes']:>12} "
+            f"{s['step_s'] * 1e3:>10.4f}")
+    lines.append("")
+    w = max(len(str(d.knob)) for d in plan.decisions)
+    for d in plan.decisions:
+        lines.append(f"{d.knob:<{w}}  = {d.value}")
+        lines.append(f"{'':<{w}}    basis: {d.basis}")
+        lines.append(f"{'':<{w}}    why:   {d.rationale}")
+    return "\n".join(lines) + "\n"

@@ -134,7 +134,7 @@ class ShardStream:
 
     def __init__(self, shards, *, batch_size: int, fmt: str = "tsv",
                  num_buckets: int = 1 << 25,
-                 readers: int = DEFAULT_READERS,
+                 readers: Optional[int] = None,
                  ring_batches: int = DEFAULT_RING_BATCHES,
                  epochs: Optional[int] = 1,
                  drop_remainder: bool = True,
@@ -156,6 +156,13 @@ class ShardStream:
         self.transform = transform
         self.verify = bool(verify)
         self.name = str(name)
+        if readers is None:
+            # graftplan hook: a planner-emitted EnvConfig (or
+            # OE_PLAN_READERS) widens the pool when the observed window
+            # showed ingest stalls; an explicit ``readers=`` argument
+            # always wins over the plan
+            from ..utils.envconfig import EnvConfig
+            readers = EnvConfig.load().plan.readers or DEFAULT_READERS
         self.readers = max(1, min(int(readers), len(self.paths)))
         per_reader = max(1, int(ring_batches) // self.readers)
         self.ring_batches = per_reader * self.readers

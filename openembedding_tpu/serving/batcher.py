@@ -58,12 +58,24 @@ from ..utils import observability
 # a 200 us window collects a handful of requests at the measured knee
 # without adding visible latency at low load; 1024 rows caps one pull
 # at ~64 coalesced 16-id storm requests
+from ..utils import envconfig
 from ..utils.envconfig import (DEFAULT_BATCH_QUEUE_ROWS,
                                DEFAULT_BATCH_ROWS, DEFAULT_BATCH_WAIT_US)
 
 DEFAULT_MAX_BATCH_ROWS = DEFAULT_BATCH_ROWS
 DEFAULT_MAX_WAIT_US = DEFAULT_BATCH_WAIT_US
 DEFAULT_MAX_QUEUE_ROWS = DEFAULT_BATCH_QUEUE_ROWS
+
+
+def knob_defaults() -> Dict[str, int]:
+    """The batcher sizing defaults, read LIVE from their one home in
+    ``utils.envconfig`` — every knob read (registry config fallbacks,
+    CLI resolution) routes through here instead of snapshotting the
+    constants at import time, so a retune (or a test monkeypatch) of
+    the envconfig values is observed everywhere."""
+    return {"max_batch_rows": int(envconfig.DEFAULT_BATCH_ROWS),
+            "max_wait_us": int(envconfig.DEFAULT_BATCH_WAIT_US),
+            "max_queue_rows": int(envconfig.DEFAULT_BATCH_QUEUE_ROWS)}
 
 
 class BusyError(RuntimeError):
@@ -169,6 +181,8 @@ class LookupBatcher:
         self._queue_rows = 0
         self._accepting = True
         self._flushes = 0
+        self._flush_rows = 0
+        self._rejects = 0
         # daemon + joined by close(): a crashing host process must not
         # hang on the flusher, an orderly close() quiesces it
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -199,6 +213,7 @@ class LookupBatcher:
                 accepted = True
             else:
                 accepted = False
+                self._rejects += 1
         if not accepted:
             sync_point("serving.batch.reject")
             # renders as oe_serving_rejected_total on /metrics
@@ -244,10 +259,14 @@ class LookupBatcher:
                     # answered before the flusher exits
                     return
                 # adaptive flush: wait for more work until the ROW cap
-                # or the oldest request's wait budget, whichever first
-                deadline = self._queue[0].t_enq + self.max_wait_us / 1e6
+                # or the oldest request's wait budget, whichever first.
+                # The knobs are re-read every iteration (set_knobs
+                # notifies this wait), so a live retune moves the very
+                # next flush decision, not the one after.
                 while self._accepting \
                         and self._queue_rows < self.max_batch_rows:
+                    deadline = self._queue[0].t_enq \
+                        + self.max_wait_us / 1e6
                     remaining = deadline - time.perf_counter()
                     if remaining <= 0:
                         break
@@ -275,9 +294,10 @@ class LookupBatcher:
     def _flush(self, batch: List[_Request]) -> None:
         sync_point("serving.batch.collect")
         t0 = time.perf_counter()
+        total_rows = sum(request_rows(r.idx) for r in batch)
         with self._cv:
             self._flushes += 1
-        total_rows = sum(request_rows(r.idx) for r in batch)
+            self._flush_rows += total_rows
         # ONE snapshot per flush: every pull below reads this reference
         # (the serving_batcher model's batch_serves_one_version
         # invariant; the resnapshot_per_pull mutation is the bug)
@@ -353,14 +373,169 @@ class LookupBatcher:
             self._cv.notify_all()
         self._thread.join(timeout)
 
+    # -- live knobs ---------------------------------------------------------
+    def knobs(self) -> Dict[str, int]:
+        """Current sizing knobs, read under the queue lock — THE live
+        accessor every external knob read goes through (the registry's
+        admission gate, warmup ladder, and the adaptive tuner)."""
+        with self._cv:
+            return {"max_batch_rows": self.max_batch_rows,
+                    "max_wait_us": self.max_wait_us,
+                    "max_queue_rows": self.max_queue_rows}
+
+    def set_knobs(self, max_batch_rows: Optional[int] = None,
+                  max_wait_us: Optional[int] = None,
+                  max_queue_rows: Optional[int] = None) -> Dict[str, int]:
+        """Retune the sizing knobs while the flusher runs. Updates land
+        under the queue lock and wake the flusher, so the very next
+        flush decision observes them (the flusher reads the knobs per
+        loop iteration — never a cached copy). Returns the new knobs."""
+        with self._cv:
+            if max_batch_rows is not None:
+                self.max_batch_rows = max(1, int(max_batch_rows))
+            if max_wait_us is not None:
+                self.max_wait_us = max(0, int(max_wait_us))
+            if max_queue_rows is not None:
+                self.max_queue_rows = max(1, int(max_queue_rows))
+            self._cv.notify_all()
+            return {"max_batch_rows": self.max_batch_rows,
+                    "max_wait_us": self.max_wait_us,
+                    "max_queue_rows": self.max_queue_rows}
+
     def stats(self) -> Dict[str, float]:
         with self._cv:
             return {"queue_rows": float(self._queue_rows),
                     "queued_requests": float(len(self._queue)),
-                    "flushes": float(self._flushes)}
+                    "flushes": float(self._flushes),
+                    "flush_rows": float(self._flush_rows),
+                    "rejects": float(self._rejects)}
 
     def __enter__(self) -> "LookupBatcher":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# --- the online planner leg (graftplan) --------------------------------------
+
+# flush-occupancy deadband: pressure UP above the high mark, DOWN below
+# the low mark, and NO step in between — combined with the consecutive-
+# sample hysteresis this is what keeps a load oscillating at a threshold
+# from flapping the knobs (tests/test_graftplan.py pins zero flaps)
+UP_OCCUPANCY = 0.85
+DOWN_OCCUPANCY = 0.30
+
+
+class AdaptiveBatchTuner:
+    """Hysteresis-bounded online tuner for one batcher's sizing knobs.
+
+    Samples the batcher every ``plan.adjust_interval_ms``: flush
+    occupancy (rows flushed per flush vs ``max_batch_rows``), queue
+    backlog, and 429 rejects since the last sample. Sustained pressure
+    (``hysteresis`` consecutive out-of-band samples) steps BOTH knobs
+    by ``step_factor`` — up under backlog (bigger flushes amortize the
+    per-pull dispatch; the wait window is moot because the row cap
+    flushes first), down when sustained idle (small fast flushes bound
+    the latency an idle server adds). Steps clamp to the PlanConfig
+    floor/ceiling and never move silently: every applied step counts as
+    ``oe_plan_adjust_total{knob=,direction=}`` on /metrics.
+
+    ``stop()`` is the kill switch: it joins the sampler and (by
+    default) restores the static knobs the batcher was configured
+    with, so disarming mid-run returns the exact pre-tuner behavior.
+    """
+
+    def __init__(self, batcher: LookupBatcher,
+                 plan: "envconfig.PlanConfig", *,
+                 up_occupancy: float = UP_OCCUPANCY,
+                 down_occupancy: float = DOWN_OCCUPANCY):
+        self._b = batcher
+        self._plan = plan
+        self._up = float(up_occupancy)
+        self._down = float(down_occupancy)
+        self._static = batcher.knobs()      # restored by the kill switch
+        self._last = batcher.stats()
+        self._streak = 0                    # signed run of same-direction samples
+        self._adjustments = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"oe-plan-{batcher.name}")
+        self._thread.start()
+
+    # -- sampling loop ------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self._plan.adjust_interval_ms / 1e3):
+            self.sample()
+
+    def _direction(self, s: Dict[str, float],
+                   knobs: Dict[str, int]) -> int:
+        """+1 pressure up, -1 sustained idle, 0 inside the deadband."""
+        flushes = s["flushes"] - self._last["flushes"]
+        rows = s["flush_rows"] - self._last["flush_rows"]
+        rejects = s["rejects"] - self._last["rejects"]
+        occupancy = rows / (flushes * knobs["max_batch_rows"]) \
+            if flushes else 0.0
+        if rejects > 0 or s["queue_rows"] > knobs["max_batch_rows"] \
+                or (flushes and occupancy >= self._up):
+            return 1
+        if flushes and occupancy <= self._down \
+                and s["queue_rows"] == 0:
+            return -1
+        return 0            # deadband, or no traffic at all this window
+
+    def sample(self) -> int:
+        """One observation->decision round (the thread calls this every
+        interval; tests drive it directly for determinism). Returns the
+        number of knob steps applied (0 or 1)."""
+        s = self._b.stats()
+        knobs = self._b.knobs()
+        d = self._direction(s, knobs)
+        self._last = s
+        if d == 0 or (self._streak and (d > 0) != (self._streak > 0)):
+            self._streak = d        # deadband or direction flip: restart
+            return 0
+        self._streak += d
+        if abs(self._streak) < self._plan.hysteresis:
+            return 0
+        self._streak = 0
+        return self._apply(knobs, up=d > 0)
+
+    def _apply(self, knobs: Dict[str, int], *, up: bool) -> int:
+        p, f = self._plan, self._plan.step_factor
+        scale = f if up else 1.0 / f
+        rows = min(p.rows_ceiling,
+                   max(p.rows_floor,
+                       int(knobs["max_batch_rows"] * scale)))
+        wait = min(p.wait_ceiling_us,
+                   max(p.wait_floor_us,
+                       int(knobs["max_wait_us"] * scale)))
+        changed = {}
+        if rows != knobs["max_batch_rows"]:
+            changed["max_batch_rows"] = rows
+        if wait != knobs["max_wait_us"]:
+            changed["max_wait_us"] = wait
+        if not changed:
+            return 0                # pinned at the envelope edge: no flap
+        sync_point("serving.plan.adjust")
+        self._b.set_knobs(**changed)
+        direction = "up" if up else "down"
+        for knob in changed:
+            observability.add_labeled("plan_adjust", knob=knob,
+                                      direction=direction)
+        self._adjustments += 1
+        return 1
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def adjustments(self) -> int:
+        return self._adjustments
+
+    def stop(self, restore: bool = True, timeout: float = 10.0) -> None:
+        """Kill switch: join the sampler; ``restore`` re-applies the
+        static knobs the batcher was configured with."""
+        self._stop.set()
+        self._thread.join(timeout)
+        if restore:
+            self._b.set_knobs(**self._static)

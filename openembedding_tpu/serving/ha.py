@@ -96,6 +96,12 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--batch-queue-rows", type=int, default=None,
                    help="bounded batcher queue depth in rows; offers "
                         "past it get 429-busy backpressure")
+    p.add_argument("--adaptive", action="store_true",
+                   help="arm the graftplan online tuner: the batcher's "
+                        "rows/wait knobs track the offered load inside "
+                        "the EnvConfig plan envelope "
+                        "(serving/batcher.AdaptiveBatchTuner; "
+                        "equivalent to OE_PLAN_ONLINE=1)")
     p.add_argument("--trace-out", default="",
                    help="record graftscope spans and export them as "
                         "Chrome-trace JSON here on (SIGTERM/ctrl-C) "
@@ -135,6 +141,10 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
     batch_rows = (args.batch_rows if args.batch_rows is not None
                   else cfg.batch_rows)
     if batch_rows > 0:
+        plan_cfg = cfg_tree.plan
+        if args.adaptive and not plan_cfg.online:
+            import dataclasses as dc
+            plan_cfg = dc.replace(plan_cfg, online=True)
         registry.enable_batching(
             max_batch_rows=batch_rows,
             max_wait_us=(args.batch_wait_us
@@ -142,9 +152,13 @@ def replica_main(argv: Optional[Sequence[str]] = None) -> int:
                          else cfg.batch_wait_us),
             max_queue_rows=(args.batch_queue_rows
                             if args.batch_queue_rows is not None
-                            else cfg.batch_queue_rows))
-        print(f"replica: micro-batching armed (rows={batch_rows})",
-              flush=True)
+                            else cfg.batch_queue_rows),
+            plan=plan_cfg if plan_cfg.online else None)
+        mode = (f"adaptive [{plan_cfg.rows_floor}, "
+                f"{plan_cfg.rows_ceiling}]" if plan_cfg.online
+                else "static")
+        print(f"replica: micro-batching armed (rows={batch_rows}, "
+              f"{mode})", flush=True)
     peers = [e for e in args.peers.split(",") if e]
     server = ControllerServer(registry, port=port, peers=peers,
                               compress=compress).start()
@@ -421,7 +435,8 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
                   trace_out: str = "",
                   batch_rows: int = 0,
                   batch_wait_us: Optional[int] = None,
-                  batch_queue_rows: Optional[int] = None
+                  batch_queue_rows: Optional[int] = None,
+                  adaptive: bool = False
                   ) -> subprocess.Popen:
     """Start a replica daemon as a child process (test/driver helper)."""
     cmd = [sys.executable, "-m", "openembedding_tpu.serving.ha",
@@ -436,6 +451,8 @@ def spawn_replica(port: int, *, load: Sequence[str] = (),
             cmd += ["--batch-wait-us", str(batch_wait_us)]
         if batch_queue_rows is not None:
             cmd += ["--batch-queue-rows", str(batch_queue_rows)]
+        if adaptive:
+            cmd += ["--adaptive"]
     for item in load:
         cmd += ["--load", item]
     if peers:

@@ -301,6 +301,11 @@ class ModelRegistry:
         # lookup and drained at delete/close
         self._batch_cfg: Optional[Dict[str, Any]] = None
         self._batchers: Dict[str, Any] = {}
+        # graftplan online mode: PlanConfig envelope; when its kill
+        # switch (plan.online) is armed, each lazily-created batcher
+        # gets an AdaptiveBatchTuner, stopped at drain time
+        self._batch_plan: Optional[Any] = None
+        self._tuners: Dict[str, Any] = {}
         from ..utils import observability
         observability.register_memory_source("serving", "registry", self)
 
@@ -425,7 +430,8 @@ class ModelRegistry:
     def enable_batching(self, *, max_batch_rows: int = 0,
                         max_wait_us: Optional[int] = None,
                         max_queue_rows: int = 0,
-                        timeout: float = 30.0) -> None:
+                        timeout: float = 30.0,
+                        plan: Optional[Any] = None) -> None:
         """Arm the micro-batching lookup scheduler: concurrent flat
         lookups against one model coalesce into ONE key-deduped batched
         pull per flush (``serving/batcher.py``; zero/None keeps the
@@ -434,17 +440,28 @@ class ModelRegistry:
         Responses stay bit-identical to unbatched lookups — each flush
         snapshots exactly one model version (graftproto
         ``serving_batcher``). Call before serving traffic; the REST
-        plane routes through :meth:`lookup` automatically."""
+        plane routes through :meth:`lookup` automatically.
+
+        ``plan`` (an ``envconfig.PlanConfig``) arms graftplan's ONLINE
+        mode when its ``online`` kill switch is set: every batcher gets
+        an :class:`batcher.AdaptiveBatchTuner` moving max_batch_rows /
+        max_wait_us inside the plan's floor/ceiling envelope.
+        """
         from . import batcher as batcher_mod
+        # fallbacks resolve through the LIVE knob accessor, never an
+        # import-time snapshot of the envconfig constants (the online
+        # tuner and test monkeypatches both rely on late reads)
+        defaults = batcher_mod.knob_defaults()
         cfg = {"max_batch_rows": max_batch_rows
-               or batcher_mod.DEFAULT_MAX_BATCH_ROWS,
-               "max_wait_us": batcher_mod.DEFAULT_MAX_WAIT_US
+               or defaults["max_batch_rows"],
+               "max_wait_us": defaults["max_wait_us"]
                if max_wait_us is None else max_wait_us,
                "max_queue_rows": max_queue_rows
-               or batcher_mod.DEFAULT_MAX_QUEUE_ROWS,
+               or defaults["max_queue_rows"],
                "timeout": timeout}
         with self._lock:
             self._batch_cfg = cfg
+            self._batch_plan = plan
 
     @property
     def batching_enabled(self) -> bool:
@@ -462,6 +479,7 @@ class ModelRegistry:
         serve the old checkpoint's rows forever)."""
         from . import batcher as batcher_mod
         stale = None
+        stale_tuner = None
         try:
             with self._lock:
                 entry = self._batchers.get(sign)
@@ -469,6 +487,7 @@ class ModelRegistry:
                     if entry[0] is model:
                         return entry[1]
                     stale = self._batchers.pop(sign)[1]
+                    stale_tuner = self._tuners.pop(sign, None)
                 # only LIVE models get a (re)created batcher: a lookup
                 # racing delete_model must not resurrect a flusher
                 # thread for the deleted sign (it would pin the dead
@@ -478,8 +497,14 @@ class ModelRegistry:
                     return None
                 b = self._make_batcher(sign, model, self._batch_cfg)
                 self._batchers[sign] = (model, b)
+                if self._batch_plan is not None \
+                        and getattr(self._batch_plan, "online", False):
+                    self._tuners[sign] = batcher_mod.AdaptiveBatchTuner(
+                        b, self._batch_plan)
                 return b
         finally:
+            if stale_tuner is not None:
+                stale_tuner.stop(restore=False)
             if stale is not None:
                 # outside the registry lock: the drain flush pulls
                 # against the old model's snapshot (device work)
@@ -524,9 +549,16 @@ class ModelRegistry:
         is armed."""
         with self._lock:
             cfg = self._batch_cfg
+            plan = self._batch_plan
             models = list(self._models.values())
         if cfg is None:
             return 0
+        # online mode warms to the adaptive CEILING, not the configured
+        # static cap: the tuner may grow max_batch_rows mid-storm and a
+        # cold XLA compile in the serving path would eat the win
+        warm_rows = cfg["max_batch_rows"]
+        if plan is not None and getattr(plan, "online", False):
+            warm_rows = max(warm_rows, plan.rows_ceiling)
         n = 0
         for model in models:
             states = model.states
@@ -550,7 +582,7 @@ class ModelRegistry:
                                            states, record=False,
                                            span=False)
                         n += 1
-                    if cap >= cfg["max_batch_rows"]:
+                    if cap >= warm_rows:
                         break
                     cap <<= 1
         return n
@@ -566,14 +598,17 @@ class ModelRegistry:
         with self._lock:
             cfg = self._batch_cfg
         name = model.batchable(variable, idx) if cfg is not None else None
-        # oversized single requests bypass the batcher: they would
-        # flush alone into a pow2 bucket ABOVE the warmed ladder (an
-        # un-warmed XLA compile in the serving path); the direct pull
-        # compiles per raw shape exactly as the unbatched plane always
-        # has, so they are no worse off there
-        if name is not None and int(idx.shape[0]) <= cfg["max_batch_rows"]:
+        if name is not None:
             b = self._batcher_for(sign, model)
-            if b is not None:
+            # oversized single requests bypass the batcher: they would
+            # flush alone into a pow2 bucket ABOVE the warmed ladder
+            # (an un-warmed XLA compile in the serving path); the
+            # direct pull compiles per raw shape exactly as the
+            # unbatched plane always has, so they are no worse off
+            # there. The cap is the batcher's LIVE knob (one attribute
+            # read — the online tuner moves it mid-storm), never the
+            # armed-time config snapshot.
+            if b is not None and int(idx.shape[0]) <= b.max_batch_rows:
                 return b.lookup(name, idx)
             # batching disarmed/closed between the check and the
             # batcher fetch (registry.close racing a request): the
@@ -589,13 +624,22 @@ class ModelRegistry:
         with self._lock:
             if signs is None:
                 entries, self._batchers = list(self._batchers.values()), {}
+                tuners, self._tuners = list(self._tuners.values()), {}
             else:
                 entries = []
+                tuners = []
                 for s in signs:
                     entry = self._batchers.get(s)
                     if entry is None or entry[0] is keep_model:
                         continue
                     entries.append(self._batchers.pop(s))
+                    t = self._tuners.pop(s, None)
+                    if t is not None:
+                        tuners.append(t)
+        for t in tuners:
+            # before the drain: no knob step may land on a closing
+            # batcher (restore is pointless — the batcher is going away)
+            t.stop(restore=False)
         for _model, b in entries:
             # outside the registry lock: close() drains the queue, and
             # a drain flush pulls against the model (device work)
@@ -621,6 +665,7 @@ class ModelRegistry:
         self.join_loads(timeout)
         with self._lock:
             self._batch_cfg = None
+            self._batch_plan = None
         self._close_batchers()
 
     def register_model(self, model: ServingModel, *,

@@ -253,9 +253,69 @@ def _plan_ok(v: str) -> bool:
 _check(ChaosConfig, "plan", _plan_ok,
        "must be empty, '@/path/plan.json', or inline FaultPlan JSON")
 
+
+@dataclasses.dataclass(frozen=True)
+class PlanConfig:
+    """graftplan tuning envelope (analysis/plan.py, serving/batcher.py).
+
+    The OFFLINE planner (tools/graftplan) writes its chosen knobs into
+    the other sections; this section carries the envelope the ONLINE
+    adaptive batcher is allowed to move inside — hard floor/ceiling per
+    knob, the hysteresis that stops boundary flapping, and the kill
+    switch (``online=False`` pins the static knobs; flipping it back
+    off mid-run re-applies the configured statics). Env:
+    ``OE_PLAN_<FIELD>``.
+    """
+
+    online: bool = False           # kill switch for the adaptive tuner
+    rows_floor: int = 64           # adaptive max_batch_rows lower bound
+    rows_ceiling: int = 8192       # ... upper bound (warmup compiles here)
+    wait_floor_us: int = 50        # adaptive max_wait_us lower bound
+    wait_ceiling_us: int = 2000    # ... upper bound
+    adjust_interval_ms: int = 200  # tuner sampling period
+    # consecutive out-of-band samples required before a knob step —
+    # the hysteresis that keeps an oscillating load at the threshold
+    # from flapping the knobs every sample
+    hysteresis: int = 3
+    step_factor: float = 2.0       # multiplicative knob step per adjust
+    # planner-chosen ingest reader-pool width (data/stream.ShardStream);
+    # 0 keeps the stream's own default
+    readers: int = 0
+
+    def __post_init__(self):
+        _validate(self)
+        _plan_bounds_ok(self)
+
+
+_check(PlanConfig, "rows_floor", lambda v: v > 0, "must be > 0")
+_check(PlanConfig, "rows_ceiling", lambda v: v > 0,
+       "must be > 0 (and >= rows_floor)")
+_check(PlanConfig, "wait_floor_us", lambda v: v >= 0, "must be >= 0")
+_check(PlanConfig, "wait_ceiling_us", lambda v: v >= 0,
+       "must be >= 0 (and >= wait_floor_us)")
+_check(PlanConfig, "adjust_interval_ms", lambda v: v > 0, "must be > 0")
+_check(PlanConfig, "hysteresis", lambda v: v >= 1, "must be >= 1")
+_check(PlanConfig, "step_factor", lambda v: v > 1.0, "must be > 1.0")
+_check(PlanConfig, "readers", lambda v: v >= 0,
+       "must be >= 0 (0 = stream default)")
+
+
+def _plan_bounds_ok(cfg: "PlanConfig") -> None:
+    if cfg.rows_ceiling < cfg.rows_floor:
+        raise ValueError(
+            f"PlanConfig.rows_ceiling = {cfg.rows_ceiling} < rows_floor "
+            f"= {cfg.rows_floor}: the adaptive envelope is empty")
+    if cfg.wait_ceiling_us < cfg.wait_floor_us:
+        raise ValueError(
+            f"PlanConfig.wait_ceiling_us = {cfg.wait_ceiling_us} < "
+            f"wait_floor_us = {cfg.wait_floor_us}: the adaptive "
+            "envelope is empty")
+
+
 _SECTIONS = {"a2a": A2AConfig, "exchange": ExchangeConfig,
              "offload": OffloadConfig, "serving": ServingConfig,
-             "report": ReportConfig, "chaos": ChaosConfig}
+             "report": ReportConfig, "chaos": ChaosConfig,
+             "plan": PlanConfig}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,6 +329,7 @@ class EnvConfig:
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
     report: ReportConfig = dataclasses.field(default_factory=ReportConfig)
     chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
+    plan: PlanConfig = dataclasses.field(default_factory=PlanConfig)
 
     @classmethod
     def load(cls, config: Optional[Dict[str, Any]] = None,

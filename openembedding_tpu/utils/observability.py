@@ -96,23 +96,53 @@ def vtimer(name: str, accumulator: Optional[Accumulator] = None):
         acc.add_time(name, time.perf_counter() - t0)
 
 
+# always-on batch-shape gauges: when the evaluate_performance gate is
+# OFF, the uniqueness scan still runs — but at most once per table per
+# this window, so a production trainer pays ~one np.unique per second
+# per table instead of one per batch. The dict is read/written without
+# a lock: batches for one table come from one trainer thread, and the
+# worst a race costs is one extra scan.
+_BATCH_GAUGE_INTERVAL_S = 1.0
+_BATCH_GAUGE_LAST: Dict[str, float] = {}
+
+
 def record_batch_stats(sparse: Dict[str, np.ndarray],
                        accumulator: Optional[Accumulator] = None) -> None:
-    """pull_indices / pull_unique counters for one batch (host-side).
+    """Per-table batch-shape stats for one batch (host-side).
 
-    Gated by set_evaluate_performance like the reference
-    (EmbeddingPullOperator.cpp:208-209,244-248) — measuring uniqueness costs
-    a host np.unique per column, so it's off by default.
+    Two tiers (the split graftplan depends on):
+
+    * ALWAYS ON — last-value gauges ``pull_unique_ratio_last`` /
+      ``pull_key_skew_last`` per table (``/metrics``), throttled to one
+      uniqueness scan per table per second when the gate is off, so a
+      production stats window can be captured without arming the debug
+      gate (first batch of a table always records, whatever the clock).
+    * Gated by set_evaluate_performance like the reference
+      (EmbeddingPullOperator.cpp:208-209,244-248) — the pull_indices /
+      pull_unique counters and the full per-table histograms
+      (``pull_rows``/``pull_unique_ratio``/``pull_key_skew``), fed
+      every batch.
     """
-    if not _EVALUATE_PERFORMANCE:
-        return
     acc = accumulator or GLOBAL
+    gated = _EVALUATE_PERFORMANCE
     for name, idx in sparse.items():
+        if not gated:
+            last = _BATCH_GAUGE_LAST.get(name)
+            now = time.monotonic()
+            if last is not None and now - last < _BATCH_GAUGE_INTERVAL_S:
+                continue
         arr = np.asarray(idx).ravel()
         _uniq, counts = np.unique(arr, return_counts=True)
-        acc.add("pull_indices", arr.size)
-        acc.add("pull_unique", _uniq.size)
+        if gated:
+            acc.add("pull_indices", arr.size)
+            acc.add("pull_unique", _uniq.size)
         if arr.size:
+            _BATCH_GAUGE_LAST[name] = time.monotonic()
+            set_labeled_gauge("pull_unique_ratio_last",
+                              _uniq.size / arr.size, table=name)
+            set_labeled_gauge("pull_key_skew_last",
+                              counts.max() / arr.size, table=name)
+        if gated and arr.size:
             # per-table batch-shape distributions (graftscope histogram
             # registry -> /metrics _bucket series): rows per batch, the
             # dedup win, and key skew as the top-1 key's share
@@ -329,6 +359,37 @@ def gauges() -> Dict[str, float]:
         return dict(_GAUGES)
 
 
+# LABELED last-value gauges: a separate store so the flat ``gauges()``
+# view (ckpt_stats/swap_stats consume it) keeps its shape. Keyed
+# ``name -> {sorted (label, value) tuple -> value}``; rendered on
+# /metrics as ``oe_<name>{label="..."} v`` with one HELP/TYPE per name
+# (the per-table pull_unique_ratio_last / pull_key_skew_last gauges the
+# graftplan stats window is captured from live here)
+_LABELED_GAUGE_LOCK = make_lock("observability.labeled_gauges")
+_LABELED_GAUGES: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+
+
+def set_labeled_gauge(name: str, value: float, **labels) -> None:
+    key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    with _LABELED_GAUGE_LOCK:
+        _LABELED_GAUGES.setdefault(str(name), {})[key] = float(value)
+
+
+def labeled_gauges() -> Dict[str, Dict[Tuple[Tuple[str, str], ...],
+                                       float]]:
+    with _LABELED_GAUGE_LOCK:
+        return {name: dict(series)
+                for name, series in _LABELED_GAUGES.items()}
+
+
+def add_labeled(name: str, value: float = 1.0, **labels) -> None:
+    """Labeled monotonic counter — rides the scope counter registry, so
+    it renders as ``oe_<name>_total{label="..."}`` on /metrics and reads
+    back via ``scope.HISTOGRAMS.counter(name, **labels)`` (the adaptive
+    batcher's ``plan_adjust{knob=,direction=}`` decisions count here)."""
+    scope.HISTOGRAMS.inc(name, float(value), **labels)
+
+
 # --- checkpoint / serving-swap counters (delta checkpoint plane) -------------
 
 def record_ckpt_save(mode: str, nbytes: int, seconds: float, *,
@@ -459,6 +520,10 @@ def _prom_name(name: str) -> str:
     return out.lstrip("0123456789_") or "metric"
 
 
+def _esc_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
 def prometheus_text(accumulator: Optional[Accumulator] = None,
                     prefix: str = "oe",
                     include_scope: bool = True,
@@ -506,6 +571,17 @@ def prometheus_text(accumulator: Optional[Accumulator] = None,
         lines.append(f"# HELP {base} last-value gauge `{name}`")
         lines.append(f"# TYPE {base} gauge")
         lines.append(f"{base} {value:.10g}")
+    # labeled last-value gauges (per-table batch-shape stats): one
+    # HELP/TYPE per name, one series per label set
+    for name, series in sorted(labeled_gauges().items()):
+        base = f"{prefix}_{_prom_name(name)}"
+        lines.append(f"# HELP {base} last-value gauge `{name}` "
+                     f"(labeled)")
+        lines.append(f"# TYPE {base} gauge")
+        for key in sorted(series):
+            lab = ",".join(
+                f'{k}="{_esc_label(v)}"' for k, v in key)
+            lines.append(f"{base}{{{lab}}} {series[key]:.10g}")
     # graftrace traced-lock counters (empty unless OE_REPORT_TRACE_LOCKS)
     for name, st in sorted(lock_stats().items()):
         base = f"{prefix}_lock_{_prom_name(name)}"

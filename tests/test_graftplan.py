@@ -1,0 +1,368 @@
+"""graftplan: stats window, calibration, planner determinism, the
+cost-model audit hook, and the online tuner's hysteresis discipline."""
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from openembedding_tpu.analysis import contracts, scope
+from openembedding_tpu.analysis import plan as plan_lib
+from openembedding_tpu.serving.batcher import (AdaptiveBatchTuner,
+                                               LookupBatcher)
+from openembedding_tpu.utils import envconfig
+from openembedding_tpu.utils import observability as obs
+
+FP = "cpu8-test-c1"
+
+
+def make_window(*, lookups=3000, p50=64.0, p95=64.0, skew=0.3,
+                stall_p95=0.0, window_s=10.0, tables=2):
+    """A hand-built stats window in the collect_window schema."""
+    t = {}
+    for i in range(tables):
+        t[f"c{i}"] = {"pull_unique_ratio": 0.6, "pull_key_skew": skew,
+                      "dim": 16, "pull_rows_count": 100,
+                      "pull_rows_p50": 1024.0}
+    total = lookups * p50
+    return {
+        "schema_version": plan_lib.STATS_SCHEMA_VERSION,
+        "kind": plan_lib.STATS_KIND,
+        "fingerprint": FP,
+        "device": None,
+        "window_s": window_s,
+        "tables": t,
+        "serving": {"lookup_rows": {"count": lookups, "p50": p50,
+                                    "p95": p95, "p99": p95,
+                                    "sum": total}},
+        "cache": {},
+        "ingest": {"pops": 200, "stall_ms_sum": 0.0,
+                   "stall_ms_p95": stall_p95},
+    }
+
+
+# --- window schema -----------------------------------------------------------
+
+def test_collect_window_round_trips_live_stats():
+    """collect_window snapshots the live gauges/histograms into a dict
+    that validates against its own schema and drives build_plan."""
+    scope.HISTOGRAMS.reset()
+    obs.set_evaluate_performance(True)
+    try:
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            obs.record_batch_stats(
+                {"w0": rng.randint(0, 64, 512),
+                 "w1": rng.randint(0, 8, 512)})  # heavy skew
+    finally:
+        obs.set_evaluate_performance(False)
+    for _ in range(20):
+        obs.record_serving_lookup("w0", 48)
+    w = plan_lib.collect_window(window_s=5.0, fingerprint=FP,
+                                table_dims={"w0": 16, "w1": 8})
+    assert plan_lib.validate_window(w) == []
+    assert json.loads(json.dumps(w)) == w       # JSON-serialisable
+    assert set(w["tables"]) >= {"w0", "w1"}
+    assert w["tables"]["w0"]["dim"] == 16
+    assert 0 < w["tables"]["w1"]["pull_key_skew"] <= 1.0
+    assert w["serving"]["lookup_rows"]["count"] == 20
+    plan = plan_lib.build_plan(w)
+    assert plan.config.serving.batch_rows > 0
+    scope.HISTOGRAMS.reset()
+
+
+def test_validate_window_rejects_junk():
+    assert plan_lib.validate_window([]) != []
+    assert plan_lib.validate_window({}) != []
+    w = make_window()
+    assert plan_lib.validate_window(w) == []
+    bad = dict(w, kind="trace")
+    assert any("kind" in p for p in plan_lib.validate_window(bad))
+    bad = dict(w, window_s=0)
+    assert any("window_s" in p for p in plan_lib.validate_window(bad))
+    bad = dict(w)
+    del bad["tables"]
+    assert any("tables" in p for p in plan_lib.validate_window(bad))
+    with pytest.raises(ValueError, match="invalid stats window"):
+        plan_lib.build_plan(dict(w, schema_version=99))
+
+
+# --- calibration -------------------------------------------------------------
+
+def synth_records(per_byte, per_launch, planes=("a2a", "psum", "a2a+cache")):
+    """Trajectory records whose eps encodes t = a*bytes + b*launches
+    exactly, so calibrate() must recover (a, b)."""
+    recs = []
+    for plane in planes:
+        for batch in (512, 1024, 2048):
+            params = plan_lib._record_params(plane, batch, 16)
+            if plane == "a2a+int8":
+                nb = (contracts.declared_exchange_bytes(
+                          plane, "pull", dict(params, wire_itemsize=2))
+                      + contracts.declared_exchange_bytes(
+                          plane, "push", params))
+            else:
+                nb = sum(contracts.declared_exchange_bytes(
+                    plane, prog, params) for prog in ("pull", "push"))
+            spec = contracts.PLANE_SPECS[plane]
+            launches = spec.launches["pull"] + spec.launches["push"]
+            t = per_byte * nb + per_launch * launches
+            recs.append({"fingerprint": FP, "plane": plane,
+                         "config": {"batch": batch, "dim": 16},
+                         "eps": batch / t})
+    return recs
+
+
+def test_calibrate_recovers_planted_constants():
+    a, b = 2.5e-10, 80e-6
+    calib = plan_lib.calibrate(synth_records(a, b), FP)
+    assert calib.source == "trajectory"
+    assert calib.n_records == 9
+    assert calib.per_byte_s == pytest.approx(a, rel=1e-6)
+    assert calib.per_launch_s == pytest.approx(b, rel=1e-6)
+
+
+def test_calibrate_falls_back_deterministically():
+    # wrong fingerprint, junk records, too few records -> defaults
+    for records in ([], [{"fingerprint": "other", "plane": "a2a",
+                          "config": {"batch": 512, "dim": 16},
+                          "eps": 1e4}],
+                    [{"not": "a record"}, "noise", None]):
+        calib = plan_lib.calibrate(records, FP)
+        assert calib.source == "defaults"
+        assert calib.per_byte_s == plan_lib.DEFAULT_PER_BYTE_S
+        assert calib.per_launch_s == plan_lib.DEFAULT_PER_LAUNCH_S
+
+
+# --- plane spec registry -----------------------------------------------------
+
+def test_every_registered_plane_declares_costs():
+    """The cost registry must cover exactly the pull/push planes in the
+    contract registry — a new plane without declared cost terms would
+    silently fall out of planner ranking."""
+    contract_planes = {p for (p, prog) in contracts.REGISTRY
+                       if prog in ("pull", "push")}
+    assert set(contracts.PLANE_SPECS) == contract_planes
+    for plane, spec in contracts.PLANE_SPECS.items():
+        params = {"global_batch": 1024, "dim": 16, "itemsize": 4,
+                  "wire_itemsize": 2, "cache_k": 0,
+                  "num_tables": 3, "dim_bucket": 16}
+        for prog in ("pull", "push"):
+            assert spec.exchange_bytes[prog](params) > 0, plane
+            assert spec.launches[prog] >= 1, plane
+        assert spec.hbm_overhead_bytes(params) >= 0
+        assert spec.host_step_units > 0
+        assert spec.workload_factor({"unique_ratio": 0.5,
+                                     "key_skew": 0.3,
+                                     "cache_hit_ratio": 0.5}) > 0
+
+
+def test_cost_model_negative_via_spec_override(devices8):
+    """check_cost_model must FAIL a declaration that drifts from the
+    compiled HLO — audited with a deliberately wrong PlaneSpec against
+    a real lowering (the graftcheck cost-audit failure path)."""
+    from openembedding_tpu.analysis import programs
+    from openembedding_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(1, 8, devices8)
+    txt, params = programs.lower_pull(mesh, "a2a", batch=512, dim=16)
+    good = contracts.check_cost_model(txt, "a2a", "pull", params)
+    assert good["rel_err"] <= good["tolerance"]
+    spec = contracts.PLANE_SPECS["a2a"]
+    wrong = dataclasses_replace_bytes(spec, factor=3.0)
+    with pytest.raises(contracts.ContractViolation,
+                       match="cost model"):
+        contracts.check_cost_model(txt, "a2a", "pull", params,
+                                   spec=wrong)
+
+
+def dataclasses_replace_bytes(spec, factor):
+    import dataclasses
+    forms = dict(spec.exchange_bytes)
+    orig = forms["pull"]
+    forms["pull"] = lambda p, _o=orig: _o(p) * factor
+    return dataclasses.replace(spec, exchange_bytes=forms)
+
+
+# --- planner determinism + rules ---------------------------------------------
+
+def test_build_plan_byte_identical():
+    w = make_window(skew=0.3)
+    records = synth_records(2.5e-10, 80e-6)
+    texts, rats = set(), set()
+    for _ in range(3):
+        plan = plan_lib.build_plan(copy.deepcopy(w),
+                                   copy.deepcopy(records))
+        texts.add(plan_lib.render_config(plan.config))
+        rats.add(plan_lib.format_rationale(plan))
+    assert len(texts) == 1 and len(rats) == 1
+    # the artifact round-trips through the loader it feeds
+    cfg = envconfig.EnvConfig.load(config=json.loads(texts.pop()),
+                                   env={})
+    plan = plan_lib.build_plan(w, records)
+    assert cfg == plan.config
+
+
+def test_serving_knobs_follow_the_window():
+    w = make_window(lookups=3000, p50=64.0, p95=64.0, window_s=10.0)
+    plan = plan_lib.build_plan(w)
+    cfg = plan.config
+    # rows = pow2ceil(4 x p95) = 256; queue = 8 flushes
+    assert cfg.serving.batch_rows == 256
+    assert cfg.serving.batch_queue_rows == 8 * 256
+    # wait = 4 x mean interarrival (3000/10s -> 3333us), clamped to
+    # the envelope ceiling
+    assert cfg.serving.batch_wait_us == cfg.plan.wait_ceiling_us
+    # adaptive envelope: floor pow2(p50), ceiling 4x the static rows
+    assert cfg.plan.rows_floor == 64
+    assert cfg.plan.rows_ceiling == 1024
+    knobs = {d.knob for d in plan.decisions}
+    assert {"plane", "serving.batch_rows", "plan.rows_envelope",
+            "plan.readers"} <= knobs
+    # an idle window leaves serving alone
+    idle = plan_lib.build_plan(make_window(lookups=0, p95=None))
+    assert idle.config.serving.batch_rows == \
+        envconfig.ServingConfig().batch_rows
+
+
+def test_ingest_stalls_widen_reader_pool():
+    stalled = plan_lib.build_plan(make_window(stall_p95=25.0))
+    assert stalled.config.plan.readers == 4
+    healthy = plan_lib.build_plan(make_window(stall_p95=0.0))
+    assert healthy.config.plan.readers == 0
+
+
+def test_compressed_gate_and_skew_pricing():
+    """--no-compressed keeps bf16/int8 out of selection; heavy skew
+    plus a cache prices a2a+cache below plain a2a."""
+    w = make_window(skew=0.6)
+    open_plan = plan_lib.build_plan(w)
+    gated = plan_lib.build_plan(w, allow_compressed=False)
+    assert gated.decisions[0].knob == "plane"
+    assert gated.decisions[0].value not in plan_lib._COMPRESSED_EXCHANGE
+    # both still PRICE every plane
+    assert set(gated.scores) == set(open_plan.scores)
+    costs = gated.scores
+    # the skewed stream discounts the cached plane's WIRE term (its
+    # extra collective launches are priced separately, so the total
+    # can still favor a2a on launch-dominated hardware)
+    assert costs["a2a+cache"]["wire_s"] < costs["a2a"]["wire_s"]
+    assert costs["a2a+cache"]["workload_factor"] < 1.0
+
+
+# --- the online tuner (hysteresis discipline) --------------------------------
+
+class StubBatcher:
+    """Knob/stats surface of LookupBatcher without threads — the tuner
+    is driven via sample() directly."""
+
+    name = "stub"
+
+    def __init__(self, rows=256, wait=500, queue=2048):
+        self._knobs = {"max_batch_rows": rows, "max_wait_us": wait,
+                       "max_queue_rows": queue}
+        self._stats = {"queue_rows": 0.0, "queued_requests": 0.0,
+                       "flushes": 0.0, "flush_rows": 0.0,
+                       "rejects": 0.0}
+
+    def knobs(self):
+        return dict(self._knobs)
+
+    def stats(self):
+        return dict(self._stats)
+
+    def set_knobs(self, **kw):
+        self._knobs.update(kw)
+        return dict(self._knobs)
+
+    def push_window(self, *, flushes, occupancy, queue_rows=0.0,
+                    rejects=0.0):
+        """Advance the counters by one observation window."""
+        self._stats["flushes"] += flushes
+        self._stats["flush_rows"] += occupancy * flushes \
+            * self._knobs["max_batch_rows"]
+        self._stats["rejects"] += rejects
+        self._stats["queue_rows"] = queue_rows
+
+
+def make_tuner(b, **over):
+    plan = envconfig.PlanConfig(
+        online=True, rows_floor=64, rows_ceiling=1024,
+        wait_floor_us=50, wait_ceiling_us=2000,
+        adjust_interval_ms=3_600_000,   # thread effectively parked
+        hysteresis=over.pop("hysteresis", 3), step_factor=2.0)
+    t = AdaptiveBatchTuner(b, plan, **over)
+    t._stop.set()                       # tests drive sample() directly
+    return t
+
+
+def plan_adjust_count(knob, direction):
+    return scope.HISTOGRAMS.counter("plan_adjust", knob=knob,
+                                    direction=direction)
+
+
+def test_tuner_oscillation_at_threshold_never_flaps():
+    """A load oscillating across the occupancy deadband every sample
+    must produce ZERO knob moves — asserted on the knobs AND on the
+    oe_plan_adjust_total counters (the hysteresis satellite)."""
+    scope.HISTOGRAMS.reset()
+    b = StubBatcher()
+    t = make_tuner(b, hysteresis=3)
+    before = b.knobs()
+    for i in range(24):
+        if i % 2 == 0:
+            b.push_window(flushes=10, occupancy=0.95)   # pressure up
+        else:
+            b.push_window(flushes=10, occupancy=0.10)   # pressure down
+        assert t.sample() == 0
+    assert b.knobs() == before
+    assert t.adjustments == 0
+    assert plan_adjust_count("max_batch_rows", "up") == 0
+    assert plan_adjust_count("max_batch_rows", "down") == 0
+
+
+def test_tuner_sustained_pressure_steps_after_hysteresis():
+    scope.HISTOGRAMS.reset()
+    b = StubBatcher(rows=256, wait=500)
+    t = make_tuner(b, hysteresis=3)
+    b.push_window(flushes=10, occupancy=0.95)
+    assert t.sample() == 0
+    b.push_window(flushes=10, occupancy=0.95)
+    assert t.sample() == 0
+    b.push_window(flushes=10, occupancy=0.95)
+    assert t.sample() == 1              # third consecutive sample steps
+    assert b.knobs()["max_batch_rows"] == 512
+    assert b.knobs()["max_wait_us"] == 1000
+    assert plan_adjust_count("max_batch_rows", "up") == 1
+    assert plan_adjust_count("max_wait_us", "up") == 1
+    # a direction flip restarts the streak
+    b.push_window(flushes=10, occupancy=0.95)
+    assert t.sample() == 0
+    b.push_window(flushes=10, occupancy=0.05)
+    assert t.sample() == 0
+    assert b.knobs()["max_batch_rows"] == 512
+
+
+def test_tuner_envelope_edge_is_quiet_and_kill_switch_restores():
+    """Pinned at the ceiling, sustained pressure must NOT count moves
+    (edge flapping); stop() restores the configured statics."""
+    scope.HISTOGRAMS.reset()
+    b = StubBatcher(rows=1024, wait=2000)       # already at ceiling
+    t = make_tuner(b, hysteresis=2)
+    for _ in range(8):
+        b.push_window(flushes=10, occupancy=0.99, queue_rows=4096)
+        t.sample()
+    assert b.knobs()["max_batch_rows"] == 1024
+    assert t.adjustments == 0
+    assert plan_adjust_count("max_batch_rows", "up") == 0
+    # now from below the ceiling: rejects alone force pressure up
+    b2 = StubBatcher(rows=512, wait=2000)
+    t2 = make_tuner(b2, hysteresis=2)
+    for _ in range(2):
+        b2.push_window(flushes=0, occupancy=0.0, rejects=5)
+        t2.sample()
+    assert b2.knobs()["max_batch_rows"] == 1024
+    t2.stop(restore=True)
+    assert b2.knobs()["max_batch_rows"] == 512  # statics restored
+    assert b2.knobs()["max_wait_us"] == 2000
+    scope.HISTOGRAMS.reset()

@@ -26,7 +26,7 @@ def test_accumulator_and_vtimer():
 def test_batch_stats_gated():
     acc = obs.Accumulator()
     sparse = {"c": np.array([1, 1, 2, 3])}
-    obs.record_batch_stats(sparse, acc)          # gate off -> no-op
+    obs.record_batch_stats(sparse, acc)          # gate off -> no counters
     assert acc.snapshot() == {}
     obs.set_evaluate_performance(True)
     try:
@@ -36,6 +36,37 @@ def test_batch_stats_gated():
         assert snap["pull_unique"]["count"] == 3
     finally:
         obs.set_evaluate_performance(False)
+
+
+def test_batch_stats_always_on_gauges_and_throttle(monkeypatch):
+    """The graftplan split: per-table last-value gauges record with the
+    debug gate OFF (throttled to one scan per table per interval; the
+    first batch of a table always lands), while the counters/histograms
+    stay behind set_evaluate_performance."""
+    monkeypatch.setattr(obs, "_BATCH_GAUGE_LAST", {})
+    monkeypatch.setattr(obs, "_LABELED_GAUGES", {})
+    acc = obs.Accumulator()
+    key = (("table", "g0"),)
+    obs.record_batch_stats({"g0": np.array([1, 1, 2, 3])}, acc)
+    assert acc.snapshot() == {}                  # counters stay gated
+    g = obs.labeled_gauges()
+    assert g["pull_unique_ratio_last"][key] == 0.75
+    assert g["pull_key_skew_last"][key] == 0.5
+    # a second batch inside the throttle interval is skipped...
+    obs.record_batch_stats({"g0": np.array([5, 5, 5, 5])}, acc)
+    assert obs.labeled_gauges()["pull_unique_ratio_last"][key] == 0.75
+    # ...but a NEW table's first batch always records
+    obs.record_batch_stats({"g1": np.array([7, 7])}, acc)
+    assert obs.labeled_gauges()["pull_key_skew_last"][
+        (("table", "g1"),)] == 1.0
+    # the gate bypasses the throttle (per-batch fidelity when armed)
+    obs.set_evaluate_performance(True)
+    try:
+        obs.record_batch_stats({"g0": np.array([5, 5, 5, 5])}, acc)
+    finally:
+        obs.set_evaluate_performance(False)
+    assert obs.labeled_gauges()["pull_unique_ratio_last"][key] == 0.25
+    assert acc.snapshot()["pull_indices"]["count"] == 4
 
 
 def test_plane_timed_and_timings():
@@ -185,7 +216,10 @@ def test_prometheus_text_golden(monkeypatch):
     scope.reset()
     monkeypatch.setattr(obs, "_MEM_SOURCES", {})
     monkeypatch.setattr(obs, "_GAUGES", {})
+    monkeypatch.setattr(obs, "_LABELED_GAUGES", {})
     obs.set_gauge("ckpt_chain_len", 3)
+    obs.set_labeled_gauge("pull_unique_ratio_last", 0.625,
+                          table="clicks")
     got = obs.prometheus_text(acc)
     want = """\
 # HELP oe_pull_indices_total accumulated count of `pull_indices`
@@ -200,6 +234,9 @@ oe_train_step_calls_total 1
 # HELP oe_ckpt_chain_len last-value gauge `ckpt_chain_len`
 # TYPE oe_ckpt_chain_len gauge
 oe_ckpt_chain_len 3
+# HELP oe_pull_unique_ratio_last last-value gauge `pull_unique_ratio_last` (labeled)
+# TYPE oe_pull_unique_ratio_last gauge
+oe_pull_unique_ratio_last{table="clicks"} 0.625
 # HELP oe_span_pull_seconds graftscope histogram `span_pull_seconds` (log-spaced buckets)
 # TYPE oe_span_pull_seconds histogram
 oe_span_pull_seconds_bucket{plane="a2a",le="0.3162"} 1

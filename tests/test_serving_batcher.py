@@ -508,3 +508,55 @@ def test_batch_metrics_and_member_traces(served):
         assert members, "no member span with the request trace id"
     finally:
         scope.set_tracing(None)
+
+
+# --- live knob retune (graftplan online mode) --------------------------------
+
+def test_mid_storm_knob_flip_moves_next_flush():
+    """Regression: the flusher must observe a ``set_knobs`` retune on
+    its very NEXT flush decision (it once latched the knobs at thread
+    start — the adaptive tuner would then adjust a dead copy). A flip
+    from rows=8 to rows=32 while a flush is in flight must coalesce the
+    backlog into ONE 32-row flush, not four 8-row ones."""
+    entered = threading.Event()
+    release = threading.Event()
+    flush_sizes = []
+
+    def gated_pull(_snap, _name, uniq):
+        flush_sizes.append(uniq.size)
+        entered.set()
+        release.wait(10)
+        return uniq[:, None].astype(np.float32) * np.ones(2, np.float32)
+
+    b = LookupBatcher("flip", lambda: None, gated_pull,
+                      max_batch_rows=8, max_wait_us=500_000,
+                      max_queue_rows=1024)
+    try:
+        # 8 rows hit the row cap -> immediate flush, parked in the pull
+        first = b.offer("v", np.arange(8, dtype=np.int64))
+        assert entered.wait(10)
+        # backlog four more 8-row requests behind the in-flight flush
+        # (distinct keys per request so dedup keeps the row count)
+        backlog = [b.offer("v", np.arange(8 * (i + 1), 8 * (i + 2),
+                                          dtype=np.int64))
+                   for i in range(4)]
+        # the live accessor reflects the retune IMMEDIATELY, mid-pull
+        assert b.set_knobs(max_batch_rows=32, max_wait_us=0) \
+            == {"max_batch_rows": 32, "max_wait_us": 0,
+                "max_queue_rows": 1024}
+        assert b.knobs()["max_batch_rows"] == 32
+        release.set()
+        np.testing.assert_array_equal(
+            first.wait(10),
+            np.arange(8)[:, None] * np.ones(2, np.float32))
+        for i, req in enumerate(backlog):
+            want = np.arange(8 * (i + 1), 8 * (i + 2))[:, None] \
+                * np.ones(2, np.float32)
+            np.testing.assert_array_equal(req.wait(10), want)
+        # the retune moved the very next flush: 8-row flush while the
+        # old knobs ruled, then the whole 32-row backlog in ONE flush
+        assert flush_sizes == [8, 32]
+        assert b.stats()["flushes"] == 2
+    finally:
+        release.set()
+        b.close()

@@ -138,6 +138,45 @@ def main(argv=None) -> int:
                                                **params)
             audit(f"a2a+grouped/{prog} ({kind}, 3 tables)", run)
 
+    # graftplan cost audit: every registered PlaneSpec's DECLARED
+    # exchange bytes (analysis/contracts.py cost registry) against the
+    # compiled HLO's actual collective bytes, within
+    # COST_MODEL_TOLERANCE. Audited at batch >= 512 — the regime the
+    # closed forms are calibrated in (below it XLA elides the
+    # residue/overflow legs and the additive terms drift, see the
+    # registry comment) on the 1 x N layout where the exchange spans
+    # every device — mixed data-parallel layouts split the per-device
+    # bytes differently, which is a property of the LAYOUT, not the
+    # plane, and the planner only consumes the plane ranking. A stale
+    # or wrong declaration fails HERE, so the offline planner can
+    # never rank planes off fiction.
+    cost_batch = max(args.batch, 512)
+    cost_mesh = create_mesh(1, data * model)
+    for plane in sorted(contracts.PLANE_SPECS):
+        if plane == "a2a+grouped":
+            lowers = (("pull", programs.lower_grouped_pull),
+                      ("push", programs.lower_grouped_push))
+        else:
+            lowers = (("pull", programs.lower_pull),
+                      ("push", programs.lower_push))
+        for prog, lower in lowers:
+            def run(plane=plane, prog=prog, lower=lower):
+                if plane == "a2a+grouped":
+                    txt, params = lower(cost_mesh, tables=3,
+                                        batch=cost_batch,
+                                        dim=args.dim, use_hash=False)
+                else:
+                    txt, params = lower(cost_mesh, plane,
+                                        batch=cost_batch,
+                                        dim=args.dim, use_hash=False)
+                res = contracts.check_cost_model(txt, plane, prog,
+                                                 params)
+                return (f"declared {res['declared']}B vs HLO "
+                        f"{res['actual']}B (err "
+                        f"{res['rel_err'] * 100:.1f}% <= "
+                        f"{res['tolerance'] * 100:.0f}%)")
+            audit(f"{plane}/{prog} (graftplan cost model)", run)
+
     # graftwatch memory ledger: peak-temp contract per plane at the
     # calibrated audit sizes (memwatch.AUDIT_*, deliberately independent
     # of --batch: detection power needs the table shard to dwarf batch

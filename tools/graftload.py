@@ -451,6 +451,224 @@ def run_replica_sweep(args) -> int:
     return rc
 
 
+def scrape_plan_adjustments(endpoints) -> Dict[str, float]:
+    """Sum the replicas' ``oe_plan_adjust_total{knob=,direction=}``
+    counters off /metrics — every knob move the online tuner made,
+    labeled. Dead replicas contribute nothing."""
+    import re as re_mod
+    import urllib.request
+    out: Dict[str, float] = {}
+    pat = re_mod.compile(
+        r"^oe_plan_adjust_total\{([^}]*)\} ([0-9.e+]+)$",
+        re_mod.MULTILINE)
+    for ep in endpoints:
+        try:
+            with urllib.request.urlopen(f"http://{ep}/metrics",
+                                        timeout=3) as r:
+                body = r.read().decode()
+        except Exception:  # noqa: BLE001 — a dead replica is expected
+            continue
+        for m in pat.finditer(body):
+            out[m.group(1)] = out.get(m.group(1), 0.0) \
+                + float(m.group(2))
+    return out
+
+
+# calm fraction of the drift window: the calm phase exists to force a
+# real mid-run shift (the tuner must START from the calm knobs); the
+# storm phase is where adaptation pays, so it gets the larger share
+DRIFT_CALM_FRACTION = 1.0 / 3.0
+
+
+def drift_arrivals(lo: float, hi: float, duration: float,
+                   seed: int) -> np.ndarray:
+    """Open-loop arrival schedule with a mid-run load shift: Poisson at
+    ``lo`` QPS for the first third of the window, ``hi`` QPS for the
+    rest — the drifting-load scenario the online tuner exists for."""
+    calm = duration * DRIFT_CALM_FRACTION
+    a1 = poisson_arrivals(lo, calm, seed=seed)
+    a2 = poisson_arrivals(hi, duration - calm, seed=seed + 1)
+    return np.concatenate([a1, calm + a2])
+
+
+def run_drift_ab(args) -> int:
+    """Drifting-load A/B (the graftplan online-mode claim): one storm
+    schedule with a mid-run QPS shift (``--drift lo,hi``) driven at
+    three single-replica arms —
+
+    * ``static-calm``: the knobs the offline planner emits from a
+      window captured in the CALM phase (flush width from the request
+      shape, wait from the lo arrival rate);
+    * ``static-storm``: the planner's answer for a window captured
+      AFTER the shift (same flush-width rule — it is a function of
+      request shape, not load — wait from the hi arrival rate). The
+      point of this arm: even a perfectly timed re-plan cannot size
+      flushes for saturation from a request-size histogram;
+    * ``adaptive``: starts from the calm knobs with the graftplan
+      online tuner armed — it must detect the shift (occupancy /
+      rejects) and walk rows+wait up inside the plan envelope, whose
+      ceiling (4x the static choice) the planner emitted alongside
+      the statics.
+
+    Gate (``--ab-floor``): adaptive sustained QPS >= floor x the
+    better static arm's, at equal-or-lower p99. Every tuner move is
+    counted (``oe_plan_adjust_total``) and reported; a zero-adjustment
+    pass would be vacuous, so that also fails the gate. Appends ONE
+    ``serving`` record for the adaptive arm (its own baseline group:
+    config carries ``drift`` + ``adaptive``) when gating passes.
+    """
+    import shutil
+    import tempfile
+    from openembedding_tpu.analysis import plan as plan_lib
+    from openembedding_tpu.serving import ha
+    from tools import graftwatch
+
+    lo, hi = (float(x) for x in args.drift.split(","))
+
+    # planner knobs for a window captured in each phase — the SAME
+    # rules analysis/plan.build_plan applies (rows from the request
+    # shape, wait from the phase's arrival rate, envelope ceiling 4x
+    # rows), so the static arms are exactly what tools/graftplan
+    # would ship, not strawmen
+    # queue depth is deliberately PINNED to the library default across
+    # all three arms: an arm that sheds most of the storm gets a
+    # flattering p99 on the survivors, so varying rejection policy
+    # would confound the latency comparison — the arms must differ
+    # ONLY in the flush knobs the tuner moves
+    def planner_knobs(rate: float):
+        rows = plan_lib._pow2ceil(
+            max(64, plan_lib.ROWS_PER_FLUSH_P95 * args.batch))
+        wait = min(2000, max(50, int(round(
+            plan_lib.WAIT_INTERARRIVALS * 1e6 / max(rate, 1.0)
+            / 10.0)) * 10))
+        return rows, wait
+
+    calm_rows, calm_wait = planner_knobs(lo)
+    storm_rows, storm_wait = planner_knobs(hi)
+    ceiling = min(8192, plan_lib._pow2ceil(4 * calm_rows))
+    arms = (
+        ("static-calm", dict(batch_rows=calm_rows,
+                             batch_wait_us=calm_wait,
+                             adaptive=False)),
+        ("static-storm", dict(batch_rows=storm_rows,
+                              batch_wait_us=storm_wait,
+                              adaptive=False)),
+        ("adaptive", dict(batch_rows=calm_rows,
+                          batch_wait_us=calm_wait, adaptive=True)),
+    )
+    tmp_dir = tempfile.mkdtemp(prefix="graftload_drift_")
+    results: Dict[str, StormResult] = {}
+    adjustments: Dict[str, float] = {}
+    try:
+        model_dir = build_demo_checkpoint(os.path.join(tmp_dir, "model"))
+        head = (f"{'arm':>15}{'offered':>9}{'achieved':>10}{'calls':>7}"
+                f"{'err':>5}{'rej':>6}{'p50_ms':>9}{'p99_ms':>10}")
+        print(f"\ndrift storm: {lo:g} -> {hi:g} QPS at "
+              f"{DRIFT_CALM_FRACTION:.0%} of the window "
+              f"({args.duration:g}s total, batch {args.batch})")
+        print(head + "\n" + "-" * len(head))
+        for ai, (name, kw) in enumerate(arms):
+            env = {"OE_PLAN_ADJUST_INTERVAL_MS": "100",
+                   "OE_PLAN_ROWS_CEILING": str(ceiling)} \
+                if kw["adaptive"] else None
+            endpoints, procs, _tr = boot_demo_cluster(
+                model_dir, 1, batch_rows=kw["batch_rows"],
+                batch_wait_us=kw["batch_wait_us"],
+                adaptive=kw["adaptive"], env=env)
+            client = ha.RoutingClient(endpoints, timeout=args.timeout)
+            try:
+                send = make_rest_sender(client, DEMO_SIGN, "emb",
+                                        DEMO_VOCAB, args.batch,
+                                        seed=40 + ai)
+                arrivals = drift_arrivals(lo, hi, args.duration,
+                                          seed=700 + 10 * ai)
+                offered = arrivals.size / args.duration
+                res = run_storm(send, arrivals, route=name,
+                                offered_qps=offered,
+                                duration=args.duration,
+                                workers=args.workers)
+                results[name] = res
+                if kw["adaptive"]:
+                    adjustments = scrape_plan_adjustments(endpoints)
+                s = res.summary()
+                print(f"{name:>15}{s['offered_qps']:>9}"
+                      f"{s['achieved_qps']:>10}{s['calls']:>7}"
+                      f"{s['errors']:>5}{s['rejected']:>6}"
+                      f"{s['p50_ms']:>9}{s['p99_ms']:>10}", flush=True)
+            finally:
+                client.close()
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    p.wait()
+    finally:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    statics = {n: r for n, r in results.items() if n != "adaptive"}
+    best_name = max(statics, key=lambda n: statics[n].achieved_qps)
+    best = statics[best_name]
+    adaptive = results["adaptive"]
+    ratio = adaptive.achieved_qps / max(best.achieved_qps, 1e-9)
+    n_moves = int(sum(adjustments.values()))
+    moves = ", ".join(f"{k}: {int(v)}"
+                      for k, v in sorted(adjustments.items())) \
+        or "none"
+    print(f"\nadaptive sustained {adaptive.achieved_qps:.1f} QPS vs "
+          f"better static ({best_name}) {best.achieved_qps:.1f} QPS "
+          f"= {ratio:.2f}x (floor {args.ab_floor}x); p99 "
+          f"{adaptive.quantile_ms(0.99):.1f} ms vs "
+          f"{best.quantile_ms(0.99):.1f} ms")
+    print(f"tuner adjustments: {n_moves} ({moves})")
+    rc = 0
+    errors = sum(r.errors for r in results.values())
+    if errors:
+        print(f"graftload: {errors} request error(s) — drift overload "
+              "must degrade to 429 rejections, never failures",
+              file=sys.stderr)
+        rc = 1
+    if args.ab_floor and ratio < args.ab_floor:
+        print(f"graftload: adaptive/static ratio {ratio:.2f}x below "
+              f"the {args.ab_floor}x floor", file=sys.stderr)
+        rc = 1
+    if adaptive.quantile_ms(0.99) > best.quantile_ms(0.99):
+        print("graftload: adaptive p99 "
+              f"{adaptive.quantile_ms(0.99):.1f} ms above the better "
+              f"static arm's {best.quantile_ms(0.99):.1f} ms — the "
+              "claim is MORE throughput at equal-or-lower tail",
+              file=sys.stderr)
+        rc = 1
+    if n_moves == 0:
+        print("graftload: the online tuner made ZERO knob moves over "
+              "a 4x load shift — adaptation is not happening "
+              "(oe_plan_adjust_total stayed 0)", file=sys.stderr)
+        rc = 1
+    if args.trajectory and rc == 0:
+        config = {"source": "graftload", "drift": [lo, hi],
+                  "adaptive": True, "batch": args.batch,
+                  "workers": args.workers, "duration": args.duration,
+                  "path": "rest", "batched": True}
+        rec = graftwatch.make_serving_record(
+            routes={"rest": adaptive.summary()},
+            offered_qps=adaptive.offered_qps,
+            achieved_qps=adaptive.achieved_qps, errors=errors,
+            replicas=1, qps_band=adaptive.per_chunk_qps(),
+            rejected=adaptive.rejected, config=config)
+        # per-run measurements ride the serving section, NOT config
+        rec["serving"]["vs_static_ratio"] = round(ratio, 3)
+        rec["serving"]["best_static_arm"] = best_name
+        rec["serving"]["best_static_qps"] = round(best.achieved_qps, 1)
+        rec["serving"]["best_static_p99_ms"] = round(
+            best.quantile_ms(0.99), 3)
+        rec["serving"]["plan_adjustments"] = n_moves
+        graftwatch.append_record(args.trajectory, rec)
+        print(f"graftload: appended drift-A/B serving record to "
+              f"{args.trajectory} ({ratio:.2f}x vs {best_name})")
+    print("graftload: ok" if rc == 0 else "graftload: FAILED",
+          flush=True)
+    return rc
+
+
 # --- demo cluster ------------------------------------------------------------
 
 def build_demo_checkpoint(out_dir: str) -> str:
@@ -473,13 +691,17 @@ def build_demo_checkpoint(out_dir: str) -> str:
 def boot_demo_cluster(model_dir: str, replicas: int,
                       trace_dir: str = "", batch_rows: int = 0,
                       batch_wait_us: Optional[int] = None,
-                      batch_queue_rows: Optional[int] = None):
+                      batch_queue_rows: Optional[int] = None,
+                      adaptive: bool = False,
+                      env: Optional[Dict[str, str]] = None):
     """Spawn ``replicas`` replica daemons serving the demo checkpoint;
     returns (endpoints, procs, trace_paths). With ``trace_dir`` each
     replica records spans and exports them on graceful (SIGTERM)
     shutdown — the server-side half of the merged Perfetto story.
     ``batch_rows > 0`` arms each replica's micro-batching scheduler
-    (the --batched A/B arm)."""
+    (the --batched A/B arm); ``adaptive`` arms the graftplan online
+    tuner on top of it (``env`` can carry OE_PLAN_* envelope
+    overrides)."""
     import socket
     from openembedding_tpu.serving import ha
 
@@ -495,7 +717,8 @@ def boot_demo_cluster(model_dir: str, replicas: int,
     procs = [ha.spawn_replica(p, load=[f"{DEMO_SIGN}={model_dir}"],
                               trace_out=tr, batch_rows=batch_rows,
                               batch_wait_us=batch_wait_us,
-                              batch_queue_rows=batch_queue_rows)
+                              batch_queue_rows=batch_queue_rows,
+                              adaptive=adaptive, env=env)
              for p, tr in zip(ports, traces)]
     for ep, proc in zip(eps, procs):
         if not ha.wait_ready(ep, sign=DEMO_SIGN):
@@ -547,6 +770,17 @@ def main(argv=None) -> int:
                     help="boot a --replicas local cluster on a tiny "
                          "generated checkpoint, storm it, tear it down")
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--drift", default="",
+                    help="LO,HI QPS: run the drifting-load A/B (load "
+                         "shifts LO->HI at half-window) over "
+                         "static-calm / static-default / adaptive "
+                         "arms and gate the adaptive arm's sustained "
+                         "QPS against the better static (graftplan "
+                         "online mode)")
+    ap.add_argument("--ab-floor", type=float, default=1.15,
+                    help="drift A/B gate: adaptive sustained QPS must "
+                         "be >= this x the better static arm's "
+                         "(0 disables)")
     ap.add_argument("--replica-sweep", default="",
                     help="comma-separated replica counts (e.g. 1,3): "
                          "boot a fresh demo cluster per count, drive it "
@@ -629,6 +863,8 @@ def main(argv=None) -> int:
     if args.batch_queue_rows is None:
         args.batch_queue_rows = envconfig.DEFAULT_BATCH_QUEUE_ROWS
 
+    if args.drift:
+        return run_drift_ab(args)
     if args.replica_sweep:
         return run_replica_sweep(args)
 

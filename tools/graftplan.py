@@ -1,0 +1,114 @@
+"""graftplan CLI: observed-stats planner — stats window -> EnvConfig.
+
+    python -m tools.graftplan --stats window.json \
+        [--trajectory BENCH_trajectory.jsonl] [--out plan_env.json] \
+        [--rationale plan_rationale.txt] [--no-compressed]
+
+Reads a stats window captured by ``tools/graftscope --export-stats``
+(per-table pull uniqueness/skew gauges, the serving_lookup_rows
+histogram, cache hit counters, ingest stall accounting), calibrates
+the per-byte/per-launch hardware constants from fingerprint-matched
+``tools/graftwatch`` trajectory records, and emits:
+
+* a VALIDATED EnvConfig JSON (round-tripped through
+  ``EnvConfig.load`` before writing — a plan that does not parse as a
+  config is a bug, not an artifact), byte-identical for identical
+  inputs;
+* a per-decision rationale table (chosen plane with the full score
+  table, cache K, serving batcher knobs, the adaptive envelope, the
+  ingest reader width) on stdout and optionally ``--rationale``.
+
+Pure offline arithmetic — no mesh, no jax, no clock. Exit 0 on a
+written plan, 1 on an invalid window or a round-trip mismatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="observed-stats planner: window -> EnvConfig")
+    ap.add_argument("--stats", required=True,
+                    help="stats window JSON (tools/graftscope "
+                         "--export-stats)")
+    ap.add_argument("--trajectory", default="",
+                    help="graftwatch trajectory jsonl for hardware "
+                         "calibration (fingerprint-matched records "
+                         "only; optional)")
+    ap.add_argument("--out", default="plan_env.json",
+                    help="EnvConfig JSON to write (default "
+                         "plan_env.json)")
+    ap.add_argument("--rationale", default="",
+                    help="also write the rationale table here")
+    ap.add_argument("--base", default="",
+                    help="EnvConfig JSON to start from (default: "
+                         "library defaults)")
+    ap.add_argument("--no-compressed", action="store_true",
+                    help="keep the bf16/int8 rungs out of plane "
+                         "selection (workloads that cannot take the "
+                         "precision hit)")
+    args = ap.parse_args(argv)
+
+    from openembedding_tpu.analysis import plan as plan_lib
+    from openembedding_tpu.utils import envconfig
+
+    try:
+        window = plan_lib.load_window(args.stats)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"graftplan: {e}", file=sys.stderr)
+        return 1
+
+    records = plan_lib.load_trajectory(args.trajectory) \
+        if args.trajectory else []
+
+    base = None
+    if args.base:
+        try:
+            with open(args.base, "r", encoding="utf-8") as f:
+                base = envconfig.EnvConfig.load(config=json.load(f),
+                                                env={})
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftplan: --base {args.base}: {e}",
+                  file=sys.stderr)
+            return 1
+
+    try:
+        plan = plan_lib.build_plan(
+            window, records, base=base,
+            allow_compressed=not args.no_compressed)
+    except ValueError as e:
+        print(f"graftplan: {e}", file=sys.stderr)
+        return 1
+
+    text = plan_lib.render_config(plan.config)
+    # the plan must round-trip through the config loader it claims to
+    # feed — validated BEFORE the artifact exists
+    reloaded = envconfig.EnvConfig.load(config=json.loads(text), env={})
+    if reloaded != plan.config:
+        print("graftplan: emitted config does not round-trip through "
+              "EnvConfig.load — refusing to write", file=sys.stderr)
+        return 1
+
+    rationale = plan_lib.format_rationale(plan)
+    print(rationale)
+    with open(args.out, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(f"graftplan: wrote {args.out} "
+          f"({len(plan.decisions)} decisions, calibration "
+          f"{plan.calibration.source})")
+    if args.rationale:
+        with open(args.rationale, "w", encoding="utf-8") as f:
+            f.write(rationale)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
@@ -57,6 +58,11 @@ def main(argv=None) -> int:
                     help="Chrome-trace/Perfetto JSON output path")
     ap.add_argument("--skip-train", action="store_true",
                     help="skip the traced Trainer run (ledger only)")
+    ap.add_argument("--export-stats", default="",
+                    help="also dump the capture's observed-stats window "
+                         "(per-table pull uniqueness/skew, serving "
+                         "lookup sizes, cache + ingest counters) as "
+                         "JSON in the tools/graftplan input schema")
     args = ap.parse_args(argv)
     data, model = (int(x) for x in args.mesh.split("x"))
 
@@ -133,6 +139,7 @@ def main(argv=None) -> int:
         worlds[plane] = (coll, states)
     scope.HISTOGRAMS.reset()     # drop compile-inclusive warmup samples
     scope.reset()
+    window_t0 = time.perf_counter()   # stats window starts post-warmup
 
     for plane in planes:
         coll, states = worlds[plane]
@@ -163,6 +170,7 @@ def main(argv=None) -> int:
 
 
     # --- 3. traced train-step run on --plane -------------------------------
+    table_dims = {}
     if not args.skip_train:
         import optax
         from openembedding_tpu.embedding import EmbeddingCollection
@@ -172,6 +180,7 @@ def main(argv=None) -> int:
         vocab, dim, batch = 4096, 8, 256
         specs = deepctr.make_feature_specs(features, vocab, dim,
                                            plane=args.plane)
+        table_dims = {s.name: s.output_dim for s in specs}
         coll = EmbeddingCollection(
             specs, mesh,
             default_optimizer={"category": "adagrad",
@@ -223,6 +232,28 @@ def main(argv=None) -> int:
                   f"{scope.HISTOGRAMS.quantile(name, 0.5, **labels):.4g}"
                   f" / "
                   f"{scope.HISTOGRAMS.quantile(name, 0.95, **labels):.4g}")
+
+    # --- observed-stats window export (tools/graftplan input) --------------
+    if args.export_stats:
+        from tools.graftwatch import device_fingerprint
+        from openembedding_tpu.analysis import plan as plan_lib
+        fp, device = device_fingerprint()
+        window = plan_lib.collect_window(
+            window_s=time.perf_counter() - window_t0,
+            fingerprint=fp, device=device, table_dims=table_dims)
+        problems = plan_lib.validate_window(window)
+        if problems:
+            failures += 1
+            print("FAIL stats window does not validate against its own "
+                  "schema: " + "; ".join(problems), file=sys.stderr)
+        else:
+            with open(args.export_stats, "w", encoding="utf-8") as f:
+                json.dump(window, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"wrote {args.export_stats}: stats window "
+                  f"({len(window['tables'])} tables, "
+                  f"{window['serving']['lookup_rows']['count']} serving "
+                  f"lookups, fingerprint {fp})")
 
     # --- trace export + validation -----------------------------------------
     scope.export_chrome_trace(args.out)
