@@ -51,7 +51,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..analysis import scope
-from ..analysis.concurrency import sync_point
+from ..analysis.concurrency import make_lock, sync_point
 from ..utils import observability
 # sizing defaults live in envconfig (ONE home for the batcher knobs —
 # graftload and the ServingConfig defaults import the same values):
@@ -455,6 +455,10 @@ class AdaptiveBatchTuner:
         self._up = float(up_occupancy)
         self._down = float(down_occupancy)
         self._static = batcher.knobs()      # restored by the kill switch
+        # guards the sampler state below: the interval thread and a
+        # test (or operator) driving sample() directly must not
+        # interleave one observation->decision round with another
+        self._lock = make_lock(f"serving.plan.{batcher.name}")
         self._last = batcher.stats()
         self._streak = 0                    # signed run of same-direction samples
         self._adjustments = 0
@@ -489,18 +493,19 @@ class AdaptiveBatchTuner:
         """One observation->decision round (the thread calls this every
         interval; tests drive it directly for determinism). Returns the
         number of knob steps applied (0 or 1)."""
-        s = self._b.stats()
-        knobs = self._b.knobs()
-        d = self._direction(s, knobs)
-        self._last = s
-        if d == 0 or (self._streak and (d > 0) != (self._streak > 0)):
-            self._streak = d        # deadband or direction flip: restart
-            return 0
-        self._streak += d
-        if abs(self._streak) < self._plan.hysteresis:
-            return 0
-        self._streak = 0
-        return self._apply(knobs, up=d > 0)
+        with self._lock:
+            s = self._b.stats()
+            knobs = self._b.knobs()
+            d = self._direction(s, knobs)
+            self._last = s
+            if d == 0 or (self._streak and (d > 0) != (self._streak > 0)):
+                self._streak = d    # deadband or direction flip: restart
+                return 0
+            self._streak += d
+            if abs(self._streak) < self._plan.hysteresis:
+                return 0
+            self._streak = 0
+            return self._apply(knobs, up=d > 0)
 
     def _apply(self, knobs: Dict[str, int], *, up: bool) -> int:
         p, f = self._plan, self._plan.step_factor
@@ -530,7 +535,8 @@ class AdaptiveBatchTuner:
     # -- lifecycle ----------------------------------------------------------
     @property
     def adjustments(self) -> int:
-        return self._adjustments
+        with self._lock:
+            return self._adjustments
 
     def stop(self, restore: bool = True, timeout: float = 10.0) -> None:
         """Kill switch: join the sampler; ``restore`` re-applies the

@@ -1,91 +1,187 @@
 """Seeded graftproto mutation models: every one must model-check to
-exactly one (minimal) counterexample, with the expected invariant named.
+exactly one (minimal) counterexample, with the expected property named.
 
 Mirror of the graftlint/graftrace seeded-violation fixtures, one level
 up: where those plant violating *source*, this plants violating
 *protocols* — each mutation is a shipped protocol minus one load-bearing
 line (the seq gate, the payload-before-manifest order, the claim
-restore, the one-lock commit), built by passing the matching flag to the
-shipped model builder in ``openembedding_tpu/analysis/protomodel.py``.
-``tests/test_graftproto.py`` asserts each fires its expected invariant
+restore, the one-lock commit, the verify-all-acks barrier, the fence
+before a shard grant, the copy-then-release order), built by passing
+the matching flag to the model builder in
+``openembedding_tpu/analysis/protomodel.py``.
+``tests/test_graftproto.py`` asserts each fires its expected property
 and that every UNMUTATED shipped model checks clean;
 ``tests/test_graftproto_replay.py`` replays the exported counterexample
 schedules against the real implementation.
 
 Entries are pure data so ``tools/graftproto.py --mutations`` can load
-this file standalone (no package / jax import):
+this file standalone (no package / jax import). Schema (all fields
+REQUIRED except ``kind``, which defaults to ``"invariant"`` —
+``iter_mutations`` REJECTS an entry missing ``expected_invariant`` or
+any other field, so a new mutation cannot land without pinning what it
+must fire):
 
-    (name, builder, kwargs, expected_invariant, what the mutation drops)
+    {"name": ...,            # unique fixture id
+     "builder": ...,         # protomodel builder function name
+     "kwargs": {...},        # the one dropped-line flag
+     "expected_invariant": ..., # invariant (or obligation) that fires
+     "kind": "invariant" | "liveness",  # which checker catches it
+     "why": ...}             # what the mutation drops, in prose
 
 ``full_save_resets_seq`` and ``compact_zero_version`` are the PRE-FIX
-shipped behaviors this PR's modeling uncovered and fixed — kept as
-mutations so the checker guards the fixes forever.
+shipped behaviors PR 11's modeling uncovered and fixed — kept as
+mutations so the checker guards the fixes forever. The ``kind:
+liveness`` entries counterexample through ``check_liveness`` (the
+bounded ``Obligation`` lane) rather than the safety BFS.
 """
 
 MUTATIONS = [
-    ("drop_seq_gate", "hot_swap", {"seq_gate": False},
-     "version_covers_exactly_applied_deltas",
-     "apply_delta without the gap refusal: a reordered delta applies "
-     "over a hole and the skipped delta's rows are silently lost"),
-    ("inplace_publish", "hot_swap", {"atomic_publish": False},
-     "reader_sees_one_version",
-     "patching the served states in place instead of building "
-     "functionally and publishing one reference: a concurrent lookup "
-     "snapshots a half-patched model"),
-    ("skip_claim_restore", "dirty_tracker", {"restore_on_failure": False},
-     "no_dirty_chunk_lost_to_completed_chain",
-     "a failed delta writer that drops its claim instead of restoring "
-     "it: the claimed chunks' changes vanish from bitmap and chain"),
-    ("manifest_before_payload", "delta_chain",
-     {"commit_order": "manifest_first"},
-     "no_silent_commit_loss",
-     "committing the manifest before the payload file: a crash in "
-     "between leaves a committed entry with no bytes, which a load "
-     "silently drops as if it were a torn tail"),
-    ("full_save_resets_seq", "delta_chain", {"carry_seq_on_full": False},
-     "seqs_never_reused",
-     "re-arming a full save at last_seq=0: the next delta reuses a "
-     "burned seq, serving replicas ack it as stale and stop updating "
-     "(pre-fix shipped behavior)"),
-    ("compact_zero_version", "delta_chain",
-     {"compact_content_seq": False},
-     "load_version_matches_content",
-     "compacting without recording the folded content version: "
-     "applied_seq reports 0, every later delta push is refused as a "
-     "gap (pre-fix shipped behavior)"),
-    ("resume_cursor_from_zero", "delta_chain", {"resume_cursor": "zero"},
-     "trainer_neither_reapplies_nor_skips_rows",
-     "a resumed trainer that restores the checkpoint state but re-reads "
-     "the stream from position zero: batches already folded into the "
-     "committed checkpoint are applied a second time (the naive-restart "
-     "behavior ShardStream.skip_batches exists to prevent)"),
-    ("resume_cursor_skips_a_step", "delta_chain",
-     {"resume_cursor": "skip"},
-     "trainer_neither_reapplies_nor_skips_rows",
-     "a resume that seeks the stream one batch past the committed "
-     "cursor: the skipped batch's rows are in no checkpoint and no "
-     "replay — silently lost from the trained model"),
-    ("normal_before_install", "ha_registry", {"atomic_commit": False},
-     "normal_status_implies_model_installed",
-     "publishing status=NORMAL before installing the model object: "
-     "find_model hands a lookup a missing model inside the window"),
-    ("resnapshot_per_pull", "serving_batcher",
-     {"snapshot_per_flush": False},
-     "batch_serves_one_version",
-     "re-reading the live model reference at every per-variable pull "
-     "instead of snapshotting once per flush: a hot-swap landing "
-     "between two groups' pulls answers one batch from two versions"),
-    ("drop_queue_on_shutdown", "serving_batcher",
-     {"drain_on_shutdown": False},
-     "no_request_lost_at_shutdown",
-     "shutdown discarding the accepted queue instead of draining it: "
-     "enqueued requests never get their response and hang forever"),
+    {"name": "drop_seq_gate", "builder": "hot_swap",
+     "kwargs": {"seq_gate": False},
+     "expected_invariant": "version_covers_exactly_applied_deltas",
+     "why": "apply_delta without the gap refusal: a reordered delta "
+            "applies over a hole and the skipped delta's rows are "
+            "silently lost"},
+    {"name": "inplace_publish", "builder": "hot_swap",
+     "kwargs": {"atomic_publish": False},
+     "expected_invariant": "reader_sees_one_version",
+     "why": "patching the served states in place instead of building "
+            "functionally and publishing one reference: a concurrent "
+            "lookup snapshots a half-patched model"},
+    {"name": "skip_claim_restore", "builder": "dirty_tracker",
+     "kwargs": {"restore_on_failure": False},
+     "expected_invariant": "no_dirty_chunk_lost_to_completed_chain",
+     "why": "a failed delta writer that drops its claim instead of "
+            "restoring it: the claimed chunks' changes vanish from "
+            "bitmap and chain"},
+    {"name": "manifest_before_payload", "builder": "delta_chain",
+     "kwargs": {"commit_order": "manifest_first"},
+     "expected_invariant": "no_silent_commit_loss",
+     "why": "committing the manifest before the payload file: a crash "
+            "in between leaves a committed entry with no bytes, which "
+            "a load silently drops as if it were a torn tail"},
+    {"name": "full_save_resets_seq", "builder": "delta_chain",
+     "kwargs": {"carry_seq_on_full": False},
+     "expected_invariant": "seqs_never_reused",
+     "why": "re-arming a full save at last_seq=0: the next delta "
+            "reuses a burned seq, serving replicas ack it as stale and "
+            "stop updating (pre-fix shipped behavior)"},
+    {"name": "compact_zero_version", "builder": "delta_chain",
+     "kwargs": {"compact_content_seq": False},
+     "expected_invariant": "load_version_matches_content",
+     "why": "compacting without recording the folded content version: "
+            "applied_seq reports 0, every later delta push is refused "
+            "as a gap (pre-fix shipped behavior)"},
+    {"name": "resume_cursor_from_zero", "builder": "delta_chain",
+     "kwargs": {"resume_cursor": "zero"},
+     "expected_invariant": "trainer_neither_reapplies_nor_skips_rows",
+     "why": "a resumed trainer that restores the checkpoint state but "
+            "re-reads the stream from position zero: batches already "
+            "folded into the committed checkpoint are applied a second "
+            "time (the naive-restart behavior ShardStream.skip_batches "
+            "exists to prevent)"},
+    {"name": "resume_cursor_skips_a_step", "builder": "delta_chain",
+     "kwargs": {"resume_cursor": "skip"},
+     "expected_invariant": "trainer_neither_reapplies_nor_skips_rows",
+     "why": "a resume that seeks the stream one batch past the "
+            "committed cursor: the skipped batch's rows are in no "
+            "checkpoint and no replay — silently lost from the trained "
+            "model"},
+    {"name": "normal_before_install", "builder": "ha_registry",
+     "kwargs": {"atomic_commit": False},
+     "expected_invariant": "normal_status_implies_model_installed",
+     "why": "publishing status=NORMAL before installing the model "
+            "object: find_model hands a lookup a missing model inside "
+            "the window"},
+    {"name": "resnapshot_per_pull", "builder": "serving_batcher",
+     "kwargs": {"snapshot_per_flush": False},
+     "expected_invariant": "batch_serves_one_version",
+     "why": "re-reading the live model reference at every per-variable "
+            "pull instead of snapshotting once per flush: a hot-swap "
+            "landing between two groups' pulls answers one batch from "
+            "two versions"},
+    {"name": "drop_queue_on_shutdown", "builder": "serving_batcher",
+     "kwargs": {"drain_on_shutdown": False},
+     "expected_invariant": "no_request_lost_at_shutdown",
+     "why": "shutdown discarding the accepted queue instead of "
+            "draining it: enqueued requests never get their response "
+            "and hang forever"},
+    # --- multi-host models (ROADMAP item 3, models-first) ---------------
+    {"name": "commit_on_partial", "builder": "multihost_delta",
+     "kwargs": {"verify_all": False},
+     "expected_invariant": "no_torn_cross_host_publish",
+     "why": "the coordinator commits the manifest on a quorum of "
+            "hosts-1 acks (the one-straggler shortcut): the missing "
+            "host's shard payload is torn out of the published "
+            "cross-host version"},
+    {"name": "ack_before_write", "builder": "multihost_delta",
+     "kwargs": {"durable_ack": False},
+     "expected_invariant": "no_torn_cross_host_publish",
+     "why": "a host acks the round before its payload is durable "
+            "(ack races the fsync): the coordinator counts an ack "
+            "whose bytes never land and publishes a torn version"},
+    {"name": "assign_without_release", "builder": "training_membership",
+     "kwargs": {"fenced_reassign": False},
+     "expected_invariant": "shard_never_trained_by_two_live_workers",
+     "why": "granting a suspect's shard on mere suspicion without the "
+            "confirmed-dead fence or the release: a falsely suspected "
+            "live worker and the grantee both train the shard"},
+    {"name": "no_failure_detect", "builder": "training_membership",
+     "kwargs": {"failure_detect": False},
+     "expected_invariant": "every_shard_regains_a_live_owner",
+     "kind": "liveness",
+     "why": "dropping the failure detector: a dead worker's shards are "
+            "never granted to a live one — the run ends with orphaned "
+            "shards (the bounded-liveness obligation fires, not a "
+            "safety invariant)"},
+    {"name": "release_before_apply", "builder": "reshard",
+     "kwargs": {"apply_before_release": False},
+     "expected_invariant": "no_row_lost",
+     "why": "releasing the source copy before the destination "
+            "persisted the row: a destination crash in the window "
+            "leaves the row in NO host"},
+    {"name": "double_apply", "builder": "reshard",
+     "kwargs": {"idempotent_apply": False},
+     "expected_invariant": "no_row_double_applied",
+     "why": "crash recovery re-folds an already-applied row into the "
+            "destination (no idempotence check): optimizer state for "
+            "the row is applied twice"},
 ]
+
+_REQUIRED = ("name", "builder", "kwargs", "expected_invariant", "why")
+_KINDS = ("invariant", "liveness")
+
+
+def iter_mutations():
+    """Validated view of ``MUTATIONS``: every entry must carry every
+    required field (non-empty) — in particular an explicit
+    ``expected_invariant`` — and a known ``kind``. Raises ``ValueError``
+    on the first malformed entry, so a mutation can't land without
+    pinning exactly what it must fire."""
+    seen = set()
+    for e in MUTATIONS:
+        if not isinstance(e, dict):
+            raise ValueError(f"mutation entry is not a dict: {e!r}")
+        for f in _REQUIRED:
+            if not e.get(f) and e.get(f) != {}:
+                raise ValueError(
+                    f"mutation {e.get('name', e)!r}: missing required "
+                    f"field {f!r} (every seeded mutation must declare "
+                    f"the property it fires)")
+        kind = e.get("kind", "invariant")
+        if kind not in _KINDS:
+            raise ValueError(
+                f"mutation {e['name']!r}: unknown kind {kind!r} "
+                f"(must be one of {_KINDS})")
+        if e["name"] in seen:
+            raise ValueError(f"duplicate mutation name {e['name']!r}")
+        seen.add(e["name"])
+        yield {**e, "kind": kind}
 
 
 def build(protomodel, name):
     """Construct one mutated model by fixture name."""
-    for n, builder, kwargs, _inv, _why in MUTATIONS:
-        if n == name:
-            return getattr(protomodel, builder)(**kwargs)
+    for e in iter_mutations():
+        if e["name"] == name:
+            return getattr(protomodel, e["builder"])(**e["kwargs"])
     raise KeyError(name)

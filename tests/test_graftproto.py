@@ -1,6 +1,8 @@
-"""graftproto static plane: checker semantics, the four shipped models
-exhaustively clean, every seeded mutation model counterexamples with the
-expected invariant, the CLI exit codes, and the model<->code sync-point
+"""graftproto static plane: checker semantics (BFS + the v2 reductions
+and bounded liveness), the eight shipped models exhaustively clean with
+reduction-soundness cross-checks, every seeded mutation model
+counterexampling with the expected property, the POR-unsoundness
+negative test, the CLI exit codes, and the model<->code sync-point
 bridge.
 
 The executable half of the bridge — counterexample schedules replayed
@@ -82,9 +84,14 @@ def test_deadlock_detected_and_accepting_states_are_not():
 
 
 def test_state_dedup_and_exhaustive_count():
-    # product space is exactly 4 x 11 states
+    # product space is exactly 4 x 11 states, all stored unreduced; the
+    # counter model declares no footprints or symmetry, so the only
+    # reduction that engages is forced-sequence fusion (the tail where
+    # just one action stays enabled stores endpoints only)
+    full = pm.check(_counter_model(), reduce=False)
+    assert full.ok and full.explored == 44
     res = pm.check(_counter_model())
-    assert res.ok and res.explored == 44
+    assert res.ok and res.explored <= 44 and res.stats["fused"] > 0
 
 
 def test_nondet_branches_and_state_budget():
@@ -116,11 +123,186 @@ def test_freeze_rejects_unhashable_state_values():
         pm.make_model("bad", {"x": [1, 2]}, [], [], lambda s: True)
 
 
+# --- v2 reductions: symmetry, ample sets, collapse ---------------------------
+
+def _sym_pair_model(*, declare=True):
+    """Two interchangeable workers racing to grab one token: the full
+    graph distinguishes who holds it, the symmetric quotient does not."""
+    def grab(w):
+        def guard(s):
+            return s["holder"] == "" and s[f"{w}_pc"] == "idle"
+
+        def apply(s, w=w):
+            s["holder"] = w
+            s[f"{w}_pc"] = "got"
+        return pm.Action(f"{w}_grab", w, guard, apply)
+
+    return pm.make_model(
+        "sym_pair", {"holder": "", "w0_pc": "idle", "w1_pc": "idle"},
+        [grab("w0"), grab("w1")],
+        [("one_holder", lambda s: True)], lambda s: s["holder"] != "",
+        symmetry=(("w0", "w1"),) if declare else ())
+
+
+def test_symmetry_reduction_merges_interchangeable_identities():
+    red = pm.check(_sym_pair_model())
+    full = pm.check(_sym_pair_model(), reduce=False)
+    assert red.ok and full.ok
+    # w0-holds and w1-holds canonicalize to one state
+    assert red.explored < full.explored
+    assert red.stats["sym"] > 0
+
+
+@pytest.mark.parametrize(
+    "model", [m for m in pm.shipped_models() if m.symmetry],
+    ids=lambda m: m.name)
+def test_symmetry_declaring_models_check_at_strictly_fewer_states(model):
+    """The tentpole soundness harness: every symmetry-declaring shipped
+    model re-checks both ways with identical verdicts (cross_check
+    asserts that internally) at STRICTLY fewer states."""
+    xc = pm.cross_check(model)
+    assert xc["reduced"].explored < xc["full"].explored, model.name
+    assert xc["reduced"].stats["sym"] > 0, model.name
+
+
+def _por_trap_model():
+    """The seeded POR-unsoundness trap: ``advance`` moves the pc key
+    ``x`` that ``poison``'s guard reads, so expanding only ``advance``
+    disables ``poison`` forever and hides the only violation. The
+    sound ample rule must refuse the singleton {advance}; a naive rule
+    that skips the dependence closure takes it and reports clean."""
+    def adv_apply(s):
+        s["x"] = "hi"
+
+    def poison_apply(s):
+        s["bad"] = True
+
+    acts = [
+        pm.Action("advance", "a", lambda s: s["x"] == "lo", adv_apply,
+                  pc=(("x", "lo"),), greads=(), reads=(), writes=("x",)),
+        pm.Action("poison", "b", lambda s: s["x"] == "lo", poison_apply,
+                  pc=(("x", "lo"),), greads=(), reads=(),
+                  writes=("bad",)),
+    ]
+    return pm.make_model(
+        "por_trap", {"x": "lo", "bad": False}, acts,
+        [("never_bad", lambda s: not s["bad"])],
+        lambda s: s["x"] == "hi", inv_reads=("bad",))
+
+
+def test_por_sound_rule_refuses_the_hiding_reduction():
+    res = pm.check(_por_trap_model())
+    assert not res.ok
+    assert res.counterexample.invariant == "never_bad"
+
+
+def test_por_naive_rule_would_hide_the_counterexample(monkeypatch):
+    """Negative test for the ample-set dependence closure: with the
+    closure skipped, the reduction is UNSOUND — the checker declares
+    the trap model clean. This pins that the closure, not luck, is
+    what keeps the reduced verdicts honest."""
+    monkeypatch.setattr(pm, "_AMPLE_SKIP_DEPENDENCE", True)
+    naive = pm.check(_por_trap_model())
+    assert naive.ok and naive.complete     # the seeded bug is HIDDEN
+    monkeypatch.setattr(pm, "_AMPLE_SKIP_DEPENDENCE", False)
+    assert not pm.check(_por_trap_model()).ok
+
+
+def test_collapse_declaration_validated_statically():
+    # an invariant reads the collapsed key: the declaration is unsound
+    # and check() must refuse to run with it
+    def push(s):
+        s["box"] = ("full", s["n"])
+        s["n"] += 1
+
+    m = pm.make_model(
+        "bad_collapse", {"box": ("empty",), "n": 0},
+        [pm.Action("push", "p", lambda s: s["n"] < 2, push,
+                   greads=("n",), reads=("n",), writes=("box", "n"))],
+        [("payload_small", lambda s: len(s["box"]) < 9)],
+        lambda s: True,
+        inv_reads=("box",), collapse=(("box", "full"),))
+    with pytest.raises(ValueError, match="collapse"):
+        pm.check(m)
+    # the same model unreduced ignores collapse and checks fine
+    assert pm.check(m, reduce=False).ok
+
+
+# --- bounded liveness --------------------------------------------------------
+
+def _liveness_model(*, within=5, loop=False, give_up=False):
+    """Counter to 3 with optional postponement knobs: ``loop`` adds a
+    pred-avoiding cycle (lasso), ``give_up`` adds an early accepting
+    exit (the run just ends)."""
+    acts = [pm.Action("inc", "p",
+                      lambda s: s["n"] < 3 and not s["q"],
+                      lambda s: s.__setitem__("n", s["n"] + 1))]
+    if loop:
+        acts.append(pm.Action("spin", "q", lambda s: True,
+                              lambda s: s.__setitem__(
+                                  "t", (s["t"] + 1) % 2)))
+    if give_up:
+        acts.append(pm.Action("quit", "q", lambda s: not s["q"],
+                              lambda s: s.__setitem__("q", True)))
+    return pm.make_model(
+        "live", {"n": 0, "t": 0, "q": False}, acts, [],
+        lambda s: s["n"] == 3 or s.get("q"),
+        obligations=(pm.Obligation("reaches_three",
+                                   lambda s: s["n"] == 3, within),))
+
+
+def test_liveness_clean_pass():
+    res = pm.check_liveness(_liveness_model())
+    assert res.ok and res.complete
+
+
+def test_liveness_bound_counterexample():
+    # 3 inc steps needed, bound of 2: a within-step avoiding path
+    res = pm.check_liveness(_liveness_model(within=2))
+    assert not res.ok
+    cex = res.counterexample
+    assert cex.kind == "liveness" and cex.invariant == "reaches_three"
+    assert res.stats["liveness"] == "bound"
+
+
+def test_liveness_lasso_counterexample():
+    # the spin cycle postpones the eventuality forever
+    res = pm.check_liveness(_liveness_model(loop=True))
+    assert not res.ok
+    assert res.counterexample.kind == "liveness"
+    assert res.stats["liveness"] == "lasso"
+
+
+def test_liveness_run_ends_counterexample():
+    # quit is accepting but n never reaches 3 on that run
+    res = pm.check_liveness(_liveness_model(give_up=True))
+    assert not res.ok
+    assert res.stats["liveness"] == "run ends"
+    assert "(run ends)" in res.counterexample.trace[-1][0]
+
+
+def test_liveness_trigger_gated_by_after():
+    # with after= never true, there is no trigger and nothing to prove
+    m = _liveness_model(loop=True)
+    gated = pm.Obligation("reaches_three", lambda s: s["n"] == 3, 5,
+                          after=lambda s: False)
+    m = pm.make_model("live", dict(m.init), m.actions, [], m.is_done,
+                      obligations=(gated,))
+    assert pm.check_liveness(m).ok
+
+
 # --- shipped models ----------------------------------------------------------
 
-SHIPPED_MIN_STATES = {"delta_chain": 100_000, "hot_swap": 40,
-                      "dirty_tracker": 100, "ha_registry": 200,
-                      "serving_batcher": 2_000}
+# REDUCED exhaustive floors (~10% under current counts): a guard
+# refactor that silently hollows out the reachable space must fail
+SHIPPED_MIN_STATES = {"delta_chain": 58_000, "hot_swap": 120,
+                      "dirty_tracker": 70, "ha_registry": 210,
+                      "serving_batcher": 3_000, "multihost_delta": 140,
+                      "training_membership": 160, "reshard": 60}
+
+# PR 11's plain-BFS delta_chain count — the baseline the v2 engine's
+# >=1.5x reduction acceptance criterion is measured against
+PR11_DELTA_CHAIN_STATES = 141_649
 
 
 @pytest.mark.parametrize("model", pm.shipped_models(),
@@ -135,11 +317,68 @@ def test_shipped_model_checks_clean_and_exhaustively(model):
 
 @pytest.mark.parametrize("model", pm.shipped_models(),
                          ids=lambda m: m.name)
+def test_shipped_model_footprints_audit_clean(model):
+    """Every declared guard/apply/invariant footprint must cover what
+    the code actually reads and writes — the POR soundness input."""
+    assert pm.audit_footprints(model) == []
+
+
+@pytest.mark.parametrize("model",
+                         [m for m in pm.shipped_models()
+                          if m.name != "delta_chain"],
+                         ids=lambda m: m.name)
+def test_reduction_verdicts_identical_to_full_expansion(model):
+    """cross_check asserts reduced/full verdict equality internally and
+    that reduction never expands the graph."""
+    xc = pm.cross_check(model)
+    assert xc["ratio"] >= 1.0
+
+
+def test_delta_chain_reduction_beats_pr11_baseline():
+    """The acceptance criterion: >=1.5x state reduction on delta_chain
+    vs the plain-BFS shipped baseline (the v2 engine's footprint-driven
+    payload hygiene + quiescent collapse + ample fusion land 2.1x+;
+    same-model reduced-vs-full is ~1.4x on top of the collapsed
+    encoding, cross-checked weekly in CI)."""
+    xc = pm.cross_check(pm.delta_chain())
+    red = xc["reduced"].explored
+    assert red * 3 <= PR11_DELTA_CHAIN_STATES * 2, red   # >= 1.5x
+    assert xc["ratio"] > 1.0
+
+
+@pytest.mark.parametrize("model",
+                         [m for m in pm.shipped_models()
+                          if m.obligations],
+                         ids=lambda m: m.name)
+def test_shipped_model_obligations_hold(model):
+    res = pm.check_liveness(model)
+    assert res.ok and res.complete, pm.format_result(res, model)
+
+
+def test_all_three_multihost_models_shipped_with_obligations():
+    byname = {m.name: m for m in pm.shipped_models()}
+    for name in ("multihost_delta", "training_membership", "reshard"):
+        assert name in byname
+        assert byname[name].obligations, name
+
+
+@pytest.mark.parametrize("model", pm.shipped_models(),
+                         ids=lambda m: m.name)
 def test_model_sync_points_exist_in_package_source(model):
     """The fidelity tripwire: every sync point a model action claims to
-    correspond to must still be emitted by the package source."""
+    correspond to must still be emitted by the package source (or be an
+    explicitly reserved design-only point for the multi-host models)."""
     assert pm.missing_sync_points(model) == []
     assert pm.model_sync_points(model)     # and the bridge is non-empty
+
+
+def test_reserved_sync_points_are_design_only():
+    # reserved names must NOT leak into the package source unnoticed:
+    # once implemented, the reservation must be retired
+    byname = {m.name: m for m in pm.shipped_models()}
+    assert pm.reserved_sync_points(byname["multihost_delta"])
+    assert pm.reserved_sync_points(byname["reshard"])
+    assert pm.reserved_sync_points(byname["delta_chain"]) == []
 
 
 def test_sample_traces_are_replayable_schedules():
@@ -154,24 +393,53 @@ def test_sample_traces_are_replayable_schedules():
 
 # --- seeded mutations --------------------------------------------------------
 
-def test_every_seeded_mutation_fires_its_invariant():
+def test_every_seeded_mutation_fires_its_expected_property():
     fixture = _load_fixture()
-    names = [m[0] for m in fixture.MUTATIONS]
-    assert len(names) == len(set(names))
-    # every shipped protocol has at least one seeded mutation
-    assert {m[1] for m in fixture.MUTATIONS} == \
-        {m.name for m in pm.shipped_models()}
-    for name, builder, kwargs, expect_inv, _why in fixture.MUTATIONS:
-        model = getattr(pm, builder)(**kwargs)
-        res = pm.check(model)
+    muts = list(fixture.iter_mutations())
+    # every shipped protocol has at least one seeded mutation, and each
+    # multi-host model at least two
+    builders = [m["builder"] for m in muts]
+    assert set(builders) == {m.name for m in pm.shipped_models()}
+    for name in ("multihost_delta", "training_membership", "reshard"):
+        assert builders.count(name) >= 2, name
+    for mut in muts:
+        model = getattr(pm, mut["builder"])(**mut["kwargs"])
+        if mut["kind"] == "liveness":
+            res = pm.check_liveness(model)
+            want_kind = "liveness"
+        else:
+            res = pm.check(model)
+            want_kind = "invariant"
         cex = res.counterexample
-        assert cex is not None and cex.kind == "invariant", \
-            f"mutation {name} produced no counterexample"
-        assert cex.invariant == expect_inv, \
-            f"mutation {name}: fired {cex.invariant!r}"
+        assert cex is not None and cex.kind == want_kind, \
+            f"mutation {mut['name']} produced no {want_kind} cex"
+        assert cex.invariant == mut["expected_invariant"], \
+            f"mutation {mut['name']}: fired {cex.invariant!r}"
         # minimal-length trace exists and is replayable
         assert len(cex.trace) >= 2
         assert isinstance(pm.trace_schedule(model, cex.trace), list)
+
+
+def test_fixture_loader_rejects_missing_expected_invariant():
+    fixture = _load_fixture()
+    good = list(fixture.iter_mutations())
+    assert good
+    orig = fixture.MUTATIONS
+    try:
+        bad = dict(orig[0], name="no_expectation")
+        del bad["expected_invariant"]
+        fixture.MUTATIONS = orig + [bad]
+        with pytest.raises(ValueError, match="expected_invariant"):
+            list(fixture.iter_mutations())
+        fixture.MUTATIONS = orig + [dict(orig[0], name="bad_kind",
+                                         kind="eventually")]
+        with pytest.raises(ValueError, match="kind"):
+            list(fixture.iter_mutations())
+        fixture.MUTATIONS = orig + [dict(orig[0])]
+        with pytest.raises(ValueError, match="duplicate"):
+            list(fixture.iter_mutations())
+    finally:
+        fixture.MUTATIONS = orig
 
 
 def test_mutation_builder_helper():
@@ -186,12 +454,38 @@ def test_mutation_builder_helper():
 
 def test_cli_exit_codes(tmp_path):
     from tools.graftproto import main
-    assert main([]) == 0
-    assert main(["--model", "delta_chain"]) == 0
+    assert main(["--model", "reshard"]) == 0
     assert main(["--model", "nope"]) == 2
-    assert main(["--mutations"]) == 1      # seeded bugs MUST fire
     # a budget too small to finish a shipped model fails the gate
     assert main(["--model", "delta_chain", "--max-states", "100"]) == 1
+
+
+def test_cli_check_sync(capsys):
+    from tools.graftproto import main
+    assert main(["--check-sync"]) == 0
+    out = capsys.readouterr().out
+    assert "reserved, design-only" in out
+    assert "DRIFT" not in out
+
+
+def test_cli_json_report(tmp_path, capsys):
+    from tools.graftproto import main
+    out = tmp_path / "gate.json"
+    assert main(["--model", "multihost_delta", "--cross-check",
+                 "--json", str(out)]) == 0
+    capsys.readouterr()
+    data = json.loads(out.read_text())
+    entry = data["models"]["multihost_delta"]
+    assert entry["ok"] and entry["complete"]
+    assert entry["explored"] >= SHIPPED_MIN_STATES["multihost_delta"]
+    assert entry["stats"]["reduce"] is True
+    assert entry["cross_check"]["ratio"] >= 1.0
+    assert entry["liveness_ok"] is True
+
+
+def test_cli_mutations_exit_one():
+    from tools.graftproto import main
+    assert main(["--mutations"]) == 1      # seeded bugs MUST fire
 
 
 def test_cli_emit_schedules(tmp_path, capsys):
@@ -204,8 +498,10 @@ def test_cli_emit_schedules(tmp_path, capsys):
     for entry in data["models"].values():
         assert entry["explored"] > 0 and entry["schedules"]
     fixture = _load_fixture()
-    assert set(data["mutations"]) == {m[0] for m in fixture.MUTATIONS}
-    for name, _b, _k, expect_inv, _why in fixture.MUTATIONS:
-        mut = data["mutations"][name]
-        assert mut["invariant"] == expect_inv
-        assert mut["actions"]              # the replayable trace
+    muts = list(fixture.iter_mutations())
+    assert set(data["mutations"]) == {m["name"] for m in muts}
+    for mut in muts:
+        got = data["mutations"][mut["name"]]
+        assert got["invariant"] == mut["expected_invariant"]
+        assert got["kind"] == mut["kind"]
+        assert got["actions"]              # the replayable trace

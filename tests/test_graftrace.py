@@ -291,3 +291,80 @@ def test_suppression_rule_list_fails_closed():
     for junk in ("jg1o3", "garbage", "", "JG103 because reasons"):
         got = concurrency.trace_source(base.format(junk))
         assert [v.rule for v in got] == ["JG103"], junk
+
+
+# --- thread-spawning inventory ----------------------------------------------
+
+def _package_inventory():
+    """(relpath, class, lock fields) for every thread-spawning class,
+    plus every thread name literal, straight from the analyzer index."""
+    import ast
+
+    pkg = os.path.join(ROOT, "openembedding_tpu")
+    classes = {}
+    names = set()
+    for root, _dirs, files in os.walk(pkg):
+        if "__pycache__" in root:
+            continue
+        for f in sorted(files):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            rel = os.path.relpath(path, ROOT)
+            a = concurrency.Analyzer(path, src)
+            a._index(ast.parse(src))
+            for cls in a.classes:
+                if cls.spawns_thread:
+                    classes[(rel, cls.name)] = tuple(
+                        sorted(cls.lock_fields))
+            for n in ast.walk(ast.parse(src)):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "Thread":
+                    for kw in n.keywords:
+                        if kw.arg != "name":
+                            continue
+                        v = kw.value
+                        if isinstance(v, ast.Constant):
+                            names.add(v.value)
+                        elif isinstance(v, ast.JoinedStr):
+                            names.add("".join(
+                                p.value if isinstance(p, ast.Constant)
+                                else "*" for p in v.values))
+    return classes, names
+
+
+def test_thread_spawning_inventory_is_pinned():
+    """Every class that spawns a thread is visible to the lockset audit
+    (JG101's thread-reachability is keyed off this index) and carries
+    the lock fields the audit reasons over. Pins in particular the two
+    post-audit arrivals: the ``AdaptiveBatchTuner`` sampler (PR 17,
+    ``_lock``-guarded decision rounds) and the chaos-armed checkpoint
+    writer/compactor threads (PR 16 — module-function spawns, so they
+    appear as named threads, not classes). A NEW spawn site failing
+    this test is the point: extend the pin AND the lockset audit."""
+    classes, names = _package_inventory()
+    assert classes == {
+        ("openembedding_tpu/data/stream.py", "ShardStream"): ("_cv",),
+        ("openembedding_tpu/offload.py", "ShardedOffloadedTable"):
+            ("_book",),
+        ("openembedding_tpu/serving/batcher.py", "AdaptiveBatchTuner"):
+            ("_lock",),
+        ("openembedding_tpu/serving/batcher.py", "LookupBatcher"):
+            ("_cv",),
+        ("openembedding_tpu/serving/registry.py", "ModelRegistry"):
+            ("_lock",),
+        ("openembedding_tpu/serving/rest.py", "ControllerServer"): (),
+        ("openembedding_tpu/training.py", "Trainer"): (),
+        ("openembedding_tpu/utils/observability.py", "Reporter"):
+            ("_lock",),
+    }
+    # every thread in the package is named (chaos pins faults to
+    # thread-name patterns; an anonymous thread is untargetable)
+    assert names == {
+        "oe-ckpt-writer-*", "oe-ckpt-compact", "oe-writeback-*",
+        "oe-persist-*", "oe-prep", "oe-ingest-*", "oe-batcher-*",
+        "oe-plan-*", "oe-model-load-*", "oe-rest-*", "oe-reporter",
+    }

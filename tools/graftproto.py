@@ -4,16 +4,32 @@
     python -m tools.graftproto --model delta_chain
     python -m tools.graftproto --mutations         # seeded mutations must
                                                    # ALL counterexample
+    python -m tools.graftproto --check-sync        # model<->code drift
+    python -m tools.graftproto --json out.json     # machine-readable gate
+    python -m tools.graftproto --cross-check --model delta_chain
     python -m tools.graftproto --emit-schedules out.json
 
 Fourth leg of the static-analysis gate (graftlint / graftrace /
-graftcheck / graftproto): checks the shipped host-protocol models —
-the delta-checkpoint chain (+compactor, crash/tear budgets, racing
-loads), serving hot-swap seq gating, the DirtyTracker claim discipline,
-the HA registry CREATING window under replica kills, and the serving
-lookup micro-batcher (enqueue/flush/swap/shutdown) — EXHAUSTIVELY
-by BFS, printing per-model explored-state counts. Exit 0 only when every
-model's frontier is exhausted with all invariants green and no deadlock.
+graftcheck / graftproto): checks the protocol models — five shipped
+roles (the delta-checkpoint chain with compactor, crash/tear budgets
+and racing loads; serving hot-swap seq gating; the DirtyTracker claim
+discipline; the HA registry CREATING window; the serving lookup
+micro-batcher) plus the three models-first multi-host designs
+(per-host delta writers + cross-host commit, elastic training
+membership, N->M reshard) — EXHAUSTIVELY, with the v2 reductions ON
+(symmetry canonicalization, ample-set partial order, quiescent-payload
+collapse) and bounded-liveness obligations checked on the full graph.
+Exit 0 only when every model's frontier is exhausted with all
+invariants green, no deadlock, every obligation met, every state-count
+floor held and every wall-time ceiling respected.
+
+``--check-sync`` is the model<->code drift gate: exit 1 when any model
+action names a ``sync_point`` the package source does not emit
+(reserved design-only points are reported separately and do not fail).
+``--json OUT`` writes per-model explored counts, reduction stats and
+wall time for the CI artifact. ``--no-reduce`` forces full expansion;
+``--cross-check`` runs reduced AND full expansion and fails unless the
+verdicts are identical (the weekly reduction-soundness lane).
 
 ``--mutations`` runs the seeded mutation models
 (``tests/fixtures/graftproto_violations.py``) and prints each minimal
@@ -43,6 +59,27 @@ sys.path.insert(0, _ROOT)
 _FIXTURE = os.path.join(_ROOT, "tests", "fixtures",
                         "graftproto_violations.py")
 
+# Exploration tripwires, both directions. Floors: a guard refactor that
+# silently hollows out the reachable space must fail loudly — each
+# floor sits ~10% under the current REDUCED exhaustive count (the
+# default gate runs with reductions ON; --no-reduce runs are gated by
+# the same floors, which full expansion clears by construction).
+# Ceilings: a reduction regression (footprint loss, symmetry breakage)
+# that silently re-inflates the search must fail before it blows the
+# gate's budget — wall-clock seconds, sized ~6x the local runtime to
+# absorb CI jitter.
+STATE_FLOORS = {
+    "delta_chain": 58_000, "hot_swap": 120, "dirty_tracker": 70,
+    "ha_registry": 210, "serving_batcher": 3_000,
+    "multihost_delta": 140, "training_membership": 160, "reshard": 60,
+}
+WALL_CEILINGS_S = {
+    "delta_chain": 120.0, "hot_swap": 15.0, "dirty_tracker": 15.0,
+    "ha_registry": 15.0, "serving_batcher": 20.0,
+    "multihost_delta": 20.0, "training_membership": 20.0,
+    "reshard": 15.0,
+}
+
 
 def _load_standalone(name: str, path: str):
     spec = importlib.util.spec_from_file_location(name, path)
@@ -62,16 +99,52 @@ def _schedule_entry(model, trace):
             "syncs": protomodel.trace_schedule(model, trace)}
 
 
+def _check_sync(models) -> int:
+    """The model<->code drift gate: every sync point a model claims
+    must be emitted by the package source, or explicitly reserved."""
+    failed = 0
+    for model in models:
+        missing = protomodel.missing_sync_points(model)
+        reserved = protomodel.reserved_sync_points(model)
+        ok = "DRIFT" if missing else "ok"
+        print(f"[{model.name}] sync points: {ok}"
+              + (f" — missing from package source: {missing}"
+                 if missing else "")
+              + (f" (reserved, design-only: {reserved})"
+                 if reserved else ""))
+        if missing:
+            failed += 1
+    if failed:
+        print(f"graftproto --check-sync: {failed} model(s) reference "
+              f"sync points the package does not emit (rename drift or "
+              f"a dropped sync_point call)", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="exhaustive protocol model checking (delta chain / "
-                    "hot-swap / dirty tracker / HA registry / "
-                    "serving batcher)")
+        description="exhaustive protocol model checking (shipped roles "
+                    "+ the multi-host models), reductions on")
     ap.add_argument("--model", default="",
                     help="check one shipped model by name (default: all)")
     ap.add_argument("--max-states", type=int, default=500_000,
                     help="exploration budget; hitting it FAILS a shipped "
                          "model (an unexplored protocol is unchecked)")
+    ap.add_argument("--no-reduce", action="store_true",
+                    help="disable symmetry/partial-order/collapse "
+                         "reductions (full plain-BFS expansion)")
+    ap.add_argument("--cross-check", action="store_true",
+                    help="run reduced AND full expansion per model and "
+                         "fail unless invariant verdicts are identical "
+                         "(the weekly reduction-soundness lane)")
+    ap.add_argument("--check-sync", action="store_true",
+                    help="model<->code sync-point drift gate only: exit "
+                         "1 when a model action names a sync point the "
+                         "package source does not emit")
+    ap.add_argument("--json", default="", metavar="OUT",
+                    help="write per-model state counts, reduction "
+                         "stats and wall time as JSON (the CI artifact)")
     ap.add_argument("--mutations", nargs="?", const=_FIXTURE, default=None,
                     metavar="FIXTURE",
                     help="run the seeded mutation models instead; every "
@@ -91,16 +164,67 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
+    if args.check_sync:
+        return _check_sync(models)
+
     out = {"models": {}, "mutations": {}}
+    report = {"models": {}, "max_states": args.max_states,
+              "reduce": not args.no_reduce}
     failed = 0
 
     if args.mutations is None or args.emit_schedules:
         for model in models:
-            res = protomodel.check(model, max_states=args.max_states)
+            res = protomodel.check(model, max_states=args.max_states,
+                                   reduce=not args.no_reduce)
             print(protomodel.format_result(res, model))
+            entry = {
+                "explored": res.explored,
+                "transitions": res.transitions,
+                "elapsed_s": round(res.elapsed_s, 3),
+                "ok": res.ok, "complete": res.complete,
+                "stats": res.stats,
+            }
             if not (res.ok and res.complete):
                 failed += 1
+                report["models"][model.name] = entry
                 continue
+            floor = STATE_FLOORS.get(model.name)
+            if floor is not None and res.explored < floor:
+                print(f"[{model.name}] STATE FLOOR TRIPPED: explored "
+                      f"{res.explored} < floor {floor} — a guard "
+                      f"refactor hollowed out the exploration",
+                      file=sys.stderr)
+                failed += 1
+            ceiling = WALL_CEILINGS_S.get(model.name)
+            if ceiling is not None and res.elapsed_s > ceiling:
+                print(f"[{model.name}] WALL-TIME CEILING TRIPPED: "
+                      f"{res.elapsed_s:.2f}s > {ceiling}s — a "
+                      f"reduction regression re-inflated the search",
+                      file=sys.stderr)
+                failed += 1
+            if args.cross_check:
+                xc = protomodel.cross_check(model,
+                                            max_states=args.max_states)
+                entry["cross_check"] = {
+                    "reduced_explored": xc["reduced"].explored,
+                    "full_explored": xc["full"].explored,
+                    "ratio": round(xc["ratio"], 3)}
+                print(f"[{model.name}] cross-check: reduced "
+                      f"{xc['reduced'].explored} vs full "
+                      f"{xc['full'].explored} "
+                      f"({xc['ratio']:.2f}x), verdicts identical")
+            if model.obligations:
+                lres = protomodel.check_liveness(
+                    model, max_states=args.max_states)
+                entry["liveness_ok"] = lres.ok
+                if not (lres.ok and lres.complete):
+                    print(protomodel.format_result(lres, model))
+                    failed += 1
+                else:
+                    print(f"[{model.name}] "
+                          f"{len(model.obligations)} bounded-liveness "
+                          f"obligation(s) hold on the full graph")
+            report["models"][model.name] = entry
             if args.emit_schedules:
                 out["models"][model.name] = {
                     "explored": res.explored,
@@ -114,30 +238,37 @@ def main(argv=None) -> int:
     if args.mutations is not None or args.emit_schedules:
         fixture = _load_standalone("_graftproto_fixture",
                                    args.mutations or _FIXTURE)
-        for name, builder, kwargs, expect_inv, why in fixture.MUTATIONS:
-            model = getattr(protomodel, builder)(**kwargs)
-            res = protomodel.check(model, max_states=args.max_states)
+        for mut in fixture.iter_mutations():
+            name = mut["name"]
+            expect_inv = mut["expected_invariant"]
+            model = getattr(protomodel, mut["builder"])(**mut["kwargs"])
+            if mut["kind"] == "liveness":
+                res = protomodel.check_liveness(
+                    model, max_states=args.max_states)
+            else:
+                res = protomodel.check(model, max_states=args.max_states)
             cex = res.counterexample
             if cex is None:
                 print(f"[mutation {name}] NO counterexample — the "
-                      f"checker missed a seeded bug ({why})")
+                      f"checker missed a seeded bug ({mut['why']})")
                 failed += 1
                 continue
             print(f"[mutation {name}] counterexample "
-                  f"({len(cex.trace) - 1} steps, invariant "
+                  f"({len(cex.trace) - 1} steps, {mut['kind']} "
                   f"{cex.invariant!r}, expected {expect_inv!r})")
             if args.mutations is not None:
                 print(protomodel.format_result(res, model))
                 failed += 1          # mutations firing IS the exit-1 path
             if cex.invariant != expect_inv:
-                print(f"[mutation {name}] WRONG invariant fired",
+                print(f"[mutation {name}] WRONG property fired",
                       file=sys.stderr)
                 failed += 1
             if args.emit_schedules:
                 out["mutations"][name] = {
                     "model": model.name,
                     "invariant": cex.invariant,
-                    "why": why,
+                    "kind": mut["kind"],
+                    "why": mut["why"],
                     **_schedule_entry(model, cex.trace),
                 }
 
@@ -145,6 +276,11 @@ def main(argv=None) -> int:
         with open(args.emit_schedules, "w", encoding="utf-8") as fh:
             json.dump(out, fh, indent=1, sort_keys=True)
         print(f"graftproto: schedules -> {args.emit_schedules}")
+
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+        print(f"graftproto: gate report -> {args.json}")
 
     if failed:
         print(f"graftproto: {failed} failing check(s)", file=sys.stderr)
