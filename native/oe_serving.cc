@@ -16,6 +16,7 @@
 
 #include <algorithm>
 #include <cctype>
+#include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <map>
@@ -53,6 +54,12 @@ struct JsonParser {
   const char* end;
   bool ok = true;
 
+  // recursion bound: manifests/model_meta are untrusted bytes, and an
+  // unbounded "[[[[..." nest overflows the parse stack (graftfuzz
+  // manifest_json_garbage class) — far deeper than anything the
+  // framework writes, well inside any sane thread stack
+  static constexpr int kMaxDepth = 64;
+
   void skip() {
     while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
   }
@@ -61,10 +68,11 @@ struct JsonParser {
     if (p < end && *p == c) { ++p; return true; }
     return false;
   }
-  Json parse() {
+  Json parse() { return parse_at(0); }
+  Json parse_at(int depth) {
     skip();
     Json j;
-    if (p >= end) { ok = false; return j; }
+    if (p >= end || depth > kMaxDepth) { ok = false; return j; }
     switch (*p) {
       case '{': {
         ++p;
@@ -75,7 +83,7 @@ struct JsonParser {
           skip();
           Json key = parse_string();
           if (!ok || !consume(':')) { ok = false; return j; }
-          j.obj[key.str] = parse();
+          j.obj[key.str] = parse_at(depth + 1);
         } while (ok && consume(','));
         if (!consume('}')) ok = false;
         return j;
@@ -86,7 +94,7 @@ struct JsonParser {
         skip();
         if (consume(']')) return j;
         do {
-          j.arr.push_back(parse());
+          j.arr.push_back(parse_at(depth + 1));
         } while (ok && consume(','));
         if (!consume(']')) ok = false;
         return j;
@@ -144,6 +152,27 @@ struct JsonParser {
     return j;
   }
 };
+
+// Untrusted JSON numbers -> integers: a double outside int64's range
+// (or NaN) makes the straight static_cast undefined behavior
+// (float-cast-overflow; UBSan aborts) — clamp-refuse instead. The
+// bound is the largest double below 2^63; the comparison is written so
+// NaN falls through to false.
+bool json_i64(const Json* j, int64_t* out) {
+  if (!j || j->kind != Json::kNum) return false;
+  double v = j->num;
+  if (!(v >= -9.223372036854775e18 && v <= 9.223372036854775e18))
+    return false;
+  *out = static_cast<int64_t>(v);
+  return true;
+}
+
+bool json_int(const Json* j, int* out) {
+  int64_t v;
+  if (!json_i64(j, &v) || v < INT32_MIN || v > INT32_MAX) return false;
+  *out = static_cast<int>(v);
+  return true;
+}
 
 bool read_file(const std::string& path, std::string* out) {
   FILE* f = std::fopen(path.c_str(), "rb");
@@ -334,6 +363,19 @@ bool is_wide_keys(const NpyArray& a) {
   return a.shape.size() == 2 && a.shape[1] == 2 && a.itemsize == 4;
 }
 
+bool keys_dtype_supported(const NpyArray& a) {
+  // key/id/chunk columns: [n] i4/i8 (or u4/u8) — or the wide [n, 2]
+  // int32 pair layout. load_key_as_i64 memcpy's 4 or 8 bytes per row;
+  // any other dtype/shape would read the WRONG bytes (a '<i2' keys
+  // member reads past its own rows into the neighbouring member —
+  // silent key garbage, silent Python-vs-native divergence), so it
+  // must refuse here, before the first key load
+  if (is_wide_keys(a)) return true;
+  if (a.shape.size() != 1 || a.dtype.size() < 3) return false;
+  char c = a.dtype[1];
+  return (c == 'i' || c == 'u') && (a.itemsize == 4 || a.itemsize == 8);
+}
+
 int64_t load_key_as_i64(const NpyArray& a, int64_t idx) {
   // row-indexed key load: [n] int32/int64, or [n, 2] int32 pairs joined
   // to the 64-bit value ((hi << 32) | unsigned lo)
@@ -376,16 +418,22 @@ struct Crc32Table {
   }
 };
 
-uint32_t crc32_of(const unsigned char* buf, size_t len) {
+uint32_t crc32_update(uint32_t crc, const unsigned char* buf, size_t len) {
+  // zlib.crc32(data, prev) semantics: chainable over field slices (the
+  // per-chunk checksums crc field A then field B with one running crc)
   // magic static: C++11 guarantees thread-safe one-time construction
   // (two threads loading delta dirs concurrently must never read a
   // half-built table — a wrong crc would misclassify a valid delta
   // as torn)
   static const Crc32Table table;
-  uint32_t c = 0xFFFFFFFFu;
+  uint32_t c = crc ^ 0xFFFFFFFFu;
   for (size_t i = 0; i < len; ++i)
     c = table.t[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
+}
+
+uint32_t crc32_of(const unsigned char* buf, size_t len) {
+  return crc32_update(0, buf, len);
 }
 
 // A whole file mmap'd read-only; delta payloads stay mapped for the
@@ -488,7 +536,8 @@ bool parse_npz(const unsigned char* b, size_t n, const std::string& what,
       return false;
     }
     std::string name(reinterpret_cast<const char*>(b + p + 46), name_len);
-    if (csize == 0xFFFFFFFFu || lho == 0xFFFFFFFFu) {
+    if (csize == 0xFFFFFFFFu || usize == 0xFFFFFFFFu
+        || lho == 0xFFFFFFFFu) {
       set_error("zip64 npz member unsupported: " + what + ":" + name);
       return false;
     }
@@ -625,6 +674,69 @@ struct DeltaPayload {
   }
 };
 
+// Mirror checkpoint_delta._verify_array_chunks: recompute each chunk's
+// crc32 over the payload's field rows in _field_order (weights, then
+// slot_* sorted — array payloads carry no keys) and compare against
+// the manifest entry's chunk_crc list. The whole-file crc has already
+// matched by the time this runs, so a mismatch means the manifest and
+// the member bytes disagree (crc swaps, crc-preserving payload swaps);
+// the Python verifier treats that as tear damage and the caller here
+// applies the same final-drop/mid-fail semantics. Returns false on any
+// mismatch or ill-formed geometry; never reads out of bounds.
+bool verify_chunk_crcs(const DeltaPayload& pl, const Json& chunk_crc,
+                       const std::string& what) {
+  NpyArray chunks, rpc, vocab;
+  int64_t R = 0, V = 0;
+  constexpr int64_t kMaxRows = int64_t(1) << 56;
+  if (!pl.view("chunks", &chunks, what)
+      || !pl.view("rows_per_chunk", &rpc, what)
+      || !pl.view("vocab", &vocab, what)
+      || !npy_scalar_i64(rpc, &R) || !npy_scalar_i64(vocab, &V)
+      || R <= 0 || R > kMaxRows || V < 0 || V > kMaxRows
+      || !keys_dtype_supported(chunks) || is_wide_keys(chunks)
+      || static_cast<int64_t>(chunk_crc.arr.size()) != chunks.rows()) {
+    return false;
+  }
+  const int64_t nchunks = (V + R - 1) / R;
+  // _field_order: weights first, then slot_* sorted (pl.members is a
+  // sorted map, so slot members come out in field order already)
+  std::vector<std::string> order = {"weights"};
+  for (const auto& m : pl.members) {
+    if (m.first.rfind("slot_", 0) == 0 && m.first.size() > 4
+        && m.first.compare(m.first.size() - 4, 4, ".npy") == 0) {
+      order.push_back(m.first.substr(0, m.first.size() - 4));
+    }
+  }
+  int64_t off = 0;
+  for (size_t i = 0; i < chunk_crc.arr.size(); ++i) {
+    int64_t want = 0;
+    if (!json_i64(&chunk_crc.arr[i], &want)) return false;
+    int64_t c = load_key_as_i64(chunks, static_cast<int64_t>(i));
+    if (c < 0 || c >= nchunks) return false;
+    int64_t n = std::min((c + 1) * R, V) - c * R;
+    uint32_t crc = 0;
+    for (const std::string& f : order) {
+      NpyArray a;
+      if (!pl.view(f, &a, what)) return false;
+      int64_t rowbytes = a.row_elems()
+          * static_cast<int64_t>(a.itemsize);
+      if (rowbytes < 0 || off + n > a.rows()) return false;
+      crc = crc32_update(
+          crc,
+          reinterpret_cast<const unsigned char*>(a.data)
+              + off * rowbytes,
+          static_cast<size_t>(n) * static_cast<size_t>(rowbytes));
+    }
+    if (crc != static_cast<uint32_t>(want)) return false;
+    off += n;
+  }
+  for (const std::string& f : order) {
+    NpyArray a;
+    if (!pl.view(f, &a, what) || a.rows() != off) return false;
+  }
+  return true;
+}
+
 // Apply one variable's verified payload newest-wins: its weights become
 // a new part; overlay/index entries redirect the touched keys to it.
 bool apply_delta_payload(oe_variable* var, const DeltaPayload& pl,
@@ -646,6 +758,11 @@ bool apply_delta_payload(oe_variable* var, const DeltaPayload& pl,
   if (pl.members.count("keys.npy")) {           // hash payload
     NpyArray keys;
     if (!pl.view("keys", &keys, what)) return false;
+    if (!keys_dtype_supported(keys)) {
+      set_error("unsupported delta key dtype " + keys.dtype + " for "
+                + var->name + ": " + what);
+      return false;
+    }
     if (keys.rows() != wrows) {
       set_error("delta key/row count mismatch for " + var->name + ": "
                 + what);
@@ -673,16 +790,31 @@ bool apply_delta_payload(oe_variable* var, const DeltaPayload& pl,
         || !pl.view("vocab", &vocab, what)) {
       return false;
     }
+    // R/V sanity bounds keep every derived quantity ((chunk+1)*R,
+    // V+R-1) inside int64 — a hostile rows_per_chunk near 2^63 would
+    // otherwise signed-overflow (UB) before any range check can fire
+    constexpr int64_t kMaxRows = int64_t(1) << 56;
     if (!npy_scalar_i64(rpc, &R) || !npy_scalar_i64(vocab, &V)
-        || R <= 0) {
+        || R <= 0 || R > kMaxRows || V < 0 || V > kMaxRows) {
       set_error("corrupt array delta header for " + var->name + ": "
                 + what);
       return false;
     }
+    if (!keys_dtype_supported(chunks) || is_wide_keys(chunks)) {
+      set_error("unsupported delta chunk-id dtype " + chunks.dtype
+                + " for " + var->name + ": " + what);
+      return false;
+    }
+    const int64_t nchunks = (V + R - 1) / R;
     auto& target = var->direct ? var->overlay : var->index;
     int64_t j = 0;
     for (int64_t c = 0; c < chunks.rows(); ++c) {
       int64_t chunk = load_key_as_i64(chunks, c);
+      if (chunk < 0 || chunk >= nchunks) {
+        set_error("array delta chunk id out of range for " + var->name
+                  + ": " + what);
+        return false;
+      }
       int64_t l1 = std::min((chunk + 1) * R, V);
       for (int64_t g = chunk * R; g < l1; ++g, ++j) {
         if (j >= wrows) {
@@ -723,20 +855,25 @@ bool replay_delta_chain(oe_model* model, const std::string& root) {
     set_error("delta_manifest is not valid JSON: " + mpath);
     return false;
   }
-  const Json* fmt = manifest.get("format");
-  if (!fmt || static_cast<int>(fmt->num) != 1) {
+  int64_t fmt_num = -1;
+  if (!json_i64(manifest.get("format"), &fmt_num) || fmt_num != 1) {
     set_error("unknown delta manifest format at " + root);
     return false;
   }
-  if (const Json* cs = manifest.get("content_seq"))
-    model->version = static_cast<int64_t>(cs->num);
+  if (const Json* cs = manifest.get("content_seq")) {
+    if (!json_i64(cs, &model->version)) {
+      set_error("corrupt content_seq in delta manifest at " + root);
+      return false;
+    }
+  }
   const Json* chain = manifest.get("chain");
   if (!chain || chain->kind != Json::kArr) return true;
   for (size_t i = 0; i < chain->arr.size(); ++i) {
     const Json& entry = chain->arr[i];
     const Json* vars = entry.get("vars");
-    const Json* seq = entry.get("seq");
-    if (!vars || vars->kind != Json::kObj || !seq) {
+    int64_t seq64 = 0;
+    if (!vars || vars->kind != Json::kObj
+        || !json_i64(entry.get("seq"), &seq64)) {
       set_error("corrupt delta chain entry at " + root);
       return false;
     }
@@ -747,16 +884,16 @@ bool replay_delta_chain(oe_model* model, const std::string& root) {
     bool bad = false;
     for (const auto& kv : vars->obj) {
       const Json* file = kv.second.get("file");
-      const Json* crc = kv.second.get("crc32");
-      if (!file || !crc) {
-        bad = true;
+      int64_t crc64 = 0;
+      if (!file || file->kind != Json::kStr
+          || !json_i64(kv.second.get("crc32"), &crc64)) {
+        bad = true;                      // malformed var record: tear
         break;
       }
       auto mf = map_file(root + "/" + file->str);
       if (!mf
           || crc32_of(mf->bytes(), mf->size)
-              != static_cast<uint32_t>(
-                  static_cast<int64_t>(crc->num))) {
+              != static_cast<uint32_t>(crc64)) {
         bad = true;                      // missing or corrupt bytes
         break;
       }
@@ -769,14 +906,25 @@ bool replay_delta_chain(oe_model* model, const std::string& root) {
         // a tear: fail loudly instead of "recovering" past real data
         return false;
       }
+      // per-chunk checksums, when the manifest carries them, must
+      // re-verify just like checkpoint_delta.verify_chain — a manifest
+      // that lies about its chunk crcs (crc swap, crc-preserving
+      // payload swap) is tear damage in BOTH readers, or the two would
+      // silently recover to different versions
+      const Json* ccrc = kv.second.get("chunk_crc");
+      if (ccrc && ccrc->kind != Json::kNull
+          && (ccrc->kind != Json::kArr
+              || !verify_chunk_crcs(pl, *ccrc, file->str))) {
+        bad = true;                      // chunk checksum mismatch
+        break;
+      }
       maps.push_back(std::move(mf));
       payloads.push_back(std::move(pl));
     }
     if (bad) {
       if (i + 1 == chain->arr.size()) return true;  // torn FINAL: drop
       set_error("delta chain torn mid-chain at seq "
-                + std::to_string(static_cast<int64_t>(seq->num))
-                + " under " + root
+                + std::to_string(seq64) + " under " + root
                 + " — restore the file or load an older full dump");
       return false;
     }
@@ -785,13 +933,12 @@ bool replay_delta_chain(oe_model* model, const std::string& root) {
       if (it == model->by_name.end()) continue;   // unknown var: skip
       if (!apply_delta_payload(it->second, pl,
                                root + " seq "
-                               + std::to_string(
-                                   static_cast<int64_t>(seq->num)))) {
+                               + std::to_string(seq64))) {
         return false;
       }
     }
     for (auto& mf : maps) model->payloads.push_back(std::move(mf));
-    model->version = static_cast<int64_t>(seq->num);
+    model->version = seq64;
   }
   return true;
 }
@@ -828,12 +975,16 @@ oe_model* oe_model_load(const char* path) {
   for (const Json& v : vars->arr) {
     auto var = std::make_unique<oe_variable>();
     if (const Json* n = v.get("name")) var->name = n->str;
-    if (const Json* i = v.get("variable_id"))
-      var->variable_id = static_cast<int>(i->num);
+    if (const Json* i = v.get("variable_id")) {
+      if (!json_int(i, &var->variable_id)) {
+        set_error("corrupt variable_id for " + var->name);
+        return nullptr;
+      }
+    }
     // ModelVariableMeta serializes flat: datatype/embedding_dim/
-    // vocabulary_size alongside variable_id/name (meta.py to_json)
-    if (const Json* d = v.get("embedding_dim"))
-      var->dim = static_cast<int>(d->num);
+    // vocabulary_size alongside variable_id/name (meta.py to_json);
+    // an out-of-int-range dim stays 0 and is refused just below
+    if (const Json* d = v.get("embedding_dim")) json_int(d, &var->dim);
     double vocab = 0;
     if (const Json* vv = v.get("vocabulary_size")) vocab = vv->num;
     if (var->dim <= 0) {
@@ -841,6 +992,12 @@ oe_model* oe_model_load(const char* path) {
       return nullptr;
     }
     bool hash = vocab >= kUnbounded;
+    // the bounded-path cast below is UB for NaN/negative-huge vocab
+    // (float-cast-overflow) — refuse anything not a plain row count
+    if (!hash && !(vocab >= 0 && vocab <= 9.0e18)) {
+      set_error("corrupt vocabulary_size for " + var->name);
+      return nullptr;
+    }
     var->vocab = hash ? -1 : static_cast<int64_t>(vocab);
 
     std::string safe = var->name;
@@ -892,6 +1049,11 @@ oe_model* oe_model_load(const char* path) {
       if (!var->direct) {
         auto kk = open_npy(key_file);
         if (!kk) return nullptr;
+        if (!keys_dtype_supported(*kk)) {
+          set_error("unsupported key dtype " + kk->dtype + " for "
+                    + var->name);
+          return nullptr;
+        }
         if (kk->rows() != w->rows()) {
           set_error("key/row count mismatch for " + var->name);
           return nullptr;

@@ -6,7 +6,7 @@
  * TF-Serving loads through libcexb_pack.so so inference needs no Python):
  * this library memory-maps a checkpoint directory written by
  * openembedding_tpu.checkpoint.save_checkpoint (model_meta JSON +
- * var_<id>_<name>.d/*.npy) and serves read-only row lookups from C/C++.
+ * var_<id>_<name>.d/ *.npy) and serves read-only row lookups from C/C++.
  *
  *   oe_model*    m = oe_model_load("/path/to/ckpt");
  *   oe_variable* v = oe_model_variable(m, "fields");

@@ -74,6 +74,37 @@ def _delta_fname(seq: int, vid: int) -> str:
     return f"delta_{seq:06d}_{vid}.npz"
 
 
+def _seq_ok(seq: Any) -> bool:
+    """True when ``seq`` is an integral number the NATIVE reader's
+    json_i64 would also accept (int64 range, no bools, no NaN/inf) —
+    both readers must refuse the same manifests or they recover to
+    different versions (the graftfuzz divergence oracle)."""
+    if isinstance(seq, bool) or not isinstance(seq, (int, float)):
+        return False
+    try:
+        return (seq == int(seq)
+                and -(2 ** 63) < int(seq) < 2 ** 63)
+    except (OverflowError, ValueError):       # inf / nan
+        return False
+
+
+class DeltaDecodeError(ValueError):
+    """Typed refusal for corrupt/garbage delta BYTES (wire frames,
+    manifest records, crc-valid-but-unparseable payloads), with offset/
+    field context in the message.
+
+    One type for the whole untrusted-bytes delta surface so damage is
+    distinguishable from reader bugs: the REST ``POST /models/<sign>/
+    delta`` handler maps ``ValueError`` to 400 (client sent garbage —
+    this subclasses it on purpose), and the graftfuzz trichotomy oracle
+    counts it as a clean typed refusal, where a raw ``struct.error`` /
+    ``zlib.error`` / ``KeyError`` escaping a byte parser is scored as a
+    crash. Semantic refusals keep their existing types (category swap
+    ``ValueError``, checksum ``RuntimeError``, torn mid-chain
+    ``RuntimeError``) — this class is specifically for bytes that could
+    not be decoded at all."""
+
+
 # --- manifest ----------------------------------------------------------------
 
 def read_manifest(path: str) -> Optional[Dict[str, Any]]:
@@ -82,6 +113,10 @@ def read_manifest(path: str) -> Optional[Dict[str, Any]]:
     if not fs.exists(mpath):
         return None
     manifest = fs.read_json(mpath)
+    if not isinstance(manifest, dict):
+        raise DeltaDecodeError(
+            f"delta manifest at {path!r} is JSON "
+            f"{type(manifest).__name__}, not an object")
     if manifest.get("format") != DELTA_FORMAT:
         raise ValueError(
             f"unknown delta manifest format {manifest.get('format')!r} "
@@ -321,29 +356,56 @@ def _serialize_payload(payload: Dict[str, np.ndarray],
 
 
 def _parse_payload(raw: bytes) -> Dict[str, np.ndarray]:
-    data = np.load(io.BytesIO(raw))
-    return {k: data[k] for k in data.files}
+    # every caller checked the whole-file crc first, so a parse failure
+    # here means crc-preserving corruption (or an unsupported npz
+    # feature) — surface it typed, not as whatever np.load's zip/format
+    # internals happen to raise (BadZipFile, struct.error, OSError...)
+    try:
+        data = np.load(io.BytesIO(raw))
+        return {k: data[k] for k in data.files}
+    except DeltaDecodeError:
+        raise
+    except Exception as e:  # noqa: BLE001 — parser surface, see above
+        raise DeltaDecodeError(
+            f"delta payload npz is unparseable ({len(raw)} bytes, "
+            f"crc-verified): {type(e).__name__}: {e}") from e
 
 
 def _verify_array_chunks(payload: Dict[str, np.ndarray],
                          chunk_crc: List[int]) -> bool:
-    """Recompute per-chunk crcs of a parsed array payload."""
-    chunks = np.asarray(payload["chunks"], np.int64)
-    R = int(payload["rows_per_chunk"])
-    vocab = int(payload["vocab"])
-    order = _field_order(payload)
-    if len(chunk_crc) != chunks.size:
-        return False
-    off = 0
-    for i, c in enumerate(chunks):
-        n = min((int(c) + 1) * R, vocab) - int(c) * R
-        crc = 0
-        for f in order:
-            crc = zlib.crc32(payload[f][off:off + n].tobytes(), crc)
-        if crc != int(chunk_crc[i]):
+    """Recompute per-chunk crcs of a parsed array payload.
+
+    Never raises: ill-formed geometry (missing members, out-of-range
+    chunk ids, non-list crcs — the manifest and the member bytes
+    disagreeing) reports False, which the caller treats exactly like a
+    chunk crc mismatch. Mirrored by the native reader's
+    ``verify_chunk_crcs`` (oe_serving.cc) so both loaders classify the
+    same manifests as damaged."""
+    try:
+        chunks = np.asarray(payload["chunks"], np.int64)
+        R = int(payload["rows_per_chunk"])
+        vocab = int(payload["vocab"])
+        order = _field_order(payload)
+        if R <= 0 or vocab < 0 or chunks.ndim != 1:
             return False
-        off += n
-    return all(payload[f].shape[0] == off for f in order)
+        nchunks = -(-vocab // R)
+        if len(chunk_crc) != chunks.size:
+            return False
+        off = 0
+        for i, c in enumerate(chunks):
+            c = int(c)
+            if c < 0 or c >= nchunks:
+                return False
+            n = min((c + 1) * R, vocab) - c * R
+            crc = 0
+            for f in order:
+                crc = zlib.crc32(payload[f][off:off + n].tobytes(), crc)
+            if crc != int(chunk_crc[i]):
+                return False
+            off += n
+        return all(payload[f].shape[0] == off for f in order)
+    except (KeyError, TypeError, ValueError, OverflowError):
+        return False
 
 
 # --- delta save --------------------------------------------------------------
@@ -555,20 +617,43 @@ def verify_chain(path: str, manifest: Dict[str, Any],
     fold — the chain-bytes budget can be a large fraction of the base,
     which must never be required to fit in RAM at once)."""
     entries = manifest.get("chain", [])
+    if not isinstance(entries, list):
+        raise DeltaDecodeError(
+            f"delta chain at {path!r} is not a list (manifest corrupt)")
     out = []
     for i, entry in enumerate(entries):
+        if (not isinstance(entry, dict) or "seq" not in entry
+                or not isinstance(entry.get("vars"), dict)
+                or not _seq_ok(entry.get("seq"))):
+            # native parity (replay_delta_chain "corrupt delta chain
+            # entry"): structural manifest corruption refuses the load
+            # outright — tear semantics are reserved for FILE damage.
+            # The seq bound matches the native json_i64 int64 range: a
+            # 1e300 seq that Python's bignums would happily carry must
+            # not load here while the native reader refuses it
+            raise DeltaDecodeError(
+                f"corrupt delta chain entry #{i} at {path!r}")
         payloads: Dict[str, Dict[str, np.ndarray]] = {}
         bad = None
         for name, info in entry["vars"].items():
-            fpath = fs.join(path, info["file"])
+            try:
+                fname = info["file"]
+                want_crc = int(info["crc32"])
+                if not isinstance(fname, str):
+                    raise TypeError(
+                        f"file field is {type(fname).__name__}")
+            except (TypeError, KeyError, ValueError) as e:
+                bad = f"var {name!r}: malformed manifest record ({e})"
+                break
+            fpath = fs.join(path, fname)
             try:
                 with fs.open_file(fpath, "rb") as f:
                     raw = f.read()
             except (OSError, FileNotFoundError):
-                bad = f"{info['file']}: missing/unreadable"
+                bad = f"{fname}: missing/unreadable"
                 break
-            if zlib.crc32(raw) != int(info["crc32"]):
-                bad = f"{info['file']}: crc mismatch"
+            if zlib.crc32(raw) != want_crc:
+                bad = f"{fname}: crc mismatch"
                 break
             payload = _parse_payload(raw)
             if info.get("chunk_crc") is not None \
@@ -731,12 +816,31 @@ def apply_delta_to_states(collection: EmbeddingCollection,
 
 def _payload_ids(payload: Dict[str, np.ndarray]) -> np.ndarray:
     """Global logical row ids of an ARRAY payload's rows (chunk ranges
-    expanded in order)."""
-    chunks = np.asarray(payload["chunks"], np.int64)
-    R = int(payload["rows_per_chunk"])
-    vocab = int(payload["vocab"])
+    expanded in order). Refuses ill-formed headers typed: a hostile
+    chunk id or rows_per_chunk would otherwise expand to an unbounded
+    ``arange`` (an allocation-of-death, not a parse error) — the native
+    reader refuses the same ranges ("array delta chunk id out of
+    range")."""
+    try:
+        chunks = np.asarray(payload["chunks"], np.int64)
+        R = int(payload["rows_per_chunk"])
+        vocab = int(payload["vocab"])
+    except (KeyError, TypeError, ValueError, OverflowError) as e:
+        raise DeltaDecodeError(
+            f"corrupt array delta header: {type(e).__name__}: {e}"
+        ) from e
+    if R <= 0 or vocab < 0:
+        raise DeltaDecodeError(
+            f"corrupt array delta header (rows_per_chunk={R}, "
+            f"vocab={vocab})")
     if not chunks.size:
         return np.zeros(0, np.int64)
+    nchunks = -(-vocab // R)
+    lo, hi = int(chunks.min()), int(chunks.max())
+    if lo < 0 or hi >= nchunks:
+        raise DeltaDecodeError(
+            f"array delta chunk id out of range: [{lo}, {hi}] outside "
+            f"[0, {nchunks})")
     return np.concatenate([
         np.arange(int(c) * R, min((int(c) + 1) * R, vocab),
                   dtype=np.int64) for c in chunks])
@@ -865,28 +969,49 @@ class Delta:
 def read_delta(path: str, seq: Optional[int] = None) -> Delta:
     """Load one committed delta (default: the newest) for publishing."""
     manifest = read_manifest(path)
-    if manifest is None or not manifest["chain"]:
+    if manifest is None or not manifest.get("chain"):
         raise ValueError(f"no committed deltas at {path!r}")
     entries = manifest["chain"]
+    if not isinstance(entries, list):
+        raise DeltaDecodeError(
+            f"delta chain at {path!r} is not a list (manifest corrupt)")
     if seq is None:
         entry = entries[-1]
     else:
-        match = [e for e in entries if e["seq"] == seq]
+        match = [e for e in entries
+                 if isinstance(e, dict) and e.get("seq") == seq]
         if not match:
-            raise KeyError(f"no delta seq={seq} at {path!r} "
-                           f"(chain has {[e['seq'] for e in entries]})")
+            raise KeyError(
+                f"no delta seq={seq} at {path!r} (chain has "
+                f"{[e.get('seq') for e in entries if isinstance(e, dict)]})")
         entry = match[0]
+    try:
+        eseq = int(entry["seq"])
+        estep = int(entry["step"])
+        var_items = list(entry["vars"].items())
+    except (TypeError, ValueError, KeyError, AttributeError) as e:
+        raise DeltaDecodeError(
+            f"corrupt delta chain entry at {path!r}: "
+            f"{type(e).__name__}: {e}") from e
     payloads = {}
-    for name, info in entry["vars"].items():
-        with fs.open_file(fs.join(path, info["file"]), "rb") as f:
+    for name, info in var_items:
+        try:
+            fname = info["file"]
+            want_crc = int(info["crc32"])
+            if not isinstance(fname, str):
+                raise TypeError(f"file field is {type(fname).__name__}")
+        except (TypeError, KeyError, ValueError) as e:
+            raise DeltaDecodeError(
+                f"corrupt delta manifest record for {name!r} at "
+                f"{path!r}: {type(e).__name__}: {e}") from e
+        with fs.open_file(fs.join(path, fname), "rb") as f:
             raw = f.read()
-        if zlib.crc32(raw) != int(info["crc32"]):
+        if zlib.crc32(raw) != want_crc:
             raise RuntimeError(
-                f"delta seq={entry['seq']} file {info['file']} fails "
+                f"delta seq={eseq} file {fname} fails "
                 "its checksum; refusing to publish a corrupt delta")
         payloads[name] = _parse_payload(raw)
-    return Delta(seq=int(entry["seq"]), step=int(entry["step"]),
-                 vars=payloads)
+    return Delta(seq=eseq, step=estep, vars=payloads)
 
 
 def read_deltas_since(path: str, after_seq: int) -> List[Delta]:
@@ -895,8 +1020,16 @@ def read_deltas_since(path: str, after_seq: int) -> List[Delta]:
     manifest = read_manifest(path)
     if manifest is None:
         return []
-    return [read_delta(path, e["seq"]) for e in manifest["chain"]
-            if int(e["seq"]) > int(after_seq)]
+    chain = manifest.get("chain") or []
+    try:
+        seqs = [int(e["seq"]) for e in chain]
+        if not all(_seq_ok(s) for s in seqs):
+            raise ValueError("seq outside the int64 range")
+    except (TypeError, ValueError, KeyError) as e:
+        raise DeltaDecodeError(
+            f"corrupt delta chain at {path!r}: "
+            f"{type(e).__name__}: {e}") from e
+    return [read_delta(path, s) for s in seqs if s > int(after_seq)]
 
 
 def encode_delta(delta: Delta, compress: str = "") -> bytes:
@@ -926,26 +1059,94 @@ def encode_delta(delta: Delta, compress: str = "") -> bytes:
 
 
 def decode_delta(data: bytes) -> Delta:
+    """Decode one :func:`encode_delta` wire frame.
+
+    The frame is UNTRUSTED bytes (the REST ``POST /models/<sign>/delta``
+    body): every malformed shape — missing header line, garbage JSON,
+    bad codec, corrupt field specs, a body too short for its specs —
+    refuses with :class:`DeltaDecodeError` carrying offset context, so
+    the REST handler answers 400 and the graftfuzz oracle sees a typed
+    refusal instead of a raw ``struct.error``/``zlib.error``/
+    ``KeyError`` escaping the parser."""
     from .utils import compress as compress_lib
-    nl = data.index(b"\n")
-    head = json.loads(data[:nl])
+    data = bytes(data)
+    nl = data.find(b"\n")
+    if nl < 0:
+        raise DeltaDecodeError(
+            f"delta wire frame has no header line ({len(data)} bytes, "
+            "no newline)")
+    try:
+        head = json.loads(data[:nl])
+    except ValueError as e:
+        raise DeltaDecodeError(
+            f"delta wire header (bytes 0..{nl}) is not valid JSON: {e}"
+        ) from e
+    if not isinstance(head, dict):
+        raise DeltaDecodeError(
+            f"delta wire header is JSON {type(head).__name__}, "
+            "not an object")
     raw = data[nl + 1:]
     codec = head.get("compress", "")
     if codec:
-        raw = compress_lib.decompress(codec, raw)
+        try:
+            raw = compress_lib.decompress(codec, raw)
+        except DeltaDecodeError:
+            raise
+        except Exception as e:  # noqa: BLE001 — zlib.error/bad codec
+            raise DeltaDecodeError(
+                f"delta wire body (offset {nl + 1}) fails {codec!r} "
+                f"decompression: {type(e).__name__}: {e}") from e
+    try:
+        seq = int(head["seq"])
+        step = int(head["step"])
+        var_specs = head["vars"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise DeltaDecodeError(
+            f"delta wire header missing/corrupt field: "
+            f"{type(e).__name__}: {e}") from e
+    if not isinstance(var_specs, dict):
+        raise DeltaDecodeError(
+            f"delta wire header 'vars' is JSON "
+            f"{type(var_specs).__name__}, not an object")
     off = 0
     out: Dict[str, Dict[str, np.ndarray]] = {}
-    for name, specs in head["vars"].items():
+    for name, specs in var_specs.items():
+        if not isinstance(specs, list):
+            raise DeltaDecodeError(
+                f"delta wire specs for {name!r} are not a list")
         payload = {}
-        for f, descr, shape in specs:
-            dtype = np.dtype(np.lib.format.descr_to_dtype(descr))
-            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            nb = count * dtype.itemsize
-            arr = np.frombuffer(raw[off:off + nb], dtype=dtype)
-            payload[f] = arr.reshape(shape) if shape else arr[0]
+        for spec in specs:
+            try:
+                f, descr, shape = spec
+                dtype = np.dtype(np.lib.format.descr_to_dtype(descr))
+                dims = [int(d) for d in shape]
+            except (TypeError, ValueError, KeyError) as e:
+                raise DeltaDecodeError(
+                    f"corrupt field spec {spec!r} for {name!r}: "
+                    f"{type(e).__name__}: {e}") from e
+            if any(d < 0 for d in dims):
+                raise DeltaDecodeError(
+                    f"negative dim in field spec {spec!r} for {name!r}")
+            count = 1
+            for d in dims:
+                count *= d
+            nb = (count if dims else 1) * dtype.itemsize
+            if off + nb > len(raw):
+                raise DeltaDecodeError(
+                    f"delta wire body truncated: field {f!r} of "
+                    f"{name!r} needs body bytes [{off}, {off + nb}) "
+                    f"but the body holds {len(raw)}")
+            try:
+                arr = np.frombuffer(raw[off:off + nb], dtype=dtype)
+                payload[f] = arr.reshape(dims) if dims else arr[0]
+            except (ValueError, IndexError) as e:
+                raise DeltaDecodeError(
+                    f"field {f!r} of {name!r} does not decode as "
+                    f"{descr!r} x {dims}: {type(e).__name__}: {e}"
+                ) from e
             off += nb
         out[name] = payload
-    return Delta(seq=int(head["seq"]), step=int(head["step"]), vars=out)
+    return Delta(seq=seq, step=step, vars=out)
 
 
 # --- the compactor -----------------------------------------------------------

@@ -28,22 +28,34 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 _LIB_PATH = os.path.join(_NATIVE_DIR, "liboe_serving.so")
 
 
-def build_library(force: bool = False) -> str:
-    """Compile liboe_serving.so if absent (or ``force``); returns its path."""
-    if not force and os.path.exists(_LIB_PATH):
-        return _LIB_PATH
+def build_library(force: bool = False, variant: str = "") -> str:
+    """Compile liboe_serving.so if absent (or ``force``); returns its path.
+
+    ``variant`` selects a sanitizer build for the graftfuzz gate:
+    ``"asan"`` / ``"ubsan"`` compile ``liboe_serving_<variant>.so`` via
+    the Makefile's matching target. ASan probes must run in a process
+    that LD_PRELOADs libasan.so (gcc does not link the ASan runtime
+    into shared objects) — analysis/fuzz.py handles that; don't dlopen
+    the asan .so into a long-lived host process.
+    """
+    if variant not in ("", "asan", "ubsan"):
+        raise ValueError(f"unknown native build variant {variant!r}")
+    lib_path = (os.path.join(_NATIVE_DIR, f"liboe_serving_{variant}.so")
+                if variant else _LIB_PATH)
+    if not force and os.path.exists(lib_path):
+        return lib_path
     if not os.path.isdir(_NATIVE_DIR):
         raise RuntimeError(
             "native/ sources not found — the native serving library builds "
             "from a source checkout (make -C native); from an installed "
             "package, build it there and pass lib_path to NativeModel")
+    target = ["make", "-C", _NATIVE_DIR] + ([variant] if variant else [])
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, text=True)
+        subprocess.run(target, check=True, capture_output=True, text=True)
     except subprocess.CalledProcessError as e:
         raise RuntimeError(
             f"native build failed:\n{e.stdout}\n{e.stderr}") from e
-    return _LIB_PATH
+    return lib_path
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:

@@ -192,6 +192,9 @@ def test_wide_key_shard_groups(wide_sharded_model):
         _cleanup(procs)
 
 
+# slow: 4 replica subprocesses (~28s) — the cross-group routed lookup
+# itself stays tier-1 via test_serving_trace.py's sharded-trace test
+@pytest.mark.slow
 def test_shard_groups_with_replicas(sharded_model):
     path, want_emb, want_hsh = sharded_model
     G, R = 2, 2
